@@ -1,0 +1,133 @@
+"""Per-executor shared-state manager: feed queues + key/value dict.
+
+Reference anchor: ``tensorflowonspark/TFManager.py::TFManager.start`` /
+``TFManager.connect`` / ``_get`` / ``_set`` / ``_get_queue``.
+
+This is the *data plane* between the short-lived Spark task processes (which
+push partition data) and the long-lived trainer process (which consumes it
+through :class:`tensorflowonspark_tpu.TFNode.DataFeed`).  A
+``multiprocessing.managers.BaseManager`` server process owns a dict of named
+``queue.Queue`` objects plus a kv dict; any process on the host (or, in
+``remote`` mode, on the network) can connect with the address + authkey that
+the node runtime published into ``cluster_info``.
+
+Departures from the reference:
+
+- Queue payloads in the TPU rebuild are **columnar batches** (dict of numpy
+  arrays), not single pickled rows — the row-at-a-time queue was the
+  reference's main bottleneck (``SURVEY.md §3.2``).  The manager itself is
+  payload-agnostic.
+- kv get/set round-trips go through one proxied dict (method calls on a proxy
+  return plain values), avoiding the reference's proxy-wrapped scalars.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import queue as _queue_mod
+from multiprocessing.managers import BaseManager
+from typing import Any, Iterable
+
+# Module-level state — lives in the *manager server process* (spawn re-imports
+# this module there; the callables below close over these globals).
+_queues: dict[str, _queue_mod.Queue] = {}
+_kv: dict[str, Any] = {}
+
+
+def _setup(qnames: Iterable[str], maxsize: int) -> None:
+    for name in qnames:
+        _queues[name] = _queue_mod.Queue(maxsize)
+
+
+def _get_queue(qname: str) -> _queue_mod.Queue:
+    return _queues[qname]
+
+
+def _get_kv() -> dict[str, Any]:
+    return _kv
+
+
+class _TFManagerBase(BaseManager):
+    pass
+
+
+_TFManagerBase.register("get_queue", callable=_get_queue)
+_TFManagerBase.register("get_kv", callable=_get_kv)
+
+
+class TFManager:
+    """Handle over the manager server, exposing the reference API shape."""
+
+    def __init__(self, manager: _TFManagerBase, owns_server: bool):
+        self._manager = manager
+        self._owns_server = owns_server
+        self._kv_proxy = None
+
+    # -- reference API -----------------------------------------------------
+
+    def get_queue(self, qname: str):
+        """Proxy to the named queue (``put/get/task_done/join/qsize``)."""
+        return self._manager.get_queue(qname)
+
+    def get(self, key: str, default: Any = None) -> Any:
+        """kv read. Reference anchor: ``TFManager.py::_get``."""
+        return self._kv().get(key, default)
+
+    def set(self, key: str, value: Any) -> None:
+        """kv write. Reference anchor: ``TFManager.py::_set``."""
+        self._kv().update({key: value})
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._manager.address  # type: ignore[return-value]
+
+    def shutdown(self) -> None:
+        if self._owns_server:
+            self._manager.shutdown()
+
+    def _kv(self):
+        if self._kv_proxy is None:
+            self._kv_proxy = self._manager.get_kv()
+        return self._kv_proxy
+
+
+def start(
+    authkey: bytes,
+    queues: Iterable[str],
+    mode: str = "local",
+    maxsize: int = 1024,
+) -> TFManager:
+    """Start the manager server process for this executor.
+
+    Reference anchor: ``tensorflowonspark/TFManager.py::start``.  ``mode`` is
+    ``"local"`` (bind loopback — SPARK input mode, all clients on-host) or
+    ``"remote"`` (bind all interfaces — TENSORFLOW input mode, reachable from
+    other processes/hosts).  ``maxsize`` bounds each queue so a fast feeder
+    cannot balloon host memory (the reference's queues are unbounded *per
+    item* but TFoS bounds via ``qsize`` checks; a bounded queue is simpler and
+    gives the same back-pressure).
+    """
+    if mode not in ("local", "remote"):
+        raise ValueError(f"mode must be 'local' or 'remote', got {mode!r}")
+    host = "127.0.0.1" if mode == "local" else ""
+    # spawn, not fork: the caller typically has live JAX threads, and forking
+    # a multithreaded process deadlocks (JAX warns loudly about this).
+    ctx = multiprocessing.get_context("spawn")
+    mgr = _TFManagerBase(address=(host, 0), authkey=authkey, ctx=ctx)
+    mgr.start(initializer=_setup, initargs=(list(queues), maxsize))
+    return TFManager(mgr, owns_server=True)
+
+
+def connect(address: tuple[str, int] | list, authkey: bytes) -> TFManager:
+    """Connect to an executor's manager from another process.
+
+    Reference anchor: ``tensorflowonspark/TFManager.py::connect``.
+    """
+    # authkey must also be set on the *current* process for the connection
+    # handshake digest to match.
+    multiprocessing.current_process().authkey = authkey
+    mgr = _TFManagerBase(address=(address[0], int(address[1])), authkey=authkey)
+    mgr.connect()
+    return TFManager(mgr, owns_server=False)

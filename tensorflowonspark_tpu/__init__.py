@@ -1,0 +1,28 @@
+"""tensorflowonspark_tpu — TPU-native Spark-cluster orchestration for JAX/XLA.
+
+A brand-new framework with the capabilities of TensorFlowOnSpark
+(reference anchor: ``tensorflowonspark/__init__.py``), re-designed TPU-first:
+
+- the distributed runtime is JAX/XLA (``Mesh`` + ``pjit``/``shard_map`` with
+  ``psum`` all-reduce over ICI) instead of TensorFlow's gRPC/NCCL runtime;
+- Spark (or the bundled process-per-executor local substrate in
+  ``tensorflowonspark_tpu.sparkapi``) remains the resource manager and data
+  substrate;
+- RDD/DataFrame partitions are batched columnar and double-buffered into
+  HBM-resident device arrays instead of being fed row-at-a-time through
+  pickled queues.
+
+Public surface mirrors the reference package:
+
+- :mod:`tensorflowonspark_tpu.TFCluster` — cluster lifecycle
+  (``run/train/inference/shutdown``), ``InputMode``.
+- :mod:`tensorflowonspark_tpu.TFNode` — in-``map_fun`` helpers
+  (``DataFeed``, ``hdfs_path``, ``start_cluster_server``).
+- :mod:`tensorflowonspark_tpu.pipeline` — Spark-ML style
+  ``TFEstimator``/``TFModel``.
+- :mod:`tensorflowonspark_tpu.dfutil` — DataFrame ↔ TFRecord conversion.
+- :mod:`tensorflowonspark_tpu.TFParallel` — embarrassingly-parallel
+  single-node execution.
+"""
+
+__version__ = "0.1.0"

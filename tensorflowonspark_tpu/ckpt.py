@@ -1,0 +1,96 @@
+"""Checkpoint / export of JAX pytrees.
+
+Reference behavior: TFoS delegates checkpointing entirely to TensorFlow
+(``SURVEY.md §5`` — ``model_dir`` on HDFS, TF1 ``MonitoredTrainingSession``
+auto-restore, export via ``compat.py::export_saved_model``).  The TPU rebuild
+keeps the same delegation shape — the framework persists nothing of its own —
+but the artifact is an Orbax checkpoint of a JAX pytree behind the same
+``model_dir``/``export_dir`` parameters.
+
+Two layers:
+
+- :func:`save_pytree` / :func:`load_pytree` — one-shot export/import (used by
+  ``compat.export_saved_model`` and ``TFModel``).
+- :class:`CheckpointManager` — step-numbered training checkpoints with
+  retention and (optionally) async save, for restart-from-checkpoint recovery
+  (the reference's failure model: ``spark.task.maxFailures=1`` + restore).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_pytree(state: Any, path: str) -> str:
+    """Save a pytree (params/opt-state/step, arbitrary nesting) to ``path``."""
+    path = os.path.abspath(path)
+    _checkpointer().save(path, state, force=True)
+    logger.info("saved checkpoint to %s", path)
+    return path
+
+
+def load_pytree(path: str, target: Any | None = None) -> Any:
+    """Restore a pytree saved by :func:`save_pytree`.
+
+    Without ``target``, returns nested dicts/arrays; with ``target`` (a pytree
+    of like-shaped arrays), restores into that structure.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    if target is None:
+        return _checkpointer().restore(path)
+    return _checkpointer().restore(path, args=ocp.args.PyTreeRestore(item=target))
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints with retention, for mid-training recovery."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3, async_save: bool = False):
+        import orbax.checkpoint as ocp
+
+        self._directory = os.path.abspath(directory)
+        os.makedirs(self._directory, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, enable_async_checkpointing=async_save
+        )
+        self._mgr = ocp.CheckpointManager(self._directory, options=options)
+
+    @property
+    def directory(self) -> str:
+        return self._directory
+
+    def save(self, step: int, state: Any) -> None:
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, step: int | None = None, target: Any | None = None) -> Any:
+        import orbax.checkpoint as ocp
+
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self._directory}")
+        if target is None:
+            return self._mgr.restore(step)
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(target))
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,48 @@
+"""Backend compatibility shims.
+
+Reference anchor: ``tensorflowonspark/compat.py`` (``export_saved_model``,
+``disable_auto_shard``, ``is_gpu_available``) — version shims across TF1/TF2.
+The TPU rebuild has one backend (JAX), so these collapse to small helpers that
+keep old call sites working.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def is_gpu_available() -> bool:
+    """Reference parity: ``compat.py::is_gpu_available``. Always False here."""
+    return False
+
+
+def is_tpu_available() -> bool:
+    """True when this process can see TPU chips (without initialising JAX)."""
+    from tensorflowonspark_tpu import chip_info
+
+    return chip_info.get_num_host_chips() > 0
+
+
+def disable_auto_shard(options) -> "object":
+    """Reference parity: ``compat.py::disable_auto_shard``.
+
+    The reference toggled ``tf.data`` auto-sharding policy; JAX input
+    pipelines shard explicitly (each process reads its own slice), so this is
+    a documented no-op that returns its argument unchanged.
+    """
+    return options
+
+
+def export_saved_model(model_state, export_dir: str) -> str:
+    """Export a trained model for serving/transform.
+
+    Reference parity: ``compat.py::export_saved_model`` (TF SavedModel).  The
+    TPU rebuild's export format is an Orbax-style checkpoint directory written
+    by :mod:`tensorflowonspark_tpu.ckpt`.  Only *state* is persisted; the
+    apply function is supplied by the consumer at load time (``TFModel``
+    takes it as a constructor/param argument), matching JAX's functional
+    split of code and data.
+    """
+    from tensorflowonspark_tpu import ckpt
+
+    return ckpt.save_pytree(model_state, os.path.join(export_dir, "model"))

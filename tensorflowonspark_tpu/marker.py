@@ -1,0 +1,29 @@
+"""Queue sentinels for the SPARK-mode data plane.
+
+Reference anchor: ``tensorflowonspark/marker.py::Marker`` /
+``tensorflowonspark/marker.py::EndPartition``.
+
+These objects are placed on the feed queues between the Spark task process and
+the long-lived trainer process.  ``DataFeed.next_batch`` treats them as batch
+boundaries: a ``Marker`` ends the current batch (possibly short), and an
+``EndPartition`` additionally records that a whole Spark partition has been
+consumed so the feeder task can unblock.
+"""
+
+
+class Marker:
+    """Generic queue sentinel — terminates the in-flight batch."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # pragma: no cover - trivial
+        return "<Marker>"
+
+
+class EndPartition(Marker):
+    """Sentinel marking the end of one Spark partition on the feed queue."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # pragma: no cover - trivial
+        return "<EndPartition>"

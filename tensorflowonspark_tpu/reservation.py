@@ -1,0 +1,357 @@
+"""Cluster rendezvous control plane.
+
+Reference anchor: ``tensorflowonspark/reservation.py`` (``Reservations``,
+``MessageSocket``, ``Server``, ``Client``).
+
+Role: the driver starts a :class:`Server` expecting ``count`` nodes; every
+executor-side node registers its metadata (host, ports, role, authkey, …) via
+a :class:`Client` and then blocks until all ``count`` nodes are present, at
+which point every node receives the full cluster spec.  This barrier is what
+seeds ``jax.distributed.initialize`` in the TPU rebuild (the node with
+``executor_id == 0`` publishes its coordinator address through the built-in
+key/value blackboard).
+
+Deliberate departures from the reference design:
+
+- **JSON wire format, not pickle.**  The reference pickles messages; pickle
+  over a socket is an RCE hazard and buys nothing here since node metadata is
+  plain data.  Messages are 4-byte big-endian length-prefixed UTF-8 JSON.
+- **A key/value blackboard lives on the server** (``put``/``get``).  The
+  reference scatters this role across the per-executor ``TFManager`` kv dict
+  (e.g. the TensorBoard URL); centralising it on the rendezvous server means
+  any node or the driver can read it without knowing which executor wrote it.
+- **An auth token** (random, carried in ``cluster_meta``) must accompany every
+  message; the reference's server trusts any connection.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import secrets
+import socket
+import struct
+import threading
+import time
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct(">I")
+_MAX_MSG = 64 * 1024 * 1024
+
+
+class MessageSocket:
+    """Length-prefixed JSON messages over a connected TCP socket.
+
+    Reference anchor: ``tensorflowonspark/reservation.py::MessageSocket``.
+    """
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+
+    def send(self, msg: dict[str, Any]) -> None:
+        data = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+        self.sock.sendall(_LEN.pack(len(data)) + data)
+
+    def recv(self) -> dict[str, Any] | None:
+        header = self._recv_exact(_LEN.size)
+        if header is None:
+            return None
+        (length,) = _LEN.unpack(header)
+        if length > _MAX_MSG:
+            raise ValueError(f"message too large: {length}")
+        data = self._recv_exact(length)
+        if data is None:
+            return None
+        return json.loads(data.decode("utf-8"))
+
+    def _recv_exact(self, n: int) -> bytes | None:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def close(self) -> None:
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+
+
+class Reservations:
+    """Thread-safe registry of node reservations with a completion barrier.
+
+    Reference anchor: ``tensorflowonspark/reservation.py::Reservations``.
+    """
+
+    def __init__(self, required: int):
+        self.required = required
+        self._lock = threading.Condition()
+        # Keyed by executor_id so a Spark-retried bootstrap task that
+        # re-registers *replaces* its stale entry (latest wins) instead of
+        # double-counting and releasing the barrier with a malformed spec.
+        self._by_id: dict[Any, dict[str, Any]] = {}
+        self._anon: list[dict[str, Any]] = []
+
+    def add(self, meta: dict[str, Any]) -> None:
+        with self._lock:
+            eid = meta.get("executor_id")
+            if eid is None:
+                self._anon.append(meta)
+            else:
+                if eid in self._by_id:
+                    logger.warning(
+                        "executor %s re-registered; replacing stale entry", eid
+                    )
+                self._by_id[eid] = meta
+            if self.done():
+                self._lock.notify_all()
+
+    def _count(self) -> int:
+        return len(self._by_id) + len(self._anon)
+
+    def done(self) -> bool:
+        return self._count() >= self.required
+
+    def get(self) -> list[dict[str, Any]]:
+        with self._lock:
+            # numeric ids sort numerically (10 after 2); mixed types are
+            # grouped so consumers mapping position → process index are safe
+            ordered = sorted(
+                self._by_id.items(), key=lambda kv: (isinstance(kv[0], str), kv[0])
+            )
+            return [m for _k, m in ordered] + list(self._anon)
+
+    def remaining(self) -> int:
+        with self._lock:
+            return max(0, self.required - self._count())
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until all reservations are in; True on success."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while not self.done():
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                self._lock.wait(remaining)
+            return True
+
+
+class Server:
+    """Driver-side rendezvous listener.
+
+    Reference anchor: ``tensorflowonspark/reservation.py::Server``.  Handles
+    ``REG`` (register node meta), ``QINFO`` (poll cluster info), ``QUERY``
+    (all registered?), ``PUT``/``GET`` (kv blackboard), ``STOP``.
+    """
+
+    def __init__(self, count: int, auth_token: str | None = None):
+        self.reservations = Reservations(count)
+        self.auth_token = auth_token or secrets.token_hex(16)
+        self._kv: dict[str, Any] = {}
+        self._kv_lock = threading.Condition()
+        self._stop = threading.Event()
+        self._listener: socket.socket | None = None
+        self.address: tuple[str, int] | None = None
+
+    def start(self) -> tuple[str, int]:
+        """Bind, spawn the accept loop thread, return ``(host, port)``."""
+        from tensorflowonspark_tpu import util
+
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind(("", 0))
+        sock.listen(64)
+        self._listener = sock
+        self.address = (util.get_ip_address(), sock.getsockname()[1])
+        threading.Thread(
+            target=self._accept_loop, name="tfos-reservation-server", daemon=True
+        ).start()
+        logger.info("reservation server listening on %s", self.address)
+        return self.address
+
+    def await_reservations(self, timeout: float | None = None) -> list[dict[str, Any]]:
+        """Block until every node registered; return the cluster info."""
+        if not self.reservations.wait(timeout):
+            raise TimeoutError(
+                f"timed out waiting for {self.reservations.remaining()} of "
+                f"{self.reservations.required} nodes to register"
+            )
+        return self.reservations.get()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    # -- internals ---------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        assert self._listener is not None
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                break
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        ms = MessageSocket(conn)
+        try:
+            while not self._stop.is_set():
+                msg = ms.recv()
+                if msg is None:
+                    break
+                if msg.get("auth") != self.auth_token:
+                    ms.send({"ok": False, "error": "bad auth token"})
+                    break
+                ms.send(self._handle(msg))
+                if msg.get("type") == "STOP":
+                    break
+        except (OSError, ValueError) as e:
+            logger.debug("reservation connection error: %s", e)
+        finally:
+            ms.close()
+
+    def _handle(self, msg: dict[str, Any]) -> dict[str, Any]:
+        mtype = msg.get("type")
+        if mtype == "REG":
+            self.reservations.add(msg["meta"])
+            return {"ok": True}
+        if mtype == "QUERY":
+            return {"ok": True, "done": self.reservations.done()}
+        if mtype == "QINFO":
+            done = self.reservations.done()
+            return {
+                "ok": True,
+                "done": done,
+                "cluster": self.reservations.get() if done else None,
+            }
+        if mtype == "WAIT":
+            # Server-side blocking wait on the registration barrier — one
+            # connection per node instead of the reference's poll loop
+            # (``reservation.py::Client.await_reservations`` polls QINFO).
+            done = self.reservations.wait(timeout=msg.get("timeout", 30.0))
+            return {
+                "ok": True,
+                "done": done,
+                "cluster": self.reservations.get() if done else None,
+            }
+        if mtype == "PUT":
+            with self._kv_lock:
+                self._kv[msg["key"]] = msg["value"]
+                self._kv_lock.notify_all()
+            return {"ok": True}
+        if mtype == "GET":
+            with self._kv_lock:
+                timeout = msg.get("timeout", 0.0)
+                deadline = time.monotonic() + timeout
+                while msg["key"] not in self._kv:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._kv_lock.wait(remaining)
+                present = msg["key"] in self._kv
+                return {
+                    "ok": True,
+                    "found": present,
+                    "value": self._kv.get(msg["key"]),
+                }
+        if mtype == "STOP":
+            self._stop.set()
+            if self._listener is not None:
+                try:
+                    self._listener.close()
+                except OSError:
+                    pass
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown message type {mtype!r}"}
+
+
+class Client:
+    """Executor-side rendezvous client.
+
+    Reference anchor: ``tensorflowonspark/reservation.py::Client``.  One TCP
+    connection per call keeps the client trivially fork/spawn-safe (the
+    reference holds one long-lived socket, which breaks when the background
+    trainer process inherits it).
+    """
+
+    def __init__(self, server_addr: tuple[str, int] | list, auth_token: str):
+        self.server_addr = (server_addr[0], int(server_addr[1]))
+        self.auth_token = auth_token
+
+    def _call(self, msg: dict[str, Any], timeout: float = 30.0) -> dict[str, Any]:
+        msg = dict(msg, auth=self.auth_token)
+        sock = socket.create_connection(self.server_addr, timeout=timeout)
+        ms = MessageSocket(sock)
+        try:
+            ms.send(msg)
+            reply = ms.recv()
+        finally:
+            ms.close()
+        if reply is None:
+            raise ConnectionError("reservation server closed connection")
+        if not reply.get("ok", False):
+            raise RuntimeError(f"reservation server error: {reply.get('error')}")
+        return reply
+
+    def register(self, node_meta: dict[str, Any]) -> None:
+        self._call({"type": "REG", "meta": node_meta})
+
+    def await_reservations(
+        self, timeout: float = 600.0, poll_interval: float = 0.2
+    ) -> list[dict[str, Any]]:
+        """Block until the whole cluster registered; return cluster info.
+
+        Uses a server-side blocking wait (one connection, chunked so a dead
+        server is noticed) rather than the reference's QINFO poll loop.
+        ``poll_interval`` is kept for signature parity; it is unused.
+        """
+        del poll_interval
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"timed out after {timeout}s waiting for cluster reservations"
+                )
+            chunk = min(remaining, 30.0)
+            reply = self._call(
+                {"type": "WAIT", "timeout": chunk}, timeout=chunk + 30.0
+            )
+            if reply["done"]:
+                return reply["cluster"]
+
+    def put(self, key: str, value: Any) -> None:
+        """Publish to the cluster-wide kv blackboard."""
+        self._call({"type": "PUT", "key": key, "value": value})
+
+    def get(self, key: str, timeout: float = 0.0) -> Any:
+        """Read from the blackboard; block up to ``timeout`` for the key."""
+        reply = self._call(
+            {"type": "GET", "key": key, "timeout": timeout},
+            timeout=max(30.0, timeout + 10.0),
+        )
+        if not reply["found"]:
+            raise KeyError(key)
+        return reply["value"]
+
+    def request_stop(self) -> None:
+        try:
+            self._call({"type": "STOP"})
+        except (ConnectionError, OSError):
+            pass  # server already gone — that's what we wanted

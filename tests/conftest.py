@@ -1,0 +1,26 @@
+"""Test harness configuration.
+
+Mirrors the reference's test strategy (SURVEY.md §4): multi-node behavior is
+exercised on one machine.  "TPU" in tests = the JAX CPU backend with 8 forced
+host devices (``xla_force_host_platform_device_count``) — the TPU-world
+analogue of the reference running Spark ``local-cluster[N,...]``.
+
+The env vars below are set *before* any jax backend initialisation and are
+inherited by spawned executor processes, where
+``tensorflowonspark_tpu.util.ensure_jax_platform`` re-applies them (a
+site-installed TPU PJRT plugin pins ``jax_platforms`` at interpreter start, so
+plain ``JAX_PLATFORMS=cpu`` is not enough).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("TFOS_JAX_PLATFORM", "cpu")
+os.environ.setdefault("TFOS_HOST_DEVICE_COUNT", "8")
+os.environ.setdefault("TFOS_NUM_CHIPS", "0")  # no real chips in unit tests
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tensorflowonspark_tpu import util  # noqa: E402
+
+util.ensure_jax_platform()

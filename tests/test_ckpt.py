@@ -1,0 +1,37 @@
+"""Unit tests for checkpoint/export."""
+
+import numpy as np
+
+from tensorflowonspark_tpu import ckpt, compat
+
+
+def _tree_close(a, b):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_save_load_roundtrip(tmp_path):
+    state = {
+        "params": {"w": np.arange(6, dtype=np.float32).reshape(2, 3), "b": np.zeros(3)},
+        "step": np.int32(7),
+    }
+    path = ckpt.save_pytree(state, str(tmp_path / "export"))
+    restored = ckpt.load_pytree(path)
+    _tree_close(restored["params"]["w"], state["params"]["w"])
+    _tree_close(restored["step"], 7)
+
+
+def test_checkpoint_manager_retention_and_latest(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ckpts"), max_to_keep=2)
+    for step in (1, 2, 3):
+        mgr.save(step, {"w": np.full((2,), float(step))})
+    mgr.wait_until_finished()
+    assert mgr.latest_step() == 3
+    restored = mgr.restore()
+    _tree_close(restored["w"], np.full((2,), 3.0))
+    mgr.close()
+
+
+def test_export_saved_model_shim(tmp_path):
+    out = compat.export_saved_model({"w": np.ones(4)}, str(tmp_path / "exp"))
+    restored = ckpt.load_pytree(out)
+    _tree_close(restored["w"], np.ones(4))

@@ -1,0 +1,62 @@
+"""Unit tests for the per-executor TFManager data plane."""
+
+import multiprocessing
+import queue
+
+import pytest
+
+from tensorflowonspark_tpu import TFManager
+
+
+@pytest.fixture()
+def mgr():
+    m = TFManager.start(b"secret", ["input", "output", "error"], mode="local")
+    yield m
+    m.shutdown()
+
+
+def test_queue_roundtrip(mgr):
+    q = mgr.get_queue("input")
+    q.put({"x": [1, 2, 3]})
+    q.put({"x": [4, 5, 6]})
+    assert q.get()["x"] == [1, 2, 3]
+    assert q.get()["x"] == [4, 5, 6]
+    assert q.qsize() == 0
+
+
+def test_kv(mgr):
+    assert mgr.get("state") is None
+    mgr.set("state", "running")
+    assert mgr.get("state") == "running"
+    mgr.set("state", "stopped")
+    assert mgr.get("state") == "stopped"
+
+
+def test_connect_from_other_process(mgr):
+    addr = mgr.address
+    ctx = multiprocessing.get_context("spawn")
+    p = ctx.Process(target=_child_push, args=(addr, b"secret"))
+    p.start()
+    p.join(timeout=60)
+    assert p.exitcode == 0
+    q = mgr.get_queue("input")
+    assert q.get(timeout=10) == "from-child"
+    assert mgr.get("child_key") == 42
+
+
+def _child_push(addr, authkey):
+    m = TFManager.connect(addr, authkey)
+    m.get_queue("input").put("from-child")
+    m.set("child_key", 42)
+
+
+def test_queue_maxsize_backpressure():
+    m = TFManager.start(b"k", ["input"], mode="local", maxsize=2)
+    try:
+        q = m.get_queue("input")
+        q.put(1)
+        q.put(2)
+        with pytest.raises(queue.Full):
+            q.put(3, block=False)
+    finally:
+        m.shutdown()

@@ -1,0 +1,120 @@
+"""Unit tests for the rendezvous control plane.
+
+Mirrors the reference's ``test/test_reservation.py`` approach (SURVEY.md §4):
+real Server + Client over localhost sockets, threads for concurrent
+registration, timeout behavior of ``await_reservations``.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tensorflowonspark_tpu import reservation
+
+
+def test_reservations_registry():
+    r = reservation.Reservations(3)
+    assert r.remaining() == 3
+    assert not r.done()
+    r.add({"id": 0})
+    r.add({"id": 1})
+    assert r.remaining() == 1
+    r.add({"id": 2})
+    assert r.done()
+    assert sorted(m["id"] for m in r.get()) == [0, 1, 2]
+
+
+def test_reservations_wait_timeout():
+    r = reservation.Reservations(1)
+    assert not r.wait(timeout=0.05)
+    r.add({})
+    assert r.wait(timeout=0.05)
+
+
+def test_server_client_roundtrip():
+    server = reservation.Server(count=3)
+    addr = server.start()
+    clients = [reservation.Client(addr, server.auth_token) for _ in range(3)]
+
+    results = []
+
+    def node(i, c):
+        c.register({"executor_id": i, "host": "127.0.0.1", "port": 6000 + i})
+        results.append(c.await_reservations(timeout=10.0))
+
+    threads = [
+        threading.Thread(target=node, args=(i, c)) for i, c in enumerate(clients)
+    ]
+    for t in threads:
+        t.start()
+    cluster = server.await_reservations(timeout=10.0)
+    for t in threads:
+        t.join(timeout=10.0)
+
+    assert len(cluster) == 3
+    assert len(results) == 3
+    for cluster_info in results:
+        assert sorted(m["executor_id"] for m in cluster_info) == [0, 1, 2]
+    server.stop()
+
+
+def test_client_await_times_out():
+    server = reservation.Server(count=2)
+    addr = server.start()
+    c = reservation.Client(addr, server.auth_token)
+    c.register({"executor_id": 0})
+    with pytest.raises(TimeoutError):
+        c.await_reservations(timeout=0.3, poll_interval=0.05)
+    server.stop()
+
+
+def test_server_await_times_out():
+    server = reservation.Server(count=2)
+    server.start()
+    with pytest.raises(TimeoutError):
+        server.await_reservations(timeout=0.2)
+    server.stop()
+
+
+def test_kv_blackboard():
+    server = reservation.Server(count=1)
+    addr = server.start()
+    c = reservation.Client(addr, server.auth_token)
+    with pytest.raises(KeyError):
+        c.get("tb_url")
+    c.put("tb_url", "http://host:6006")
+    assert c.get("tb_url") == "http://host:6006"
+
+    # blocking get: value published from another thread after a delay
+    def later():
+        time.sleep(0.2)
+        reservation.Client(addr, server.auth_token).put("coord", "1.2.3.4:99")
+
+    threading.Thread(target=later).start()
+    assert c.get("coord", timeout=5.0) == "1.2.3.4:99"
+    server.stop()
+
+
+def test_bad_auth_rejected():
+    server = reservation.Server(count=1)
+    addr = server.start()
+    bad = reservation.Client(addr, "wrong-token")
+    with pytest.raises((RuntimeError, ConnectionError)):
+        bad.register({"executor_id": 0})
+    # server still healthy for the real client
+    good = reservation.Client(addr, server.auth_token)
+    good.register({"executor_id": 0})
+    assert server.await_reservations(timeout=5.0)
+    server.stop()
+
+
+def test_request_stop():
+    server = reservation.Server(count=1)
+    addr = server.start()
+    c = reservation.Client(addr, server.auth_token)
+    c.request_stop()
+    time.sleep(0.1)
+    # after stop, new connections fail
+    with pytest.raises((ConnectionError, OSError, RuntimeError)):
+        c.register({"executor_id": 0})
