@@ -81,7 +81,18 @@ class TFManager:
 
     @property
     def address(self) -> tuple[str, int]:
-        return self._manager.address  # type: ignore[return-value]
+        """Routable ``(host, port)`` of the manager server.
+
+        A ``remote``-mode server binds ``''`` and reports ``0.0.0.0``, which
+        is useless when published to other hosts via cluster_info — replace
+        it with this host's routable IP (same as ``reservation.Server``).
+        """
+        host, port = self._manager.address  # type: ignore[misc]
+        if host in ("", "0.0.0.0"):
+            from tensorflowonspark_tpu import util
+
+            host = util.get_ip_address()
+        return (host, port)
 
     def shutdown(self) -> None:
         if self._owns_server:

@@ -31,9 +31,17 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
+def _canonical(path: str) -> str:
+    """Absolutize local paths; leave URI-style paths (gs://, hdfs://) alone —
+    orbax/tensorstore handles those natively and abspath would mangle them."""
+    if "://" in path:
+        return path
+    return os.path.abspath(path)
+
+
 def save_pytree(state: Any, path: str) -> str:
     """Save a pytree (params/opt-state/step, arbitrary nesting) to ``path``."""
-    path = os.path.abspath(path)
+    path = _canonical(path)
     _checkpointer().save(path, state, force=True)
     logger.info("saved checkpoint to %s", path)
     return path
@@ -47,7 +55,7 @@ def load_pytree(path: str, target: Any | None = None) -> Any:
     """
     import orbax.checkpoint as ocp
 
-    path = os.path.abspath(path)
+    path = _canonical(path)
     if target is None:
         return _checkpointer().restore(path)
     return _checkpointer().restore(path, args=ocp.args.PyTreeRestore(item=target))
@@ -59,8 +67,9 @@ class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 3, async_save: bool = False):
         import orbax.checkpoint as ocp
 
-        self._directory = os.path.abspath(directory)
-        os.makedirs(self._directory, exist_ok=True)
+        self._directory = _canonical(directory)
+        if "://" not in self._directory:
+            os.makedirs(self._directory, exist_ok=True)
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep, enable_async_checkpointing=async_save
         )

@@ -1,0 +1,157 @@
+"""RDD subset for the local Spark substrate.
+
+Lazy per-partition transform chains over driver-resident partition payloads;
+actions ship ``(payload, chain, action)`` to executor processes via
+``LocalSparkContext.run_job``.  Covers the RDD surface the orchestration
+layer and its tests touch (``SURVEY.md §3``): ``mapPartitions`` /
+``foreachPartition`` / ``map`` / ``collect`` are the load-bearing ones.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator
+
+
+def _collect_action(_pindex: int, it: Iterator) -> list:
+    return list(it)
+
+
+def _count_action(_pindex: int, it: Iterator) -> int:
+    return sum(1 for _ in it)
+
+
+class _Foreach:
+    def __init__(self, f: Callable[[Iterator], Any]):
+        self.f = f
+
+    def __call__(self, _pindex: int, it: Iterator) -> None:
+        self.f(it)
+        return None
+
+
+class _MapPartitions:
+    def __init__(self, f: Callable[[Iterator], Iterable], with_index: bool):
+        self.f = f
+        self.with_index = with_index
+
+    def __call__(self, pindex: int, it: Iterator) -> Iterator:
+        out = self.f(pindex, it) if self.with_index else self.f(it)
+        return iter(out)
+
+
+class RDD:
+    def __init__(self, sc, partitions: list[Any], chain: list | None = None):
+        self._sc = sc
+        self._partitions = partitions
+        self._chain = chain or []
+
+    # -- transformations (lazy) -------------------------------------------
+
+    def mapPartitions(self, f: Callable[[Iterator], Iterable],
+                      preservesPartitioning: bool = False) -> "RDD":
+        return RDD(self._sc, self._partitions,
+                   self._chain + [_MapPartitions(f, with_index=False)])
+
+    def mapPartitionsWithIndex(self, f: Callable[[int, Iterator], Iterable],
+                               preservesPartitioning: bool = False) -> "RDD":
+        return RDD(self._sc, self._partitions,
+                   self._chain + [_MapPartitions(f, with_index=True)])
+
+    def map(self, f: Callable[[Any], Any]) -> "RDD":
+        return self.mapPartitions(_MapImpl(f))
+
+    def flatMap(self, f: Callable[[Any], Iterable]) -> "RDD":
+        return self.mapPartitions(_FlatMapImpl(f))
+
+    def filter(self, f: Callable[[Any], bool]) -> "RDD":
+        return self.mapPartitions(_FilterImpl(f))
+
+    def union(self, other: "RDD") -> "RDD":
+        if self._chain or other._chain:
+            # materialize both sides so the union has a single empty chain
+            left = self._sc.run_job(self._partitions, self._chain, _collect_action)
+            right = other._sc.run_job(other._partitions, other._chain, _collect_action)
+            return RDD(self._sc, left + right)
+        return RDD(self._sc, self._partitions + other._partitions)
+
+    def repartition(self, numPartitions: int) -> "RDD":
+        items = self.collect()
+        return self._sc.parallelize(items, numPartitions)
+
+    def coalesce(self, numPartitions: int, shuffle: bool = False) -> "RDD":
+        return self.repartition(numPartitions)
+
+    def cache(self) -> "RDD":  # no storage levels in the local substrate
+        return self
+
+    def persist(self, *_a, **_kw) -> "RDD":
+        return self
+
+    def zipWithIndex(self) -> "RDD":
+        items = self.collect()
+        return self._sc.parallelize(
+            [(x, i) for i, x in enumerate(items)], self.getNumPartitions()
+        )
+
+    # -- actions -----------------------------------------------------------
+
+    def getNumPartitions(self) -> int:
+        return len(self._partitions)
+
+    def collect(self) -> list:
+        parts = self._sc.run_job(self._partitions, self._chain, _collect_action)
+        return [x for part in parts for x in part]
+
+    def count(self) -> int:
+        return sum(self._sc.run_job(self._partitions, self._chain, _count_action))
+
+    def take(self, n: int) -> list:
+        return self.collect()[:n]
+
+    def first(self) -> Any:
+        out = self.take(1)
+        if not out:
+            raise ValueError("RDD is empty")
+        return out[0]
+
+    def foreachPartition(self, f: Callable[[Iterator], Any]) -> None:
+        self._sc.run_job(self._partitions, self._chain, _Foreach(f))
+
+    def foreach(self, f: Callable[[Any], Any]) -> None:
+        self.foreachPartition(_ForeachEach(f))
+
+    def isEmpty(self) -> bool:
+        return self.count() == 0
+
+
+class _MapImpl:
+    def __init__(self, f):
+        self.f = f
+
+    def __call__(self, it):
+        return (self.f(x) for x in it)
+
+
+class _FlatMapImpl:
+    def __init__(self, f):
+        self.f = f
+
+    def __call__(self, it):
+        return (y for x in it for y in self.f(x))
+
+
+class _FilterImpl:
+    def __init__(self, f):
+        self.f = f
+
+    def __call__(self, it):
+        return (x for x in it if self.f(x))
+
+
+class _ForeachEach:
+    def __init__(self, f):
+        self.f = f
+
+    def __call__(self, it):
+        for x in it:
+            self.f(x)

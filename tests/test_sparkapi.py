@@ -1,0 +1,184 @@
+"""Tests for the local Spark substrate (process-per-executor execution)."""
+
+import os
+import time
+
+import pytest
+
+from tensorflowonspark_tpu.sparkapi import (
+    LocalSparkContext,
+    LocalSparkSession,
+    Row,
+    StructField,
+    StructType,
+)
+from tensorflowonspark_tpu.sparkapi.sql import infer_schema
+
+
+@pytest.fixture(scope="module")
+def sc():
+    ctx = LocalSparkContext("local-cluster[3,1,1024]", "sparkapi-test")
+    yield ctx
+    ctx.stop()
+
+
+# -- module-level functions (cloudpickle ships lambdas too, but these also
+#    exercise the plain-pickle path) --
+
+
+def _double(x):
+    return x * 2
+
+
+def _pid_of_partition(it):
+    list(it)
+    return [os.getpid()]
+
+
+def test_parallelize_collect_ordering(sc):
+    data = list(range(100))
+    rdd = sc.parallelize(data, 7)
+    assert rdd.getNumPartitions() == 7
+    assert rdd.collect() == data
+
+
+def test_map_filter_flatmap_chain(sc):
+    rdd = sc.parallelize(range(10), 3)
+    out = (
+        rdd.map(_double)
+        .filter(lambda x: x % 4 == 0)
+        .flatMap(lambda x: [x, -x])
+        .collect()
+    )
+    assert out == [y for x in range(10) if (2 * x) % 4 == 0 for y in (2 * x, -2 * x)]
+
+
+def test_count_take_first(sc):
+    rdd = sc.parallelize(range(11), 4)
+    assert rdd.count() == 11
+    assert rdd.take(3) == [0, 1, 2]
+    assert rdd.first() == 0
+
+
+def test_tasks_run_in_separate_processes(sc):
+    rdd = sc.parallelize(range(3), 3)
+    pids = rdd.mapPartitions(_pid_of_partition).collect()
+    assert len(set(pids)) == 3, f"expected 3 distinct executor pids, got {pids}"
+    assert os.getpid() not in pids
+
+
+def test_mapPartitionsWithIndex(sc):
+    rdd = sc.parallelize(range(6), 3)
+    out = rdd.mapPartitionsWithIndex(lambda i, it: [(i, sorted(it))]).collect()
+    assert out == [(0, [0, 1]), (1, [2, 3]), (2, [4, 5])]
+
+
+def test_concurrent_barrier_across_executors(sc):
+    """The property TFCluster depends on: an n-partition job on n executors
+    runs all n tasks simultaneously, so a cross-task barrier completes."""
+    from tensorflowonspark_tpu import reservation
+
+    server = reservation.Server(count=3)
+    addr = server.start()
+    token = server.auth_token
+
+    def barrier_task(it):
+        part = list(it)
+        c = reservation.Client(addr, token)
+        c.register({"executor_id": part[0]})
+        c.await_reservations(timeout=15)
+
+    t0 = time.monotonic()
+    sc.parallelize(range(3), 3).foreachPartition(barrier_task)
+    assert time.monotonic() - t0 < 15
+    assert len(server.await_reservations(timeout=1)) == 3
+    server.stop()
+
+
+def test_task_failure_propagates_with_traceback(sc):
+    def boom(it):
+        list(it)
+        raise ValueError("synthetic failure in executor")
+
+    with pytest.raises(RuntimeError, match="synthetic failure in executor"):
+        sc.parallelize(range(3), 3).foreachPartition(boom)
+    # context still usable after a failed job (no retry, but no poisoning)
+    assert sc.parallelize(range(4), 2).count() == 4
+
+
+def test_broadcast_and_closure_capture(sc):
+    b = sc.broadcast({"scale": 10})
+    out = sc.parallelize([1, 2, 3], 3).map(lambda x: x * b.value["scale"]).collect()
+    assert out == [10, 20, 30]
+
+
+def test_union_repartition_zipWithIndex(sc):
+    a = sc.parallelize([1, 2], 1)
+    b = sc.parallelize([3, 4], 1).map(_double)
+    assert a.union(b).collect() == [1, 2, 6, 8]
+    assert sorted(sc.parallelize(range(5), 5).repartition(2).collect()) == list(range(5))
+    assert sc.parallelize(["a", "b"], 1).zipWithIndex().collect() == [("a", 0), ("b", 1)]
+
+
+def test_executor_cwd_isolated(sc):
+    cwds = sc.parallelize(range(3), 3).mapPartitions(
+        lambda it: [os.getcwd() if list(it) else None]
+    ).collect()
+    assert len(set(cwds)) == 3
+    assert all("executor_" in c for c in cwds)
+
+
+def test_master_string_parsing():
+    assert LocalSparkContext("local", "t").num_executors == 1
+    ctx = LocalSparkContext("local[2]", "t")
+    assert ctx.num_executors == 2
+    ctx.stop()
+    with pytest.raises(ValueError):
+        LocalSparkContext("yarn", "t")
+
+
+# -- DataFrame layer --
+
+
+@pytest.fixture(scope="module")
+def spark(sc):
+    return LocalSparkSession(sc)
+
+
+def test_create_dataframe_infer_schema(spark):
+    df = spark.createDataFrame(
+        [(1, 2.5, "a"), (2, 3.5, "b")], schema=["id", "val", "name"]
+    )
+    assert df.dtypes == [("id", "bigint"), ("val", "double"), ("name", "string")]
+    rows = df.collect()
+    assert rows[0].id == 1 and rows[1].name == "b"
+    assert df.count() == 2
+
+
+def test_dataframe_select(spark):
+    df = spark.createDataFrame([(1, "x"), (2, "y")], schema=["k", "v"])
+    sel = df.select("v")
+    assert sel.columns == ["v"]
+    assert [r.v for r in sel.collect()] == ["x", "y"]
+
+
+def test_dataframe_from_rows_and_rdd(spark):
+    rows = [Row(a=1, b=[1.0, 2.0]), Row(a=2, b=[3.0, 4.0])]
+    df = spark.createDataFrame(rows)
+    assert df.dtypes == [("a", "bigint"), ("b", "array<double>")]
+    rdd_df = spark.createDataFrame(spark.sparkContext.parallelize(rows))
+    assert rdd_df.count() == 2
+
+
+def test_infer_schema_binary_and_bool():
+    st = infer_schema({"flag": True, "blob": b"xyz"})
+    assert st == StructType(
+        [StructField("flag", "boolean"), StructField("blob", "binary")]
+    )
+
+
+def test_row_access_patterns():
+    r = Row(x=1, y="s")
+    assert r.x == 1 and r["y"] == "s" and r[0] == 1
+    assert r.asDict() == {"x": 1, "y": "s"}
+    assert list(r) == [1, "s"]
