@@ -1,0 +1,165 @@
+"""MNIST dense classifier — InputMode.SPARK end-to-end example.
+
+Acceptance config #1 (``BASELINE.json``): feed an RDD of (image, label) rows
+through the cluster and train data-parallel.  Mirrors the reference's
+``examples/mnist/spark/mnist_spark.py`` CLI shape (argparse +
+``TFCluster.run``), with a JAX/TPU map_fun instead of a TF graph.
+
+Run (no real MNIST needed — synthesises MNIST-shaped data by default):
+
+    python examples/mnist/mnist_spark.py --cluster_size 2 --epochs 3
+
+With a real dataset exported as ``mnist.npz`` (arrays ``x_train``/``y_train``
+scaled 0-255, shape [N, 784] / [N]):
+
+    python examples/mnist/mnist_spark.py --data /path/to/mnist.npz
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# allow running straight from a source checkout (spark-submit ships the
+# package via --py-files in a real deployment)
+_repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _repo_root not in sys.path:
+    sys.path.insert(0, _repo_root)
+
+
+def map_fun(args, ctx):
+    """Per-node trainer: 2-layer MLP, bfloat16 matmuls, SGD with momentum."""
+    from tensorflowonspark_tpu import util
+
+    util.ensure_jax_platform()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    feed = ctx.get_data_feed(train_mode=True, input_mapping=["image", "label"])
+
+    def init(key):
+        k1, k2 = jax.random.split(key)
+        return {
+            "w1": jax.random.normal(k1, (784, args.hidden)) * 0.05,
+            "b1": jnp.zeros(args.hidden),
+            "w2": jax.random.normal(k2, (args.hidden, 10)) * 0.05,
+            "b2": jnp.zeros(10),
+        }
+
+    def apply(params, x):
+        # bfloat16 matmuls hit the MXU; accumulate activations in f32
+        h = jnp.maximum(
+            (x.astype(jnp.bfloat16) @ params["w1"].astype(jnp.bfloat16)).astype(
+                jnp.float32
+            )
+            + params["b1"],
+            0.0,
+        )
+        return (h.astype(jnp.bfloat16) @ params["w2"].astype(jnp.bfloat16)).astype(
+            jnp.float32
+        ) + params["b2"]
+
+    @jax.jit
+    def step(params, mom, x, y):
+        def loss_fn(p):
+            logits = apply(p, x)
+            onehot = jax.nn.one_hot(y, 10)
+            return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        mom = jax.tree.map(lambda m, g: 0.9 * m + g, mom, grads)
+        params = jax.tree.map(lambda p, m: p - args.lr * m, params, mom)
+        return params, mom, loss
+
+    @jax.jit
+    def accuracy(params, x, y):
+        return jnp.mean(jnp.argmax(apply(params, x), axis=-1) == y)
+
+    params = init(jax.random.PRNGKey(ctx.task_index))
+    mom = jax.tree.map(jnp.zeros_like, params)
+    loss = None
+    seen = 0
+    while not feed.should_stop():
+        batch = feed.next_batch(args.batch_size, device_put=True)
+        if not batch or batch["image"].shape[0] == 0:
+            continue
+        x = batch["image"].astype("float32") / 255.0
+        y = batch["label"]
+        # static-shape guard: pad the tail batch so jit sees one shape
+        n = x.shape[0]
+        if n < args.batch_size:
+            pad = args.batch_size - n
+            x = jnp.pad(x, ((0, pad), (0, 0)))
+            y = jnp.pad(y, (0, pad))
+        params, mom, loss = step(params, mom, x, y)
+        seen += n
+    ctx.mgr.set("final_loss", float(loss) if loss is not None else None)
+    ctx.mgr.set("examples_seen", seen)
+    if args.model_dir and ctx.executor_id == 0:  # exactly one exporter
+        from tensorflowonspark_tpu import compat
+
+        host_params = jax.tree.map(np.asarray, params)
+        compat.export_saved_model(host_params, ctx.absolute_path(args.model_dir))
+
+
+def synth_mnist(n: int, seed: int = 0):
+    """MNIST-shaped synthetic data with learnable class structure."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    protos = rng.normal(size=(10, 784)) * 40 + 128
+    labels = rng.integers(0, 10, size=n)
+    imgs = protos[labels] + rng.normal(size=(n, 784)) * 25
+    return np.clip(imgs, 0, 255).astype(np.float32), labels.astype(np.int32)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--cluster_size", type=int, default=2)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch_size", type=int, default=128)
+    p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--num_samples", type=int, default=4096)
+    p.add_argument("--data", default=None, help="optional mnist.npz path")
+    p.add_argument("--model_dir", default=None)
+    p.add_argument("--master", default=None, help="Spark master override")
+    args = p.parse_args(argv)
+
+    from tensorflowonspark_tpu import TFCluster, TFManager
+    from tensorflowonspark_tpu.sparkapi import get_spark_context
+
+    sc = get_spark_context(
+        args.master or f"local-cluster[{args.cluster_size},1,1024]", "mnist-spark"
+    )
+
+    if args.data:
+        import numpy as np
+
+        with np.load(args.data) as z:
+            x, y = z["x_train"].reshape(-1, 784), z["y_train"]
+    else:
+        x, y = synth_mnist(args.num_samples)
+    rows = [(x[i], int(y[i])) for i in range(len(y))]
+
+    cluster = TFCluster.run(
+        sc, map_fun, args, num_executors=args.cluster_size,
+        input_mode=TFCluster.InputMode.SPARK, master_node="chief",
+    )
+    cluster.train(sc.parallelize(rows, args.cluster_size), num_epochs=args.epochs)
+    cluster.shutdown(grace_secs=60)
+
+    authkey = bytes.fromhex(cluster.cluster_meta["authkey_hex"])
+    for meta in cluster.cluster_info:
+        mgr = TFManager.connect(tuple(meta["addr"]), authkey)
+        print(
+            f"node {meta['job_name']}:{meta['task_index']} "
+            f"final_loss={mgr.get('final_loss'):.4f} seen={mgr.get('examples_seen')}"
+        )
+    sc.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,277 @@
+"""Cluster lifecycle API — the driver-side entry point.
+
+Reference anchor: ``tensorflowonspark/TFCluster.py`` (``run``, ``TFCluster``
+with ``train/inference/shutdown/tensorboard_url``, ``InputMode``).
+
+Flow (``SURVEY.md §3.1``): compute the cluster template (roles per executor),
+start the rendezvous server, launch one bootstrap task per executor on a
+background thread, wait for every node to register, hand back a
+:class:`TFCluster`.  ``InputMode.SPARK`` pushes RDD partitions through
+per-executor queues into the trainer; ``InputMode.TENSORFLOW`` lets the
+trainer read files (TFRecords on HDFS/GCS) directly, with the bootstrap task
+blocking for the whole training run.
+
+TPU deltas: the rendezvous barrier seeds ``jax.distributed.initialize``
+(coordinator = executor 0, address on the kv blackboard) instead of writing
+``TF_CONFIG``; ``num_ps`` maps to ZeRO-style sharded optimizer state instead
+of parameter-server nodes (there are no parameter servers on a TPU pod —
+see ``SURVEY.md §2.3``).
+"""
+
+from __future__ import annotations
+
+import logging
+import secrets
+import threading
+import uuid
+from enum import Enum
+from typing import Any, Callable
+
+from tensorflowonspark_tpu import TFSparkNode, reservation
+
+logger = logging.getLogger(__name__)
+
+
+class InputMode(Enum):
+    """Reference anchor: ``TFCluster.py::InputMode``."""
+
+    TENSORFLOW = 0  # trainer reads its own data (files on HDFS/GCS)
+    SPARK = 1  # Spark feeds RDD/DataFrame partitions through queues
+
+
+class TFCluster:
+    def __init__(self, sc, cluster_meta, cluster_info, server, input_mode,
+                 bootstrap_thread):
+        self.sc = sc
+        self.cluster_meta = cluster_meta
+        self.cluster_info = cluster_info
+        self.server = server
+        self.input_mode = input_mode
+        self._thread = bootstrap_thread
+        self._thread_error: list[BaseException] = []
+        self.num_executors = cluster_meta["num_executors"]
+
+    # -- data plane --------------------------------------------------------
+
+    def train(self, dataRDD, num_epochs: int = 1, feed_timeout: float = 600.0,
+              qname: str = "input") -> None:
+        """Feed an RDD through the cluster for ``num_epochs``.
+
+        Reference anchor: ``TFCluster.py::TFCluster.train`` (it re-submits
+        the RDD once per epoch; each partition lands on an executor and is
+        pushed into the co-located node's queue).
+        """
+        if self.input_mode is not InputMode.SPARK:
+            raise RuntimeError("train(dataRDD) requires InputMode.SPARK")
+        self._check_bootstrap_error()
+        for epoch in range(num_epochs):
+            logger.info("feeding epoch %d/%d", epoch + 1, num_epochs)
+            dataRDD.foreachPartition(
+                TFSparkNode.train(self.cluster_info, self.cluster_meta,
+                                  feed_timeout, qname)
+            )
+            self._check_bootstrap_error()
+
+    def inference(self, dataRDD, qname_in: str = "input",
+                  qname_out: str = "output", timeout: float = 600.0):
+        """Run distributed inference; returns an RDD of predictions.
+
+        Reference anchor: ``TFCluster.py::TFCluster.inference``.
+        """
+        if self.input_mode is not InputMode.SPARK:
+            raise RuntimeError("inference(dataRDD) requires InputMode.SPARK")
+        self._check_bootstrap_error()
+        return dataRDD.mapPartitions(
+            TFSparkNode.inference(self.cluster_info, self.cluster_meta,
+                                  qname_in, qname_out, timeout)
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def shutdown(self, ssc=None, grace_secs: float = 30.0,
+                 timeout: float = 600.0, qname: str = "input") -> None:
+        """Stop all nodes, propagate trainer errors, stop the rendezvous.
+
+        Reference anchor: ``TFCluster.py::TFCluster.shutdown``.  In SPARK
+        mode, sends a stop marker to every node's feed queue and waits up to
+        ``grace_secs`` for each trainer to finish; in TENSORFLOW mode waits
+        for the (blocking) bootstrap job to complete.
+        """
+        del ssc  # streaming contexts are not supported by the local substrate
+        try:
+            if self.input_mode is InputMode.SPARK:
+                n = self.num_executors
+                self.sc.parallelize(range(n), n).foreachPartition(
+                    TFSparkNode.shutdown(self.cluster_info, self.cluster_meta,
+                                         grace_secs, qname)
+                )
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                raise RuntimeError(
+                    f"cluster bootstrap job still running after {timeout}s"
+                )
+            self._check_bootstrap_error()
+        finally:
+            self.server.stop()
+
+    def tensorboard_url(self, timeout: float = 0.0) -> str | None:
+        """URL of the cluster's TensorBoard, if one was started.
+
+        Reference anchor: ``TFCluster.py::TFCluster.tensorboard_url`` (the
+        reference polls the manager kv; here it lives on the rendezvous kv).
+        """
+        client = reservation.Client(
+            tuple(self.cluster_meta["server_addr"]), self.cluster_meta["auth_token"]
+        )
+        try:
+            return client.get("tensorboard_url", timeout=timeout)
+        except KeyError:
+            return None
+
+    def profiler_address(self, timeout: float = 0.0) -> str | None:
+        """Address of the JAX profiler server (TPU-native tracing endpoint)."""
+        client = reservation.Client(
+            tuple(self.cluster_meta["server_addr"]), self.cluster_meta["auth_token"]
+        )
+        try:
+            return client.get("profiler_address", timeout=timeout)
+        except KeyError:
+            return None
+
+    def _check_bootstrap_error(self) -> None:
+        if self._thread_error:
+            raise RuntimeError(
+                "cluster bootstrap/training job failed"
+            ) from self._thread_error[0]
+
+
+def run(
+    sc,
+    map_fun: Callable,
+    tf_args: Any = None,
+    num_executors: int | None = None,
+    num_ps: int = 0,
+    tensorboard: bool = False,
+    input_mode: InputMode = InputMode.SPARK,
+    log_dir: str | None = None,
+    driver_ps_nodes: bool = False,
+    master_node: str | None = None,
+    reservation_timeout: float = 600.0,
+    queues: list[str] | None = None,
+    eval_node: bool = False,
+    num_chips_per_executor: int | None = None,
+    feed_chunk: int = 256,
+    default_fs: str | None = None,
+) -> TFCluster:
+    """Launch the accelerator cluster on Spark executors.
+
+    Reference anchor: ``TFCluster.py::run`` — same signature shape.  Notes on
+    reference params with no TPU meaning:
+
+    - ``num_ps`` / ``driver_ps_nodes``: there are no parameter servers on a
+      TPU pod.  All ``num_executors`` nodes train; ``num_ps > 0`` is recorded
+      on the node context (``ctx.num_ps``) where model code treats it as a
+      request for ZeRO-style sharded optimizer state
+      (``tensorflowonspark_tpu.parallel``).  A warning documents the mapping.
+    - ``master_node`` names the chief job (e.g. ``"chief"``); executor 0
+      takes that role.  ``eval_node=True`` makes the last executor an
+      ``evaluator`` (excluded from the training mesh).
+    """
+    if num_executors is None:
+        num_executors = getattr(sc, "defaultParallelism", 1)
+    local_execs = getattr(sc, "num_executors", None)
+    if local_execs is not None and num_executors != local_execs:
+        raise ValueError(
+            f"num_executors={num_executors} must equal the local substrate's "
+            f"executor count ({local_execs}) so every data partition lands on "
+            "an executor that hosts a cluster node"
+        )
+    if num_ps > 0:
+        logger.warning(
+            "num_ps=%d requested: TPU pods have no parameter servers; all %d "
+            "executors will train and optimizer state will be sharded "
+            "ZeRO-style across the data-parallel mesh axis instead "
+            "(ctx.num_ps is set for model code)",
+            num_ps, num_executors,
+        )
+    if driver_ps_nodes:
+        logger.warning("driver_ps_nodes is ignored on TPU (no parameter servers)")
+
+    # role template (reference: cluster_template computation in TFCluster.run)
+    cluster_template: dict[int, tuple[str, int]] = {}
+    worker_idx = 0
+    for eid in range(num_executors):
+        if eval_node and eid == num_executors - 1:
+            cluster_template[eid] = ("evaluator", 0)
+        elif master_node and eid == 0:
+            cluster_template[eid] = (master_node, 0)
+        else:
+            cluster_template[eid] = ("worker", worker_idx)
+            worker_idx += 1
+
+    server = reservation.Server(num_executors)
+    server_addr = server.start()
+
+    if num_chips_per_executor is None:
+        from tensorflowonspark_tpu import chip_info
+
+        num_chips_per_executor = chip_info.get_num_host_chips()
+
+    cluster_meta = {
+        "id": uuid.uuid4().hex[:12],
+        "num_executors": num_executors,
+        "server_addr": list(server_addr),
+        "auth_token": server.auth_token,
+        "authkey_hex": secrets.token_hex(16),
+        "cluster_template": cluster_template,
+        "input_mode": "spark" if input_mode is InputMode.SPARK else "tensorflow",
+        "queues": queues or ["input", "output", "error"],
+        "num_chips": num_chips_per_executor,
+        "num_ps": num_ps,
+        "feed_chunk": feed_chunk,
+        "default_fs": default_fs or "file://",
+        "reservation_timeout": reservation_timeout,
+    }
+
+    node_fn = TFSparkNode.run(map_fun, tf_args, cluster_meta, tensorboard, log_dir)
+    cluster_holder: dict[str, Any] = {}
+    thread_error: list[BaseException] = []
+
+    def _bootstrap_job():
+        try:
+            sc.parallelize(range(num_executors), num_executors).foreachPartition(
+                node_fn
+            )
+        except BaseException as e:  # surfaced via _check_bootstrap_error
+            logger.error("cluster bootstrap job failed: %s", e)
+            thread_error.append(e)
+
+    t = threading.Thread(target=_bootstrap_job, name="tfos-bootstrap", daemon=True)
+    t.start()
+
+    # wait in short chunks so a fast bootstrap failure (chip exhaustion,
+    # collision guard, …) surfaces immediately instead of after the timeout
+    import time as _time
+
+    deadline = _time.monotonic() + reservation_timeout
+    while True:
+        if thread_error:
+            server.stop()
+            raise RuntimeError("cluster bootstrap failed") from thread_error[0]
+        remaining = deadline - _time.monotonic()
+        if remaining <= 0:
+            server.stop()
+            raise TimeoutError(
+                f"timed out after {reservation_timeout}s waiting for "
+                f"{server.reservations.remaining()} of {num_executors} nodes"
+            )
+        try:
+            cluster_info = server.await_reservations(timeout=min(1.0, remaining))
+            break
+        except TimeoutError:
+            continue
+    logger.info("cluster formed: %d nodes", len(cluster_info))
+
+    cluster = TFCluster(sc, cluster_meta, cluster_info, server, input_mode, t)
+    cluster._thread_error = thread_error
+    return cluster

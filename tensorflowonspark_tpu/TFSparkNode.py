@@ -1,0 +1,545 @@
+"""Per-executor node runtime: bootstrap, feed, inference, shutdown closures.
+
+Reference anchor: ``tensorflowonspark/TFSparkNode.py`` (``run``, ``train``,
+``inference``, ``shutdown``, ``TFNodeContext``, ``_get_manager``).
+
+Driver-side factories (:func:`run`, :func:`train`, :func:`inference`,
+:func:`shutdown`) return picklable callables executed on Spark executors.
+The bootstrap callable forms the accelerator cluster; the others are the
+SPARK-input-mode data plane.
+
+TPU-first deltas from the reference (``SURVEY.md §1/§3``):
+
+- GPU allocation (``CUDA_VISIBLE_DEVICES``) → atomic chip claiming +
+  ``TPU_VISIBLE_CHIPS`` pinning *before* JAX initialises
+  (:mod:`tensorflowonspark_tpu.chip_info`).
+- ``TF_CONFIG`` + TF grpc servers → rendezvous-seeded
+  ``jax.distributed.initialize`` (the coordinator address is published on
+  the rendezvous kv blackboard by executor 0).
+- Row-at-a-time queue feed → chunked feed (lists of rows per queue item),
+  consumed columnar by ``TFNode.DataFeed``.
+- Background trainer uses **spawn**, not fork: the executor may hold JAX
+  threads, and the context object reconnects its manager lazily so it
+  survives the spawn pickle.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue as _queue_mod
+import signal
+import time
+from typing import Any, Callable, Iterator
+
+from tensorflowonspark_tpu import TFManager, chip_info, marker, reservation, util
+
+logger = logging.getLogger(__name__)
+
+# Per-executor-process singleton managers, keyed by cluster id.  Reference
+# anchor: ``TFSparkNode.py::TFSparkNode.mgr``.  Without this reference the
+# BaseManager handle is garbage-collected when the bootstrap task returns,
+# and its finalizer SHUTS DOWN the manager server process — killing the data
+# plane before the first feed task arrives.
+_MGRS: dict[str, Any] = {}
+
+
+class TFNodeContext:
+    """Node context handed to the user's ``map_fun(tf_args, ctx)``.
+
+    Reference anchor: ``TFSparkNode.py::TFNodeContext`` (fields
+    ``executor_id/job_name/task_index/cluster_spec/defaultFS/working_dir/
+    mgr``).  Plain-data and picklable; ``mgr`` reconnects lazily in whichever
+    process touches it (the reference's eager handle broke across forks).
+    """
+
+    def __init__(
+        self,
+        executor_id: int,
+        job_name: str,
+        task_index: int,
+        cluster_spec: dict[str, list[str]],
+        default_fs: str,
+        working_dir: str,
+        mgr_addr: tuple[str, int],
+        authkey: bytes,
+        cluster_info: list[dict[str, Any]],
+        cluster_id: str,
+        num_ps: int = 0,
+    ):
+        self.executor_id = executor_id
+        self.job_name = job_name
+        self.task_index = task_index
+        self.cluster_spec = cluster_spec
+        self.defaultFS = default_fs
+        self.working_dir = working_dir
+        self.mgr_addr = tuple(mgr_addr)
+        self.authkey = authkey
+        self.cluster_info = cluster_info
+        self.cluster_id = cluster_id
+        self.num_ps = num_ps
+        self._mgr = None
+
+    @property
+    def num_workers(self) -> int:
+        return len(self.cluster_info)
+
+    @property
+    def mgr(self):
+        if self._mgr is None:
+            self._mgr = TFManager.connect(self.mgr_addr, self.authkey)
+        return self._mgr
+
+    def get_data_feed(
+        self,
+        train_mode: bool = True,
+        qname_in: str = "input",
+        qname_out: str = "output",
+        input_mapping=None,
+    ):
+        """Build a :class:`tensorflowonspark_tpu.TFNode.DataFeed` for this node."""
+        from tensorflowonspark_tpu.TFNode import DataFeed
+
+        return DataFeed(self.mgr, train_mode, qname_in, qname_out, input_mapping)
+
+    def absolute_path(self, path: str) -> str:
+        """Reference anchor: ``TFNode.py::hdfs_path`` (ctx method form)."""
+        from tensorflowonspark_tpu.TFNode import hdfs_path
+
+        return hdfs_path(self, path)
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_mgr"] = None  # manager proxies don't survive pickling
+        return state
+
+
+def _guard_name(cluster_id: str) -> str:
+    return f"executor_id_{cluster_id}"
+
+
+def _resolve_node(cluster_info, cluster_id) -> dict[str, Any]:
+    """Find the cluster node co-located with the current task's executor.
+
+    Reference anchor: ``TFSparkNode.py::_get_manager`` — match by the
+    executor-id file the bootstrap task wrote into this executor's cwd.
+    """
+    eid = util.read_executor_id(name=_guard_name(cluster_id))
+    if eid is None:
+        raise RuntimeError(
+            "no cluster node bootstrapped on this executor (executor_id file "
+            f"missing for cluster {cluster_id}); was TFCluster.run started with "
+            "as many partitions as executors?"
+        )
+    for meta in cluster_info:
+        if meta["executor_id"] == eid:
+            return meta
+    raise RuntimeError(f"executor_id {eid} not present in cluster_info")
+
+
+def _connect_mgr(node_meta: dict[str, Any], authkey: bytes):
+    return TFManager.connect(tuple(node_meta["addr"]), authkey)
+
+
+def _raise_worker_error(mgr) -> None:
+    """If the trainer pushed an error, re-raise it on the Spark side."""
+    equeue = mgr.get_queue("error")
+    try:
+        err = equeue.get(block=False)
+    except _queue_mod.Empty:
+        return
+    raise RuntimeError(f"exception in worker map_fun:\n{err}")
+
+
+def _background_main(fn_blob: bytes, args_blob: bytes, ctx: TFNodeContext) -> None:
+    """Entry point of the spawned trainer process (SPARK input mode)."""
+    import cloudpickle
+
+    util.ensure_jax_platform()
+    mgr = ctx.mgr
+    mgr.set("trainer_pid", os.getpid())
+    mgr.set("state", "running")
+    try:
+        fn = cloudpickle.loads(fn_blob)
+        tf_args = cloudpickle.loads(args_blob)
+        fn(tf_args, ctx)
+        mgr.set("state", "finished")
+    except BaseException:
+        import traceback
+
+        tb = traceback.format_exc()
+        logger.error("map_fun failed on executor %s:\n%s", ctx.executor_id, tb)
+        try:
+            mgr.get_queue("error").put(tb)
+            mgr.set("state", "failed")
+        except Exception:
+            pass
+        raise
+
+
+class _MapFn:
+    """Cluster-bootstrap task body (one per executor).
+
+    Reference anchor: ``TFSparkNode.py::run`` → ``_mapfn``.
+    """
+
+    def __init__(self, fn_blob, args_blob, cluster_meta, tensorboard, log_dir):
+        self.fn_blob = fn_blob
+        self.args_blob = args_blob
+        self.meta = cluster_meta
+        self.tensorboard = tensorboard
+        self.log_dir = log_dir
+
+    def __call__(self, iterator: Iterator) -> None:
+        meta = self.meta
+        cluster_id = meta["id"]
+        part = list(iterator)
+        if not part:
+            raise RuntimeError("bootstrap partition was empty — need one element "
+                               "per partition (sc.parallelize(range(n), n))")
+        executor_id = int(part[0])
+
+        # collision guard (reference: util.write_executor_id + cross-check)
+        existing = util.read_executor_id(name=_guard_name(cluster_id))
+        if existing is not None:
+            raise RuntimeError(
+                f"executor already hosts node {existing} of cluster {cluster_id}; "
+                "two bootstrap tasks landed on one executor (Spark re-scheduling?)"
+            )
+        util.write_executor_id(executor_id, name=_guard_name(cluster_id))
+
+        # chip pinning before any JAX init (reference: gpu_info.get_gpus →
+        # CUDA_VISIBLE_DEVICES)
+        chips = []
+        if meta.get("num_chips", 0) > 0:
+            chips = chip_info.claim_chips(
+                meta["num_chips"], cluster_id, f"executor_{executor_id}"
+            )
+            chip_info.set_visibility_env(chips)
+
+        # data-plane manager: loopback for SPARK mode, routable for
+        # TENSORFLOW mode (reference: TFManager.start local/remote)
+        mode = "local" if meta["input_mode"] == "spark" else "remote"
+        authkey = bytes.fromhex(meta["authkey_hex"])
+        mgr = TFManager.start(authkey, meta["queues"], mode=mode)
+        _MGRS[cluster_id] = mgr  # keep the server alive past this task
+        mgr.set("state", "bootstrapping")
+
+        host, port = util.find_free_port()
+        job_name, task_index = meta["cluster_template"].get(
+            executor_id, ("worker", executor_id)
+        )
+        node_meta = {
+            "executor_id": executor_id,
+            "host": host,
+            "port": port,
+            "job_name": job_name,
+            "task_index": task_index,
+            "addr": list(mgr.address),
+            "pid": os.getpid(),
+            "chips": chips,
+        }
+
+        client = reservation.Client(tuple(meta["server_addr"]), meta["auth_token"])
+        # executor 0 publishes the jax.distributed coordinator address before
+        # registering, so every node can read it after the barrier
+        if executor_id == 0:
+            client.put("jax_coordinator", f"{host}:{port}")
+        client.register(node_meta)
+        cluster_info = client.await_reservations(
+            timeout=meta.get("reservation_timeout", 600.0)
+        )
+
+        cluster_spec: dict[str, list[str]] = {}
+        for m in cluster_info:
+            cluster_spec.setdefault(m["job_name"], []).append(
+                f"{m['host']}:{m['port']}"
+            )
+
+        ctx = TFNodeContext(
+            executor_id=executor_id,
+            job_name=job_name,
+            task_index=task_index,
+            cluster_spec=cluster_spec,
+            default_fs=meta.get("default_fs", "file://"),
+            working_dir=os.getcwd(),
+            mgr_addr=mgr.address,
+            authkey=authkey,
+            cluster_info=cluster_info,
+            cluster_id=cluster_id,
+            num_ps=meta.get("num_ps", 0),
+        )
+
+        if self.tensorboard and job_name in ("chief", "worker") and task_index == 0:
+            self._start_tensorboard(client, ctx)
+
+        if meta["input_mode"] == "spark":
+            import multiprocessing
+
+            mp = multiprocessing.get_context("spawn")
+            p = mp.Process(
+                target=_background_main,
+                args=(self.fn_blob, self.args_blob, ctx),
+                name=f"tfos-trainer-{executor_id}",
+                daemon=True,
+            )
+            p.start()
+            logger.info(
+                "executor %s: trainer started in background pid %s", executor_id, p.pid
+            )
+            # bootstrap task returns; the executor is free for feed tasks
+        else:
+            import cloudpickle
+
+            util.ensure_jax_platform()
+            mgr.set("state", "running")
+            mgr.set("trainer_pid", os.getpid())
+            fn = cloudpickle.loads(self.fn_blob)
+            tf_args = cloudpickle.loads(self.args_blob)
+            try:
+                fn(tf_args, ctx)
+                mgr.set("state", "finished")
+            except BaseException:
+                import traceback
+
+                mgr.get_queue("error").put(traceback.format_exc())
+                mgr.set("state", "failed")
+                raise
+
+    def _start_tensorboard(self, client, ctx) -> None:
+        """Profiler endpoint + TensorBoard (when the binary exists).
+
+        Reference anchor: ``TFSparkNode.py::_mapfn`` tensorboard branch.  TPU
+        twist: always start ``jax.profiler.start_server`` so profiles can be
+        captured remotely; additionally spawn the ``tensorboard`` CLI if
+        installed, publishing its URL on the kv blackboard (reference used
+        the TFManager kv — see ``TFCluster.py::tensorboard_url``).
+        """
+        try:
+            util.ensure_jax_platform()
+            import jax
+
+            _, prof_port = util.find_free_port()
+            jax.profiler.start_server(prof_port)
+            client.put("profiler_address", f"{ctx.cluster_info[0]['host']}:{prof_port}")
+        except Exception as e:  # profiling is best-effort
+            logger.warning("could not start jax profiler server: %s", e)
+        tb_bin = util.find_in_path(os.environ.get("PATH", ""), "tensorboard")
+        if tb_bin:
+            import subprocess
+
+            host, tb_port = util.find_free_port()
+            logdir = self.log_dir or os.path.join(os.getcwd(), "tensorboard_logs")
+            subprocess.Popen(
+                [tb_bin, f"--logdir={logdir}", f"--port={tb_port}", "--bind_all"],
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+            client.put("tensorboard_url", f"http://{host}:{tb_port}")
+        else:
+            logger.info("tensorboard binary not found; profiler server only")
+
+
+class _TrainFn:
+    """Feed one RDD partition into the co-located node's input queue.
+
+    Reference anchor: ``TFSparkNode.py::train``.  Ships chunks, not rows.
+    """
+
+    def __init__(self, cluster_info, cluster_meta, feed_timeout, qname):
+        self.cluster_info = cluster_info
+        self.meta = cluster_meta
+        self.feed_timeout = feed_timeout
+        self.qname = qname
+
+    def __call__(self, iterator: Iterator) -> None:
+        node = _resolve_node(self.cluster_info, self.meta["id"])
+        mgr = _connect_mgr(node, bytes.fromhex(self.meta["authkey_hex"]))
+        _raise_worker_error(mgr)
+        state = mgr.get("state")
+        if state in ("terminating", "finished", "failed"):
+            logger.info("node state %s: discarding partition", state)
+            for _ in iterator:
+                pass
+            _raise_worker_error(mgr)
+            return
+        q = mgr.get_queue(self.qname)
+        chunk_size = self.meta.get("feed_chunk", 256)
+        deadline = time.monotonic() + self.feed_timeout
+        chunk: list[Any] = []
+        try:
+            for row in iterator:
+                chunk.append(row)
+                if len(chunk) >= chunk_size:
+                    self._put(q, chunk, deadline)
+                    chunk = []
+            if chunk:
+                self._put(q, chunk, deadline)
+            self._put(q, marker.EndPartition(), deadline)
+        except _queue_mod.Full:
+            raise RuntimeError(
+                f"feed timed out after {self.feed_timeout}s: trainer not "
+                "consuming (hung or finished?)"
+            ) from None
+        # wait for consumption so Spark doesn't consider the epoch done while
+        # data is still queued (reference used queue.join())
+        while True:
+            if q.qsize() == 0:
+                return
+            if mgr.get("state") in ("terminating", "finished", "failed"):
+                _raise_worker_error(mgr)
+                return
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"feed timed out after {self.feed_timeout}s waiting for "
+                    f"{q.qsize()} queued chunks to be consumed"
+                )
+            time.sleep(0.05)
+
+    def _put(self, q, item, deadline) -> None:
+        timeout = max(0.0, deadline - time.monotonic())
+        q.put(item, block=True, timeout=timeout)
+
+
+class _InferenceFn:
+    """Push one partition through the node and yield its predictions.
+
+    Reference anchor: ``TFSparkNode.py::inference``.
+    """
+
+    def __init__(self, cluster_info, cluster_meta, qname_in, qname_out, timeout):
+        self.cluster_info = cluster_info
+        self.meta = cluster_meta
+        self.qname_in = qname_in
+        self.qname_out = qname_out
+        self.timeout = timeout
+
+    def __call__(self, iterator: Iterator):
+        node = _resolve_node(self.cluster_info, self.meta["id"])
+        mgr = _connect_mgr(node, bytes.fromhex(self.meta["authkey_hex"]))
+        _raise_worker_error(mgr)
+        qin = mgr.get_queue(self.qname_in)
+        qout = mgr.get_queue(self.qname_out)
+        chunk_size = self.meta.get("feed_chunk", 256)
+        deadline = time.monotonic() + self.timeout
+
+        count = 0
+        chunk: list[Any] = []
+        try:
+            for row in iterator:
+                chunk.append(row)
+                count += 1
+                if len(chunk) >= chunk_size:
+                    qin.put(chunk, timeout=max(0.0, deadline - time.monotonic()))
+                    chunk = []
+            if chunk:
+                qin.put(chunk, timeout=max(0.0, deadline - time.monotonic()))
+            qin.put(
+                marker.EndPartition(), timeout=max(0.0, deadline - time.monotonic())
+            )
+        except _queue_mod.Full:
+            _raise_worker_error(mgr)
+            raise RuntimeError(
+                f"inference feed timed out after {self.timeout}s: trainer not "
+                "consuming (hung or finished?)"
+            ) from None
+
+        results: list[Any] = []
+        while len(results) < count:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise RuntimeError(
+                    f"inference timed out: got {len(results)} of {count} results"
+                )
+            try:
+                batch = qout.get(timeout=min(1.0, remaining))
+            except _queue_mod.Empty:
+                _raise_worker_error(mgr)
+                continue
+            results.extend(batch if isinstance(batch, list) else [batch])
+        if len(results) != count:
+            raise RuntimeError(
+                f"inference produced {len(results)} results for {count} inputs"
+            )
+        return results
+
+
+class _ShutdownFn:
+    """Stop the co-located node and surface trainer errors.
+
+    Reference anchor: ``TFSparkNode.py::shutdown``.
+    """
+
+    def __init__(self, cluster_info, cluster_meta, grace_secs, qname):
+        self.cluster_info = cluster_info
+        self.meta = cluster_meta
+        self.grace_secs = grace_secs
+        self.qname = qname
+
+    def __call__(self, iterator: Iterator) -> None:
+        list(iterator)  # consume the placeholder partition element
+        node = _resolve_node(self.cluster_info, self.meta["id"])
+        mgr = _connect_mgr(node, bytes.fromhex(self.meta["authkey_hex"]))
+        state = mgr.get("state")
+        if state in ("finished", "failed"):
+            _raise_worker_error(mgr)
+            return
+        mgr.set("state", "terminating")
+        try:
+            # bounded put: a wedged trainer leaves the queue full, and a
+            # blocking put here would hang shutdown forever, never reaching
+            # the kill path below
+            mgr.get_queue(self.qname).put(
+                marker.StopFeed(), timeout=max(1.0, self.grace_secs)
+            )
+        except _queue_mod.Full:
+            logger.warning("input queue full; trainer not consuming — will kill")
+        deadline = time.monotonic() + max(1.0, self.grace_secs)
+        while time.monotonic() < deadline:
+            if mgr.get("state") in ("finished", "failed"):
+                break
+            time.sleep(0.1)
+        else:
+            pid = mgr.get("trainer_pid")
+            logger.warning(
+                "trainer (pid %s) did not stop within %ss; killing", pid, self.grace_secs
+            )
+            if pid:
+                try:
+                    os.kill(int(pid), signal.SIGKILL)
+                except OSError:
+                    pass
+            _raise_worker_error(mgr)
+            raise RuntimeError(
+                f"trainer on executor {node['executor_id']} did not shut down "
+                f"within grace period ({self.grace_secs}s) and was killed"
+            )
+        _raise_worker_error(mgr)
+
+
+# -- public factories (reference-parity signatures) -------------------------
+
+
+def run(fn: Callable, tf_args: Any, cluster_meta: dict, tensorboard: bool = False,
+        log_dir: str | None = None) -> _MapFn:
+    import cloudpickle
+
+    return _MapFn(
+        cloudpickle.dumps(fn), cloudpickle.dumps(tf_args), cluster_meta,
+        tensorboard, log_dir,
+    )
+
+
+def train(cluster_info, cluster_meta, feed_timeout: float = 600.0,
+          qname: str = "input") -> _TrainFn:
+    return _TrainFn(cluster_info, cluster_meta, feed_timeout, qname)
+
+
+def inference(cluster_info, cluster_meta, qname_in: str = "input",
+              qname_out: str = "output", timeout: float = 600.0) -> _InferenceFn:
+    return _InferenceFn(cluster_info, cluster_meta, qname_in, qname_out, timeout)
+
+
+def shutdown(cluster_info, cluster_meta, grace_secs: float = 30.0,
+             qname: str = "input") -> _ShutdownFn:
+    return _ShutdownFn(cluster_info, cluster_meta, grace_secs, qname)

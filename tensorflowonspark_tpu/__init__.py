@@ -18,11 +18,6 @@ Public surface mirrors the reference package:
   (``run/train/inference/shutdown``), ``InputMode``.
 - :mod:`tensorflowonspark_tpu.TFNode` — in-``map_fun`` helpers
   (``DataFeed``, ``hdfs_path``, ``start_cluster_server``).
-- :mod:`tensorflowonspark_tpu.pipeline` — Spark-ML style
-  ``TFEstimator``/``TFModel``.
-- :mod:`tensorflowonspark_tpu.dfutil` — DataFrame ↔ TFRecord conversion.
-- :mod:`tensorflowonspark_tpu.TFParallel` — embarrassingly-parallel
-  single-node execution.
 """
 
 __version__ = "0.1.0"

@@ -42,6 +42,8 @@ def _canonical(path: str) -> str:
 def save_pytree(state: Any, path: str) -> str:
     """Save a pytree (params/opt-state/step, arbitrary nesting) to ``path``."""
     path = _canonical(path)
+    if "://" not in path:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
     _checkpointer().save(path, state, force=True)
     logger.info("saved checkpoint to %s", path)
     return path

@@ -27,3 +27,14 @@ class EndPartition(Marker):
 
     def __repr__(self) -> str:  # pragma: no cover - trivial
         return "<EndPartition>"
+
+
+class StopFeed(Marker):
+    """Sentinel ending the feed entirely — ``DataFeed.should_stop`` becomes
+    True once consumed.  The reference signalled this with a bare ``Marker``
+    put by ``TFSparkNode.py::shutdown``; a distinct type is unambiguous."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # pragma: no cover - trivial
+        return "<StopFeed>"

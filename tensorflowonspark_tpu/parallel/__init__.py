@@ -1,0 +1,12 @@
+"""Parallelism: device meshes, sharding strategies, distributed runtime.
+
+The TPU-native replacement for the reference's three tensor-plane mechanisms
+(``SURVEY.md §2.3``): TF distributed runtime (gRPC), ``grpc+verbs`` RDMA, and
+NCCL ring-allreduce inside ``MultiWorkerMirroredStrategy`` all collapse into
+XLA collectives emitted by ``pjit``/``shard_map`` over a
+``jax.sharding.Mesh`` — ``psum`` over ICI within a slice, DCN across slices.
+"""
+
+from tensorflowonspark_tpu.parallel.distributed import (  # noqa: F401
+    maybe_initialize,
+)

@@ -1,0 +1,80 @@
+"""Multi-host JAX runtime initialisation, seeded by the rendezvous barrier.
+
+Reference anchor: the reference wires ``TF_CONFIG`` + ``tf.train.Server``
+(``TFSparkNode.py::_mapfn``, ``TFNode.py::start_cluster_server``) so TF's
+gRPC runtime can form a cluster.  The TPU equivalent is
+``jax.distributed.initialize(coordinator_address, num_processes,
+process_id)``: afterwards ``jax.devices()`` spans every host's chips and XLA
+collectives ride ICI/DCN.
+
+The coordinator is the node with ``executor_id == 0`` — its rendezvous
+``host:port`` (a port reserved during bootstrap) doubles as the coordination
+service address, so no extra configuration is needed beyond the cluster_info
+every node already holds.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+# Set TFOS_JAX_DISTRIBUTED=0 to force single-process JAX even in a multi-node
+# cluster (each node then sees only its own chips — the reference's
+# "between-graph, no collectives" shape). Default: initialise when the
+# cluster has more than one node and real accelerators are present.
+DISTRIBUTED_ENV = "TFOS_JAX_DISTRIBUTED"
+
+_initialized = False
+
+
+def coordinator_address(cluster_info) -> str:
+    node0 = next(m for m in cluster_info if m["executor_id"] == 0)
+    return f"{node0['host']}:{node0['port']}"
+
+
+def maybe_initialize(ctx) -> bool:
+    """Initialise ``jax.distributed`` for this node if appropriate.
+
+    Returns True when the distributed runtime was (already) initialised.
+    No-op for single-node clusters, when ``TFOS_JAX_DISTRIBUTED=0``, or when
+    no accelerator chips are present (CPU test topology — cross-process CPU
+    collectives are not part of the test contract; multi-chip behavior is
+    validated on a virtual in-process mesh instead, ``SURVEY.md §4``).
+    """
+    global _initialized
+    if _initialized:
+        return True
+    flag = os.environ.get(DISTRIBUTED_ENV, "auto")
+    if flag == "0":
+        return False
+    num_nodes = ctx.num_workers
+    if num_nodes <= 1:
+        return False
+    from tensorflowonspark_tpu import chip_info
+
+    if flag != "1" and chip_info.get_num_host_chips() == 0:
+        logger.info(
+            "multi-node cluster on chip-less hosts: skipping "
+            "jax.distributed.initialize (set %s=1 to force)", DISTRIBUTED_ENV,
+        )
+        return False
+
+    from tensorflowonspark_tpu import util
+
+    util.ensure_jax_platform()
+    import jax
+
+    addr = coordinator_address(ctx.cluster_info)
+    logger.info(
+        "jax.distributed.initialize(coordinator=%s, num_processes=%d, "
+        "process_id=%d)", addr, num_nodes, ctx.executor_id,
+    )
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=num_nodes,
+        process_id=ctx.executor_id,
+    )
+    _initialized = True
+    return True

@@ -103,15 +103,23 @@ class LocalSparkContext:
 
         for i in range(n):
             tq = self._mp.Queue()
+            # NOT daemonic: executors must be able to spawn children (the
+            # per-executor TFManager server and the background trainer);
+            # daemonic processes are forbidden children.  Cleanup is explicit
+            # in stop() plus an atexit hook for abandoned contexts.
             p = self._mp.Process(
                 target=executor_main,
                 args=(i, self.applicationId, tq, self._result_queue),
                 name=f"tfos-executor-{i}",
-                daemon=True,
+                daemon=False,
             )
             p.start()
             self._task_queues.append(tq)
             self._procs.append(p)
+
+        import atexit
+
+        atexit.register(self.stop)
 
         self._router = threading.Thread(
             target=self._route_results, name="tfos-result-router", daemon=True

@@ -18,6 +18,8 @@ import traceback
 
 
 def executor_main(executor_id: int, app_id: str, task_queue, result_queue) -> None:
+    import queue as queue_mod
+
     import cloudpickle
 
     from tensorflowonspark_tpu import util
@@ -27,9 +29,18 @@ def executor_main(executor_id: int, app_id: str, task_queue, result_queue) -> No
     os.chdir(wd)
     os.environ["TFOS_EXECUTOR_ID"] = str(executor_id)
     os.environ["TFOS_APP_ID"] = app_id
+    driver_pid = os.getppid()
 
     while True:
-        item = task_queue.get()
+        try:
+            item = task_queue.get(timeout=5.0)
+        except queue_mod.Empty:
+            # executors are non-daemonic (they must spawn the manager and
+            # trainer); if the driver died without running stop()/atexit
+            # (SIGKILL, os._exit), exit instead of lingering forever
+            if os.getppid() != driver_pid:
+                break
+            continue
         if item is None:
             break
         job_id, task_id, pindex, data_blob, chain_blob = item

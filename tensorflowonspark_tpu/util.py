@@ -96,26 +96,32 @@ def single_node_scratch_dir(app_id: str) -> str:
     return d
 
 
-def _executor_id_file(dir_path: str | None = None) -> str:
-    return os.path.join(dir_path or os.getcwd(), "executor_id")
+def _executor_id_file(dir_path: str | None = None, name: str = "executor_id") -> str:
+    return os.path.join(dir_path or os.getcwd(), name)
 
 
-def write_executor_id(num: int, dir_path: str | None = None) -> None:
+def write_executor_id(
+    num: int, dir_path: str | None = None, name: str = "executor_id"
+) -> None:
     """Record this executor's cluster node id in its working directory.
 
     Reference anchor: ``tensorflowonspark/util.py::write_executor_id``.  Used
     as a collision guard: if Spark schedules two cluster-bootstrap tasks onto
     the same executor, the second one sees an existing id file and fails fast
-    instead of silently forming a malformed cluster.
+    instead of silently forming a malformed cluster.  ``name`` lets callers
+    scope the guard per cluster instance (e.g. ``executor_id_<cluster_id>``)
+    so sequential clusters on one SparkContext don't trip over stale files.
     """
-    with open(_executor_id_file(dir_path), "w", encoding="utf-8") as f:
+    with open(_executor_id_file(dir_path, name), "w", encoding="utf-8") as f:
         f.write(str(num))
 
 
-def read_executor_id(dir_path: str | None = None) -> int | None:
+def read_executor_id(
+    dir_path: str | None = None, name: str = "executor_id"
+) -> int | None:
     """Read the executor id written by :func:`write_executor_id`, if any."""
     try:
-        with open(_executor_id_file(dir_path), encoding="utf-8") as f:
+        with open(_executor_id_file(dir_path, name), encoding="utf-8") as f:
             return int(f.read())
     except OSError as e:
         if e.errno in (errno.ENOENT,):

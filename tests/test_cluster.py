@@ -1,0 +1,164 @@
+"""End-to-end cluster tests on the local substrate (SURVEY.md §4: the
+local-cluster trick — real processes, real rendezvous, real queues, JAX on
+the CPU backend)."""
+
+import sys
+import time
+
+import cloudpickle
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import TFCluster, TFManager
+from tensorflowonspark_tpu.sparkapi import LocalSparkContext
+
+# ship this test module by value so spawned executors/trainers don't need to
+# import it by name
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture()
+def sc():
+    ctx = LocalSparkContext("local-cluster[2,1,1024]", "cluster-test")
+    yield ctx
+    ctx.stop()
+
+
+def _make_regression_data(n=512, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    w_true = np.array([2.0, -1.0, 0.5, 3.0], dtype=np.float32)
+    y = x @ w_true + 1.0
+    return [(x[i], float(y[i])) for i in range(n)]
+
+
+def linear_train_fun(args, ctx):
+    """Train y = w·x + b by SGD from the Spark feed; record final loss."""
+    from tensorflowonspark_tpu import util
+
+    util.ensure_jax_platform()
+    import jax
+    import jax.numpy as jnp
+
+    feed = ctx.get_data_feed(train_mode=True, input_mapping=["x", "y"])
+
+    @jax.jit
+    def step(w, b, x, y):
+        def loss_fn(w, b):
+            pred = x @ w + b
+            return jnp.mean((pred - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(w, b)
+        return w - 0.1 * grads[0], b - 0.1 * grads[1], loss
+
+    w = jnp.zeros(4)
+    b = jnp.asarray(0.0)
+    loss = None
+    while not feed.should_stop():
+        batch = feed.next_batch(64)
+        if not batch or batch["x"].shape[0] == 0:
+            continue
+        w, b, loss = step(w, b, batch["x"], batch["y"])
+    ctx.mgr.set("final_loss", float(loss))
+    ctx.mgr.set("final_w", np.asarray(w).tolist())
+
+
+def predict_fun(args, ctx):
+    """Inference map_fun: doubles each input value."""
+    from tensorflowonspark_tpu import util
+
+    util.ensure_jax_platform()
+    import jax
+
+    feed = ctx.get_data_feed(train_mode=False, input_mapping=["x"])
+    double = jax.jit(lambda x: x * 2.0)
+    while not feed.should_stop():
+        batch = feed.next_batch(16)
+        if not batch or batch["x"].shape[0] == 0:
+            continue
+        feed.batch_results(np.asarray(double(batch["x"])).tolist())
+
+
+def failing_fun(args, ctx):
+    raise ValueError("synthetic map_fun failure")
+
+
+def tf_mode_fun(args, ctx):
+    """TENSORFLOW-mode map_fun: no Spark feed; reads own 'dataset'."""
+    ctx.mgr.set("ran_executor", ctx.executor_id)
+    ctx.mgr.set("job", f"{ctx.job_name}:{ctx.task_index}")
+
+
+def test_spark_mode_train_end_to_end(sc):
+    data = _make_regression_data()
+    cluster = TFCluster.run(sc, linear_train_fun, tf_args=None, num_executors=2,
+                            input_mode=TFCluster.InputMode.SPARK)
+    rdd = sc.parallelize(data, 2)
+    cluster.train(rdd, num_epochs=4, feed_timeout=120)
+    cluster.shutdown(grace_secs=30)
+
+    # read each node's final loss straight from its manager (same host)
+    authkey = bytes.fromhex(cluster.cluster_meta["authkey_hex"])
+    for meta in cluster.cluster_info:
+        mgr = TFManager.connect(tuple(meta["addr"]), authkey)
+        assert mgr.get("state") == "finished"
+        final_loss = mgr.get("final_loss")
+        assert final_loss is not None and final_loss < 1.0, (
+            f"executor {meta['executor_id']}: loss {final_loss}"
+        )
+        w = np.asarray(mgr.get("final_w"))
+        np.testing.assert_allclose(w, [2.0, -1.0, 0.5, 3.0], atol=0.5)
+
+
+def test_spark_mode_inference_round_trip(sc):
+    cluster = TFCluster.run(sc, predict_fun, tf_args=None, num_executors=2)
+    values = [(float(i),) for i in range(40)]
+    preds = cluster.inference(sc.parallelize(values, 4)).collect()
+    cluster.shutdown(grace_secs=30)
+    assert sorted(preds) == [2.0 * i for i in range(40)]
+
+
+def test_map_fun_error_propagates_to_driver(sc):
+    cluster = TFCluster.run(sc, failing_fun, tf_args=None, num_executors=2)
+    rdd = sc.parallelize([(1.0,)] * 16, 2)
+    with pytest.raises(RuntimeError, match="synthetic map_fun failure"):
+        # the error surfaces on feed (trainer already dead) or at shutdown
+        cluster.train(rdd, feed_timeout=30)
+        cluster.shutdown(grace_secs=10)
+    cluster.server.stop()
+
+
+def test_tensorflow_mode_runs_to_completion(sc):
+    cluster = TFCluster.run(sc, tf_mode_fun, tf_args=None, num_executors=2,
+                            input_mode=TFCluster.InputMode.TENSORFLOW,
+                            master_node="chief")
+    cluster.shutdown(grace_secs=30)
+    authkey = bytes.fromhex(cluster.cluster_meta["authkey_hex"])
+    jobs = set()
+    for meta in cluster.cluster_info:
+        mgr = TFManager.connect(tuple(meta["addr"]), authkey)
+        assert mgr.get("ran_executor") == meta["executor_id"]
+        jobs.add(mgr.get("job"))
+    assert jobs == {"chief:0", "worker:0"}
+
+
+def test_cluster_template_roles(sc):
+    cluster = TFCluster.run(sc, tf_mode_fun, tf_args=None, num_executors=2,
+                            input_mode=TFCluster.InputMode.TENSORFLOW,
+                            eval_node=True)
+    cluster.shutdown(grace_secs=30)
+    roles = {m["executor_id"]: m["job_name"] for m in cluster.cluster_info}
+    assert roles == {0: "worker", 1: "evaluator"}
+
+
+def test_num_executors_mismatch_rejected(sc):
+    with pytest.raises(ValueError, match="num_executors"):
+        TFCluster.run(sc, tf_mode_fun, tf_args=None, num_executors=5)
+
+
+def test_train_requires_spark_mode(sc):
+    cluster = TFCluster.run(sc, tf_mode_fun, tf_args=None, num_executors=2,
+                            input_mode=TFCluster.InputMode.TENSORFLOW)
+    with pytest.raises(RuntimeError, match="InputMode.SPARK"):
+        cluster.train(sc.parallelize([1], 1))
+    cluster.shutdown(grace_secs=30)

@@ -1,0 +1,119 @@
+"""Unit tests for TFNode.DataFeed and hdfs_path (fake manager, no Spark)."""
+
+import queue
+import types
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import marker
+from tensorflowonspark_tpu.TFNode import DataFeed, hdfs_path
+
+
+class FakeMgr:
+    def __init__(self):
+        self._queues = {"input": queue.Queue(), "output": queue.Queue()}
+        self._kv = {}
+
+    def get_queue(self, name):
+        return self._queues[name]
+
+    def get(self, k, default=None):
+        return self._kv.get(k, default)
+
+    def set(self, k, v):
+        self._kv[k] = v
+
+
+def test_next_batch_columnar_with_mapping():
+    mgr = FakeMgr()
+    mgr.get_queue("input").put([(np.ones(3), 1), (np.zeros(3), 0)])
+    mgr.get_queue("input").put([(np.full(3, 2.0), 1)])
+    feed = DataFeed(mgr, input_mapping=["x", "y"])
+    batch = feed.next_batch(3)
+    assert set(batch) == {"x", "y"}
+    assert batch["x"].shape == (3, 3)
+    np.testing.assert_array_equal(batch["y"], [1, 0, 1])
+
+
+def test_next_batch_short_at_end_partition():
+    mgr = FakeMgr()
+    mgr.get_queue("input").put([(1.0, 2.0)] * 5)
+    mgr.get_queue("input").put(marker.EndPartition())
+    feed = DataFeed(mgr, input_mapping=["a", "b"])
+    batch = feed.next_batch(10)
+    assert batch["a"].shape[0] == 5  # short batch at partition boundary
+    assert not feed.should_stop()
+
+
+def test_stop_feed_sets_should_stop():
+    mgr = FakeMgr()
+    mgr.get_queue("input").put([(1,)])
+    mgr.get_queue("input").put(marker.StopFeed())
+    feed = DataFeed(mgr, input_mapping=["v"])
+    batch = feed.next_batch(8)
+    assert batch["v"].shape[0] == 1
+    assert feed.should_stop()
+    assert feed.next_batch(8) == {}  # drained
+
+
+def test_scalar_rows_without_mapping():
+    mgr = FakeMgr()
+    mgr.get_queue("input").put([1, 2, 3])
+    mgr.get_queue("input").put(marker.EndPartition())
+    feed = DataFeed(mgr)
+    cols = feed.next_batch(10)
+    assert isinstance(cols, list) and len(cols) == 1
+    np.testing.assert_array_equal(cols[0], [1, 2, 3])
+
+
+def test_mapping_arity_mismatch_raises():
+    mgr = FakeMgr()
+    mgr.get_queue("input").put([(1, 2, 3)])
+    feed = DataFeed(mgr, input_mapping=["a", "b"])
+    with pytest.raises(ValueError, match="input_mapping"):
+        feed.next_batch(1)
+
+
+def test_batch_results_chunked():
+    mgr = FakeMgr()
+    feed = DataFeed(mgr)
+    feed.batch_results([10, 20])
+    feed.batch_results([])  # empty batches are not enqueued
+    assert mgr.get_queue("output").get() == [10, 20]
+    assert mgr.get_queue("output").qsize() == 0
+
+
+def test_device_put_returns_jax_arrays():
+    import jax
+
+    mgr = FakeMgr()
+    mgr.get_queue("input").put([(np.ones(2), 0)])
+    mgr.get_queue("input").put(marker.EndPartition())
+    feed = DataFeed(mgr, input_mapping=["x", "y"])
+    batch = feed.next_batch(4, device_put=True)
+    assert isinstance(batch["x"], jax.Array)
+
+
+# -- hdfs_path (reference parity: test/test_TFNode.py) --
+
+
+def _ctx(default_fs="hdfs://nn:8020", working_dir="/user/me"):
+    return types.SimpleNamespace(defaultFS=default_fs, working_dir=working_dir)
+
+
+def test_hdfs_path_schemes_pass_through():
+    for p in ("hdfs://nn/x", "gs://b/x", "s3://b/x", "file:///x", "viewfs://y/x"):
+        assert hdfs_path(_ctx(), p) == p
+
+
+def test_hdfs_path_absolute():
+    assert hdfs_path(_ctx(), "/data/train") == "hdfs://nn:8020/data/train"
+
+
+def test_hdfs_path_relative():
+    assert hdfs_path(_ctx(), "mnist/csv") == "hdfs://nn:8020/user/me/mnist/csv"
+
+
+def test_hdfs_path_local_fs_relative():
+    assert hdfs_path(_ctx("file://", "/tmp/wd"), "model") == "/tmp/wd/model"
