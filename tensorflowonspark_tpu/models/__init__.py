@@ -1,0 +1,40 @@
+"""Model zoo mirroring the reference's ``examples/`` coverage, TPU-first.
+
+Reference anchor: ``examples/`` (mnist, cifar10, imagenet/inception+resnet,
+criteo wide&deep in the estimator era; see ``SURVEY.md §1 L6``).  The
+reference ships these as free-standing TF scripts; here they are library
+models (flax.linen) so the same definitions serve the examples, the
+pipeline API, the benchmarks, and the graft entry point.
+
+Every model module exposes the same surface:
+
+- ``Config`` dataclass (tiny test config via ``Config.tiny()``)
+- ``make_model(config, mesh=None)`` → flax module (mesh enables sp/ring
+  attention where it applies)
+- ``make_loss_fn(module, config)`` → ``loss(params, batch) -> scalar``
+- ``example_batch(config, batch_size, seed)`` → dict of numpy arrays
+- ``SEQUENCE_AXES`` → dict leaf-name → axis index sharded over ``sp``
+"""
+
+from __future__ import annotations
+
+import importlib
+
+_REGISTRY = {
+    "mnist_mlp": "tensorflowonspark_tpu.models.mnist",
+    "cifar10_cnn": "tensorflowonspark_tpu.models.cifar",
+    "resnet50": "tensorflowonspark_tpu.models.resnet",
+    "wide_deep": "tensorflowonspark_tpu.models.widedeep",
+    "bert": "tensorflowonspark_tpu.models.bert",
+}
+
+
+def get_model(name: str):
+    """Return the model module registered under ``name``."""
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; available: {sorted(_REGISTRY)}")
+    return importlib.import_module(_REGISTRY[name])
+
+
+def available() -> list[str]:
+    return sorted(_REGISTRY)

@@ -10,3 +10,24 @@ XLA collectives emitted by ``pjit``/``shard_map`` over a
 from tensorflowonspark_tpu.parallel.distributed import (  # noqa: F401
     maybe_initialize,
 )
+from tensorflowonspark_tpu.parallel.mesh import (  # noqa: F401
+    AXES,
+    MeshConfig,
+    batch_sharding,
+    batch_spec,
+    build_mesh,
+    infer_param_sharding,
+    logical_sharding,
+    named_sharding,
+    param_sharding_from_metadata,
+    replicated,
+    shard_batch,
+)
+from tensorflowonspark_tpu.parallel.train import (  # noqa: F401
+    TrainState,
+    apply_zero_sharding,
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+    state_shardings,
+)

@@ -1,0 +1,240 @@
+"""Device mesh construction and sharding rules — the TPU parallelism core.
+
+Reference anchor: the reference has **no** mesh concept — its only tensor
+plane is TF's gRPC/NCCL runtime selected per-strategy
+(``tensorflowonspark/TFNode.py::start_cluster_server``, ``TF_CONFIG`` in
+``TFSparkNode.py::_mapfn``; see ``SURVEY.md §2.3``).  The TPU-native design
+collapses every strategy (between-graph DP, MultiWorkerMirroredStrategy,
+parameter servers) into one mechanism: a ``jax.sharding.Mesh`` whose named
+axes carry
+
+- ``dp``  — data parallelism (batch axis; gradients allreduced by XLA),
+- ``fsdp``— ZeRO-style parameter/optimizer sharding (the ``num_ps`` mapping),
+- ``tp``  — tensor parallelism (feature axes of large matmuls),
+- ``sp``  — sequence/context parallelism (ring attention over ICI),
+- ``pp``  — pipeline parallelism (stage axis).
+
+``pjit``/``jax.jit`` with ``NamedSharding`` then emit the collectives
+(``psum``/``all_gather``/``reduce_scatter``/``ppermute``) over ICI/DCN —
+no NCCL, no gRPC tensor plane.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+from typing import Any, Sequence
+
+logger = logging.getLogger(__name__)
+
+# Canonical axis order.  dp outermost (rides DCN across slices if needed);
+# sp/tp innermost (highest-bandwidth ICI neighbours).
+AXES = ("dp", "fsdp", "pp", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes for each mesh axis; ``-1`` infers from the device count.
+
+    At most one axis may be ``-1``.  ``validate(n)`` checks the product
+    matches ``n`` devices.
+    """
+
+    dp: int = -1
+    fsdp: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def sizes(self) -> dict[str, int]:
+        return {a: getattr(self, a) for a in AXES}
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        sizes = self.sizes()
+        unknown = [a for a, s in sizes.items() if s == -1]
+        if len(unknown) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {unknown}")
+        known = math.prod(s for s in sizes.values() if s != -1)
+        if unknown:
+            if n_devices % known:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {known}"
+                )
+            sizes[unknown[0]] = n_devices // known
+        if math.prod(sizes.values()) != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {math.prod(sizes.values())} devices, "
+                f"have {n_devices}"
+            )
+        return MeshConfig(**sizes)
+
+
+def build_mesh(config: MeshConfig | None = None, devices: Sequence[Any] | None = None):
+    """Build a ``jax.sharding.Mesh`` over ``devices`` (default: all visible).
+
+    On real TPU slices ``mesh_utils.create_device_mesh`` lays axes out along
+    the physical ICI torus; on CPU test topologies a plain reshape is used.
+    """
+    import jax
+    import numpy as np
+
+    if devices is None:
+        devices = jax.devices()
+    config = (config or MeshConfig()).resolve(len(devices))
+    shape = tuple(config.sizes()[a] for a in AXES)
+    try:
+        from jax.experimental import mesh_utils
+
+        if devices[0].platform == "tpu":
+            dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
+        else:
+            raise ValueError  # CPU: fall through to reshape
+    except Exception:
+        dev_array = np.asarray(list(devices)).reshape(shape)
+    return jax.sharding.Mesh(dev_array, AXES)
+
+
+# -- sharding helpers --------------------------------------------------------
+
+
+def named_sharding(mesh, *spec):
+    import jax
+
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(*spec))
+
+
+def replicated(mesh):
+    return named_sharding(mesh)
+
+
+def batch_spec(ndim: int, sequence_axis: int | None = None):
+    """PartitionSpec for a data batch: axis 0 over (dp, fsdp), optionally a
+    sequence axis over sp.
+
+    fsdp participates in the batch split because ZeRO shards state *across
+    the data-parallel group* — dp and fsdp together form the data-parallel
+    world (scaling-book recipe), they differ only in how parameters are
+    stored.
+    """
+    import jax
+
+    spec: list[Any] = [None] * ndim
+    spec[0] = ("dp", "fsdp")
+    if sequence_axis is not None and ndim > sequence_axis:
+        spec[sequence_axis] = "sp"
+    return jax.sharding.PartitionSpec(*spec)
+
+
+def batch_sharding(mesh, ndim: int, sequence_axis: int | None = None):
+    import jax
+
+    return jax.sharding.NamedSharding(mesh, batch_spec(ndim, sequence_axis))
+
+
+def shard_batch(mesh, batch, sequence_axes: dict[str, int] | None = None):
+    """``device_put`` a host batch (pytree of arrays) onto the mesh.
+
+    ``sequence_axes`` optionally maps leaf path names (dict keys) to the axis
+    that should be sharded over ``sp``.
+    """
+    import jax
+
+    seq = sequence_axes or {}
+
+    def _put(path, leaf):
+        name = path[-1].key if path and hasattr(path[-1], "key") else None
+        sa = seq.get(name)
+        return jax.device_put(
+            leaf, batch_sharding(mesh, getattr(leaf, "ndim", 0), sa)
+        )
+
+    return jax.tree_util.tree_map_with_path(_put, batch)
+
+
+# -- parameter partitioning --------------------------------------------------
+
+#: Flax logical-axis → mesh-axis rules used by :func:`logical_sharding`.
+#: Models in :mod:`tensorflowonspark_tpu.models` annotate their params with
+#: these logical names via ``flax.linen.with_partitioning``.
+DEFAULT_RULES: tuple[tuple[str, Any], ...] = (
+    ("batch", ("dp", "fsdp")),
+    ("sequence", "sp"),
+    ("embed", "fsdp"),      # model dim: ZeRO-shard storage when fsdp>1
+    ("mlp", "tp"),          # hidden/ffn dim: tensor-parallel
+    ("heads", "tp"),
+    ("kv", None),
+    ("vocab", "tp"),
+    ("classes", None),
+    ("conv_kernel", None),
+    ("stage", "pp"),
+)
+
+
+def logical_sharding(mesh, logical_axes: Sequence[str | None], rules=DEFAULT_RULES):
+    rule_map = dict(rules)
+    spec = []
+    used: set[str] = set()
+    for name in logical_axes:
+        axes = rule_map.get(name) if name else None
+        # drop mesh axes already consumed by an earlier dim, or of size 1
+        if isinstance(axes, (tuple, list)):
+            axes = tuple(a for a in axes if a not in used and mesh.shape[a] > 1)
+        elif axes is not None:
+            axes = None if (axes in used or mesh.shape[axes] == 1) else axes
+        if not axes:
+            spec.append(None)
+            continue
+        for a in (axes if isinstance(axes, tuple) else (axes,)):
+            used.add(a)
+        spec.append(axes)
+    return named_sharding(mesh, *spec)
+
+
+def infer_param_sharding(params, mesh, axis: str = "tp", min_dim: int = 2048):
+    """Heuristic fallback for un-annotated params: shard the largest
+    divisible dimension of every big tensor over ``axis``; replicate the
+    rest.  Used when a model has no flax partitioning metadata.
+    """
+    import jax
+
+    size = mesh.shape[axis]
+
+    def _one(leaf):
+        shape = getattr(leaf, "shape", ())
+        if size > 1 and len(shape) >= 2:
+            dims = sorted(range(len(shape)), key=lambda d: -shape[d])
+            for d in dims:
+                if shape[d] >= min_dim and shape[d] % size == 0:
+                    spec = [None] * len(shape)
+                    spec[d] = axis
+                    return named_sharding(mesh, *spec)
+        return replicated(mesh)
+
+    return jax.tree_util.tree_map(_one, params)
+
+
+def param_sharding_from_metadata(params, mesh, rules=DEFAULT_RULES):
+    """Shardings for a flax variable tree that may contain
+    ``nn.Partitioned`` metadata (from ``nn.with_partitioning``); falls back
+    to :func:`infer_param_sharding` leaves for plain arrays.
+    """
+    import flax.linen as nn
+    import jax
+
+    def _one(leaf):
+        if isinstance(leaf, nn.Partitioned):
+            return logical_sharding(mesh, leaf.names, rules)
+        return None  # resolved in the second pass
+
+    def _is_leaf(x):
+        return isinstance(x, nn.Partitioned)
+
+    marked = jax.tree_util.tree_map(_one, params, is_leaf=_is_leaf)
+    fallback = infer_param_sharding(
+        nn.meta.unbox(params) if hasattr(nn, "meta") else params, mesh
+    )
+    return jax.tree_util.tree_map(
+        lambda m, f: f if m is None else m, marked, fallback,
+        is_leaf=lambda x: x is None or hasattr(x, "spec"),
+    )

@@ -1,0 +1,189 @@
+"""Sharded training-step factory: one code path for every strategy.
+
+Reference anchor: the reference exposes three distinct training strategies —
+between-graph DP (``TFNode.py::start_cluster_server`` + replica device
+setter), collective DP (``MultiWorkerMirroredStrategy`` built from the
+``TF_CONFIG`` that ``TFSparkNode.py::_mapfn`` writes), and parameter servers
+(``num_ps`` of ``TFCluster.py::run``).  On TPU all three collapse into one
+``jax.jit`` over a mesh (``SURVEY.md §2.3``):
+
+- DP/MWMS   → batch sharded over ``dp``; XLA inserts the grad ``psum``.
+- ``num_ps``→ there are no parameter servers on a TPU pod; the same capacity
+  concern (don't replicate optimizer state everywhere) maps to ZeRO-style
+  sharding of params/optimizer state over the ``fsdp`` axis
+  (``reduce_scatter``/``all_gather`` emitted by XLA from the shardings).
+- TP/SP     → extra mesh axes, free through the same jit.
+
+The factory returns a step that is compiled ONCE (static shapes, no Python
+control flow inside) and donates the state buffers so params update in-place
+in HBM.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable
+
+from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+
+logger = logging.getLogger(__name__)
+
+
+def unbox(tree):
+    """Strip flax ``Partitioned`` metadata boxes, if any."""
+    try:
+        import flax.linen as nn
+
+        return nn.meta.unbox(tree)
+    except Exception:
+        return tree
+
+
+class TrainState:
+    """Minimal pytree train state: ``params``, ``opt_state``, ``step``.
+
+    A hand-rolled pytree (not flax's TrainState) so the apply/optimizer
+    functions stay out of the leaves — they'd otherwise be retraced into
+    every jit signature and break donation.
+    """
+
+    def __init__(self, params, opt_state, step):
+        self.params = params
+        self.opt_state = opt_state
+        self.step = step
+
+    def tree_flatten(self):
+        return (self.params, self.opt_state, self.step), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+
+import jax.tree_util as _jtu  # noqa: E402
+
+_jtu.register_pytree_node_class(TrainState)
+
+
+def create_train_state(params, optimizer):
+    import jax.numpy as jnp
+
+    params = unbox(params)
+    return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+
+
+def state_shardings(state: TrainState, param_shardings, mesh, zero: bool = False):
+    """Shardings for the full train state.
+
+    Optimizer-state leaves that are param-shaped inherit the param's
+    sharding; with ``zero=True`` (the ``num_ps`` mapping) both params and
+    matching optimizer leaves are additionally sharded over ``fsdp``.
+    Scalars (step counts, EMA decay products) replicate.
+    """
+    import jax
+
+    flat_params, _ = jax.tree_util.tree_flatten(state.params)
+    flat_shards, _ = jax.tree_util.tree_flatten(
+        param_shardings, is_leaf=lambda x: hasattr(x, "spec")
+    )
+    by_shape = {}
+    for p, s in zip(flat_params, flat_shards):
+        by_shape.setdefault((p.shape, p.dtype), s).spec  # first wins
+
+    def _opt_leaf(leaf):
+        key = (getattr(leaf, "shape", ()), getattr(leaf, "dtype", None))
+        if key in by_shape:
+            return by_shape[key]
+        return mesh_lib.replicated(mesh)
+
+    opt_shardings = jax.tree_util.tree_map(_opt_leaf, state.opt_state)
+    return TrainState(param_shardings, opt_shardings, mesh_lib.replicated(mesh))
+
+
+def apply_zero_sharding(param_shardings, mesh, params, min_size: int = 1 << 16):
+    """Extend param shardings with an ``fsdp`` dimension (ZeRO / num_ps map).
+
+    For each parameter ≥ ``min_size`` elements, shard its largest
+    not-yet-sharded, fsdp-divisible dimension over ``fsdp``.
+    """
+    import jax
+
+    fsdp = mesh.shape["fsdp"]
+    if fsdp <= 1:
+        return param_shardings
+
+    def _one(sharding, leaf):
+        shape = getattr(leaf, "shape", ())
+        spec = list(sharding.spec) + [None] * (len(shape) - len(sharding.spec))
+        if getattr(leaf, "size", 0) < min_size:
+            return sharding
+        dims = sorted(range(len(shape)), key=lambda d: -shape[d])
+        for d in dims:
+            if spec[d] is None and shape[d] % fsdp == 0:
+                spec[d] = "fsdp"
+                return mesh_lib.named_sharding(mesh, *spec)
+        return sharding
+
+    return jax.tree_util.tree_map(
+        _one, param_shardings, params, is_leaf=lambda x: hasattr(x, "spec")
+    )
+
+
+def make_train_step(
+    loss_fn: Callable[[Any, Any], Any],
+    optimizer,
+    mesh,
+    param_shardings,
+    state: TrainState,
+    batch_example: Any,
+    sequence_axes: dict[str, int] | None = None,
+    donate: bool = True,
+):
+    """Compile ``state, batch -> state, loss`` over the mesh.
+
+    ``loss_fn(params, batch) -> scalar loss`` must be pure and
+    trace-compatible (static shapes; ``lax`` control flow only —
+    XLA semantics per the TPU design notes).
+    """
+    import jax
+
+    shardings = state_shardings(state, param_shardings, mesh)
+
+    def _batch_sharding(leaf_path, leaf):
+        name = leaf_path[-1].key if leaf_path and hasattr(leaf_path[-1], "key") else None
+        sa = (sequence_axes or {}).get(name)
+        return mesh_lib.batch_sharding(mesh, getattr(leaf, "ndim", 0), sa)
+
+    batch_shardings = jax.tree_util.tree_map_with_path(_batch_sharding, batch_example)
+
+    def _step(st: TrainState, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(st.params, batch)
+        updates, opt_state = optimizer.update(grads, st.opt_state, st.params)
+        import optax
+
+        params = optax.apply_updates(st.params, updates)
+        return TrainState(params, opt_state, st.step + 1), loss
+
+    return jax.jit(
+        _step,
+        in_shardings=(shardings, batch_shardings),
+        out_shardings=(shardings, mesh_lib.replicated(mesh)),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def make_eval_step(forward_fn, mesh, param_shardings, batch_example,
+                   sequence_axes: dict[str, int] | None = None):
+    """Compile a sharded ``params, batch -> outputs`` inference step."""
+    import jax
+
+    def _batch_sharding(leaf_path, leaf):
+        name = leaf_path[-1].key if leaf_path and hasattr(leaf_path[-1], "key") else None
+        sa = (sequence_axes or {}).get(name)
+        return mesh_lib.batch_sharding(mesh, getattr(leaf, "ndim", 0), sa)
+
+    batch_shardings = jax.tree_util.tree_map_with_path(_batch_sharding, batch_example)
+    return jax.jit(
+        forward_fn,
+        in_shardings=(param_shardings, batch_shardings),
+    )
