@@ -1,0 +1,200 @@
+"""BERT encoder + SQuAD span head — acceptance config #5 (``BASELINE.md``)
+and the flagship model of the framework (``__graft_entry__.py``).
+
+Reference anchor: **no BERT exists in the reference** — config #5 comes from
+``BASELINE.json::configs`` ("BERT-base SQuAD fine-tune streamed from Spark
+DataFrame, sharded over TPU pod").  The design is TPU-native throughout:
+
+- bfloat16 activations, float32 layernorm/softmax/loss.
+- QKV projected in ONE fused dense (3·H) — one big MXU matmul, not three.
+- attention runs through :mod:`tensorflowonspark_tpu.parallel.ring_attention`
+  when the mesh has ``sp > 1`` (sequence sharded over ICI neighbours —
+  long-context first-class), dense masked attention otherwise.
+- params carry flax logical axes (``embed``/``heads``/``kv``/``mlp``/
+  ``vocab``) so the one mesh maps DP/FSDP/TP/SP without model changes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    vocab_size: int = 30522
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    type_vocab: int = 2
+    dtype: str = "bfloat16"
+    remat: bool = False  # jax.checkpoint each layer: FLOPs for HBM
+
+    @classmethod
+    def tiny(cls) -> "Config":
+        return cls(vocab_size=128, hidden=32, layers=2, heads=4, mlp_dim=64,
+                   max_len=64, dtype="float32")
+
+    @classmethod
+    def large(cls) -> "Config":
+        return cls(hidden=1024, layers=24, heads=16, mlp_dim=4096)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.heads
+
+
+#: sequence axis of each batch leaf (sharded over ``sp`` when sp > 1)
+SEQUENCE_AXES = {"input_ids": 1, "token_type_ids": 1, "attention_mask": 1}
+
+
+def make_model(config: Config, mesh=None):
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(config.dtype)
+    use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
+    if use_ring:
+        from tensorflowonspark_tpu.parallel import ring_attention as ra
+
+        sharded_attn = ra.make_sharded_attention(mesh, causal=False, impl="ring")
+
+    def dense(features, axes, name=None):
+        return nn.DenseGeneral(
+            features, dtype=dtype, name=name,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.normal(stddev=0.02), axes
+            ),
+        )
+
+    class Attention(nn.Module):
+        @nn.compact
+        def __call__(self, x, mask):
+            b, s, _ = x.shape
+            h, d = config.heads, config.head_dim
+            qkv = dense((3, h, d), ("embed", None, "heads", "kv"), name="qkv")(x)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (B,S,H,D)
+            if use_ring:
+                # sequence is sharded over sp: K/V blocks ring over ICI.
+                # Padding must be handled by packing (mask ignored here).
+                o = sharded_attn(q, k, v)
+            else:
+                scale = 1.0 / math.sqrt(d)
+                s_ = jnp.einsum(
+                    "bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                    k.astype(jnp.float32)
+                ) * scale
+                s_ = jnp.where(mask[:, None, None, :], s_, -1e30)
+                p = jax.nn.softmax(s_, axis=-1)
+                o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(dtype), v)
+            o = o.reshape(b, s, h * d)
+            return nn.DenseGeneral(
+                config.hidden, axis=-1, dtype=dtype, name="out",
+                kernel_init=nn.with_partitioning(
+                    nn.initializers.normal(stddev=0.02), ("heads", "embed")
+                ),
+            )(o)
+
+    class Block(nn.Module):
+        @nn.compact
+        def __call__(self, x, mask):
+            y = Attention(name="attention")(x, mask)
+            x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + y).astype(dtype)
+            y = dense(config.mlp_dim, ("embed", "mlp"), name="mlp_in")(x)
+            y = nn.gelu(y)
+            y = dense(config.hidden, ("mlp", "embed"), name="mlp_out")(y)
+            x = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + y).astype(dtype)
+            return x
+
+    class Bert(nn.Module):
+        @nn.compact
+        def __call__(self, input_ids, token_type_ids, attention_mask):
+            tok = self.param(
+                "tok_embed",
+                nn.with_partitioning(
+                    nn.initializers.normal(stddev=0.02), ("vocab", "embed")
+                ),
+                (config.vocab_size, config.hidden), jnp.float32,
+            )
+            pos = self.param(
+                "pos_embed",
+                nn.with_partitioning(
+                    nn.initializers.normal(stddev=0.02), (None, "embed")
+                ),
+                (config.max_len, config.hidden), jnp.float32,
+            )
+            typ = self.param(
+                "type_embed",
+                nn.with_partitioning(
+                    nn.initializers.normal(stddev=0.02), (None, "embed")
+                ),
+                (config.type_vocab, config.hidden), jnp.float32,
+            )
+            s = input_ids.shape[1]
+            x = (jnp.take(tok, input_ids, axis=0)
+                 + pos[None, :s]
+                 + jnp.take(typ, token_type_ids, axis=0))
+            x = nn.LayerNorm(dtype=jnp.float32, name="ln_embed")(x).astype(dtype)
+            mask = attention_mask.astype(bool)
+            block = Block
+            if config.remat:
+                block = nn.remat(Block)
+            for i in range(config.layers):
+                x = block(name=f"layer_{i}")(x, mask)
+            # SQuAD span head: start/end logits per position
+            span = dense((2,), ("embed", "classes"), name="span")(x)
+            logits = span.astype(jnp.float32)
+            logits = jnp.where(mask[:, :, None], logits, -1e30)
+            return logits[..., 0], logits[..., 1]  # start, end: (B, S)
+
+    return Bert()
+
+
+def make_loss_fn(module, config: Config):
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, batch):
+        start, end = module.apply(
+            {"params": params}, batch["input_ids"], batch["token_type_ids"],
+            batch["attention_mask"],
+        )
+        l_s = optax.softmax_cross_entropy_with_integer_labels(
+            start, batch["start_positions"]
+        )
+        l_e = optax.softmax_cross_entropy_with_integer_labels(
+            end, batch["end_positions"]
+        )
+        return jnp.mean(l_s + l_e) / 2.0
+
+    return loss_fn
+
+
+def make_forward_fn(module, config: Config):
+    def forward(params, batch):
+        return module.apply(
+            {"params": params}, batch["input_ids"], batch["token_type_ids"],
+            batch["attention_mask"],
+        )
+
+    return forward
+
+
+def example_batch(config: Config, batch_size: int = 8, seed: int = 0,
+                  seq_len: int | None = None):
+    rng = np.random.RandomState(seed)
+    s = seq_len or min(config.max_len, 384)
+    return {
+        "input_ids": rng.randint(0, config.vocab_size, (batch_size, s)).astype(
+            np.int32
+        ),
+        "token_type_ids": np.zeros((batch_size, s), np.int32),
+        "attention_mask": np.ones((batch_size, s), np.int32),
+        "start_positions": rng.randint(0, s, (batch_size,)).astype(np.int32),
+        "end_positions": rng.randint(0, s, (batch_size,)).astype(np.int32),
+    }

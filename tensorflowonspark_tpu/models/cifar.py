@@ -1,0 +1,96 @@
+"""CIFAR-10 CNN — acceptance config #2 (``BASELINE.md``).
+
+Reference anchor: ``examples/cifar10`` (the reference's multi-GPU CNN
+example; see ``SURVEY.md §1 L6``).  A conv stack in NHWC (the TPU-native
+conv layout — channels innermost so XLA tiles onto the MXU), GroupNorm
+instead of BatchNorm so training needs no cross-replica batch-stat sync
+over ICI and the loss stays a pure function of ``(params, batch)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    channels: tuple = (64, 128, 256)
+    num_classes: int = 10
+    image_size: int = 32
+    groups: int = 8
+    dtype: str = "bfloat16"
+
+    @classmethod
+    def tiny(cls) -> "Config":
+        return cls(channels=(8, 16), image_size=8, groups=2, dtype="float32")
+
+
+SEQUENCE_AXES: dict = {}
+
+
+def make_model(config: Config, mesh=None):
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(config.dtype)
+    conv_init = nn.with_partitioning(
+        nn.initializers.he_normal(), (None, None, "embed", "mlp")
+    )
+
+    class CNN(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = x.astype(dtype)
+            for ch in config.channels:
+                x = nn.Conv(ch, (3, 3), dtype=dtype, kernel_init=conv_init)(x)
+                x = nn.GroupNorm(num_groups=min(config.groups, ch), dtype=dtype)(x)
+                x = nn.relu(x)
+                x = nn.Conv(ch, (3, 3), dtype=dtype, kernel_init=conv_init)(x)
+                x = nn.GroupNorm(num_groups=min(config.groups, ch), dtype=dtype)(x)
+                x = nn.relu(x)
+                x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+            x = x.mean(axis=(1, 2))  # global average pool
+            return nn.Dense(
+                config.num_classes,
+                dtype=jnp.float32,
+                kernel_init=nn.with_partitioning(
+                    nn.initializers.lecun_normal(), ("embed", "classes")
+                ),
+            )(x)
+
+    return CNN()
+
+
+def make_loss_fn(module, config: Config):
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, batch):
+        logits = module.apply({"params": params}, batch["image"])
+        return jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), batch["label"]
+            )
+        )
+
+    return loss_fn
+
+
+def make_forward_fn(module, config: Config):
+    def forward(params, batch):
+        return module.apply({"params": params}, batch["image"])
+
+    return forward
+
+
+def example_batch(config: Config, batch_size: int = 8, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    s = config.image_size
+    return {
+        "image": rng.rand(batch_size, s, s, 3).astype(np.float32),
+        "label": rng.randint(0, config.num_classes, size=(batch_size,)).astype(
+            np.int32
+        ),
+    }

@@ -1,0 +1,92 @@
+"""MNIST dense classifier — acceptance config #1 (``BASELINE.md``).
+
+Reference anchor: ``examples/mnist`` (the reference's canonical example,
+shipped in TF1 estimator, TF2 keras, and spark-feed variants; see
+``SURVEY.md §1 L6``).  Here it is a flax MLP sized to match the reference's
+dense 784→128→64→10 topology, trained with softmax cross-entropy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    hidden: tuple = (128, 64)
+    num_classes: int = 10
+    image_size: int = 28
+    dtype: str = "float32"
+
+    @classmethod
+    def tiny(cls) -> "Config":
+        return cls(hidden=(16,), image_size=8)
+
+
+#: no sequence axis — images feed as flat vectors
+SEQUENCE_AXES: dict = {}
+
+
+def make_model(config: Config, mesh=None):
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(config.dtype)
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = x.reshape((x.shape[0], -1)).astype(dtype)
+            for h in config.hidden:
+                x = nn.Dense(
+                    h,
+                    dtype=dtype,
+                    kernel_init=nn.with_partitioning(
+                        nn.initializers.lecun_normal(), ("embed", "mlp")
+                    ),
+                )(x)
+                x = nn.relu(x)
+            return nn.Dense(
+                config.num_classes,
+                dtype=dtype,
+                kernel_init=nn.with_partitioning(
+                    nn.initializers.lecun_normal(), ("embed", "classes")
+                ),
+            )(x)
+
+    return MLP()
+
+
+def make_loss_fn(module, config: Config):
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, batch):
+        logits = module.apply({"params": params}, batch["image"])
+        return jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), batch["label"]
+            )
+        )
+
+    return loss_fn
+
+
+def make_forward_fn(module, config: Config):
+    def forward(params, batch):
+        return module.apply({"params": params}, batch["image"])
+
+    return forward
+
+
+def example_batch(config: Config, batch_size: int = 8, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    s = config.image_size
+    return {
+        "image": rng.rand(batch_size, s * s).astype(np.float32),
+        "label": rng.randint(0, config.num_classes, size=(batch_size,)).astype(
+            np.int32
+        ),
+    }

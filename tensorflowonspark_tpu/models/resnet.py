@@ -1,0 +1,140 @@
+"""ResNet-50 for ImageNet — acceptance config #3 (``BASELINE.md``) and the
+headline throughput benchmark (``BASELINE.json::metric`` — images/sec/chip).
+
+Reference anchor: ``examples/imagenet`` (the reference's Inception/ResNet
+data-parallel training; see ``SURVEY.md §1 L6``).  TPU-first choices:
+
+- NHWC layout end-to-end (channels innermost → XLA tiles convs onto the MXU).
+- bfloat16 compute, float32 params and loss.
+- v1.5 bottleneck (stride in the 3×3, not the 1×1 — matches the variant every
+  modern benchmark reports).
+- GroupNorm instead of BatchNorm: per-example normalisation keeps the loss a
+  pure function of ``(params, batch)`` and needs no cross-replica batch-stat
+  ``psum`` over ICI every step (the BiT recipe).  ``Config(norm="batch")``
+  is reserved for a later stats-carrying train state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    stage_sizes: tuple = (3, 4, 6, 3)  # ResNet-50
+    width: int = 64
+    num_classes: int = 1000
+    image_size: int = 224
+    groups: int = 32
+    dtype: str = "bfloat16"
+
+    @classmethod
+    def tiny(cls) -> "Config":
+        return cls(stage_sizes=(1, 1), width=8, num_classes=10, image_size=16,
+                   groups=2, dtype="float32")
+
+    @classmethod
+    def resnet101(cls) -> "Config":
+        return cls(stage_sizes=(3, 4, 23, 3))
+
+
+SEQUENCE_AXES: dict = {}
+
+
+def make_model(config: Config, mesh=None):
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(config.dtype)
+    conv_init = nn.with_partitioning(
+        nn.initializers.he_normal(), (None, None, "embed", "mlp")
+    )
+
+    def norm(ch):
+        return nn.GroupNorm(num_groups=min(config.groups, ch), dtype=dtype)
+
+    class Bottleneck(nn.Module):
+        filters: int
+        strides: int = 1
+
+        @nn.compact
+        def __call__(self, x):
+            residual = x
+            y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=dtype,
+                        kernel_init=conv_init)(x)
+            y = norm(self.filters)(y)
+            y = nn.relu(y)
+            y = nn.Conv(self.filters, (3, 3), strides=(self.strides,) * 2,
+                        use_bias=False, dtype=dtype, kernel_init=conv_init)(y)
+            y = norm(self.filters)(y)
+            y = nn.relu(y)
+            out_ch = self.filters * 4
+            y = nn.Conv(out_ch, (1, 1), use_bias=False, dtype=dtype,
+                        kernel_init=conv_init)(y)
+            y = norm(out_ch)(y)
+            if residual.shape != y.shape:
+                residual = nn.Conv(out_ch, (1, 1), strides=(self.strides,) * 2,
+                                   use_bias=False, dtype=dtype,
+                                   kernel_init=conv_init)(residual)
+                residual = norm(out_ch)(residual)
+            return nn.relu(residual + y)
+
+    class ResNet(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = x.astype(dtype)
+            x = nn.Conv(config.width, (7, 7), strides=(2, 2), use_bias=False,
+                        dtype=dtype, kernel_init=conv_init)(x)
+            x = norm(config.width)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            for i, n_blocks in enumerate(config.stage_sizes):
+                filters = config.width * (2 ** i)
+                for j in range(n_blocks):
+                    strides = 2 if i > 0 and j == 0 else 1
+                    x = Bottleneck(filters, strides)(x)
+            x = x.mean(axis=(1, 2))
+            return nn.Dense(
+                config.num_classes,
+                dtype=jnp.float32,
+                kernel_init=nn.with_partitioning(
+                    nn.initializers.lecun_normal(), ("embed", "classes")
+                ),
+            )(x)
+
+    return ResNet()
+
+
+def make_loss_fn(module, config: Config):
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, batch):
+        logits = module.apply({"params": params}, batch["image"])
+        return jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), batch["label"]
+            )
+        )
+
+    return loss_fn
+
+
+def make_forward_fn(module, config: Config):
+    def forward(params, batch):
+        return module.apply({"params": params}, batch["image"])
+
+    return forward
+
+
+def example_batch(config: Config, batch_size: int = 8, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    s = config.image_size
+    return {
+        "image": rng.rand(batch_size, s, s, 3).astype(np.float32),
+        "label": rng.randint(0, config.num_classes, size=(batch_size,)).astype(
+            np.int32
+        ),
+    }

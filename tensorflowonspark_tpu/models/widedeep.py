@@ -1,0 +1,136 @@
+"""Criteo wide-and-deep CTR model — acceptance config #4 (``BASELINE.md``)
+and half the headline metric (``BASELINE.json::metric`` — steps/sec).
+
+Reference anchor: the estimator-era wide&deep example of the reference's
+``examples/`` tree (``SURVEY.md §1 L6``).  Criteo layout: 13 integer (dense)
+features + 26 categorical features pre-hashed into per-feature buckets.
+
+TPU-first choices:
+
+- the wide path and each deep embedding lookup are ``table[ids]`` gathers —
+  XLA lowers them to efficient dynamic-gathers in HBM; the tables carry
+  ``("vocab", "embed")`` partitioning so big vocabularies shard over ``tp``
+  (a Pallas one-pass gather-fuse kernel is the planned upgrade for the
+  multi-table lookup once profiling justifies it).
+- all 26 categorical lookups run as ONE stacked gather over a single fused
+  table (per-feature offsets added to the ids) instead of 26 small kernels —
+  the batched-not-scalar rule of the MXU/HBM playbook.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+NUM_DENSE = 13
+NUM_CAT = 26
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    hash_buckets: int = 100_000  # per categorical feature
+    embed_dim: int = 32
+    hidden: tuple = (1024, 512, 256)
+    dtype: str = "float32"
+
+    @classmethod
+    def tiny(cls) -> "Config":
+        return cls(hash_buckets=50, embed_dim=4, hidden=(16,))
+
+    @property
+    def total_buckets(self) -> int:
+        return self.hash_buckets * NUM_CAT
+
+
+SEQUENCE_AXES: dict = {}
+
+
+def make_model(config: Config, mesh=None):
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(config.dtype)
+
+    class WideDeep(nn.Module):
+        @nn.compact
+        def __call__(self, dense, cat):
+            # per-feature offsets fold 26 tables into one fused gather
+            offsets = jnp.arange(NUM_CAT, dtype=cat.dtype) * config.hash_buckets
+            ids = cat + offsets[None, :]  # (B, 26) global ids
+
+            wide_table = self.param(
+                "wide",
+                nn.with_partitioning(nn.initializers.zeros_init(), ("vocab",)),
+                (config.total_buckets,),
+                jnp.float32,
+            )
+            deep_table = self.param(
+                "embeddings",
+                nn.with_partitioning(
+                    nn.initializers.normal(stddev=0.01), ("vocab", "embed")
+                ),
+                (config.total_buckets, config.embed_dim),
+                dtype,
+            )
+
+            wide_logit = jnp.take(wide_table, ids, axis=0).sum(axis=1)  # (B,)
+            emb = jnp.take(deep_table, ids, axis=0)  # (B, 26, E)
+            x = jnp.concatenate(
+                [emb.reshape(emb.shape[0], -1),
+                 jnp.log1p(jnp.maximum(dense, 0.0)).astype(dtype)],
+                axis=-1,
+            )
+            for h in config.hidden:
+                x = nn.Dense(
+                    h, dtype=dtype,
+                    kernel_init=nn.with_partitioning(
+                        nn.initializers.he_normal(), ("embed", "mlp")
+                    ),
+                )(x)
+                x = nn.relu(x)
+            deep_logit = nn.Dense(
+                1, dtype=jnp.float32,
+                kernel_init=nn.with_partitioning(
+                    nn.initializers.lecun_normal(), ("embed", "classes")
+                ),
+            )(x)[:, 0]
+            return wide_logit + deep_logit  # (B,) CTR logit
+
+    return WideDeep()
+
+
+def make_loss_fn(module, config: Config):
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, batch):
+        logit = module.apply({"params": params}, batch["dense"], batch["cat"])
+        return jnp.mean(
+            optax.sigmoid_binary_cross_entropy(
+                logit.astype(jnp.float32), batch["label"].astype(jnp.float32)
+            )
+        )
+
+    return loss_fn
+
+
+def make_forward_fn(module, config: Config):
+    import jax
+
+    def forward(params, batch):
+        logit = module.apply({"params": params}, batch["dense"], batch["cat"])
+        return jax.nn.sigmoid(logit)
+
+    return forward
+
+
+def example_batch(config: Config, batch_size: int = 8, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    return {
+        "dense": rng.rand(batch_size, NUM_DENSE).astype(np.float32),
+        "cat": rng.randint(
+            0, config.hash_buckets, size=(batch_size, NUM_CAT)
+        ).astype(np.int32),
+        "label": rng.randint(0, 2, size=(batch_size,)).astype(np.int32),
+    }

@@ -139,23 +139,31 @@ def make_sharded_attention(mesh, causal: bool = False, impl: str = "ring"):
     batch over (dp, fsdp) and seq over sp.  Usable directly inside a jitted
     model: shard_map composes with jit and with grad.
     """
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(("dp", "fsdp"), "sp", None, None)
     fn = ring_attention if impl == "ring" else ulysses_attention
 
-    @functools.partial(
-        shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-        out_specs=spec, check_rep=False,
-    )
     def attn(q, k, v):
         return fn(q, k, v, axis_name="sp", causal=causal)
 
-    return attn
+    return _shard_map(attn, mesh, in_specs=(spec, spec, spec), out_specs=spec)
+
+
+def _shard_map(f, mesh, *, in_specs, out_specs):
+    """``shard_map`` with replication checking off, across jax versions
+    (the kwarg was renamed ``check_rep`` → ``check_vma``)."""
+    import inspect
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    params = inspect.signature(shard_map).parameters
+    kw = "check_vma" if "check_vma" in params else "check_rep"
+    return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     **{kw: False})
 
 
 def local_attention(q, k, v, causal: bool = False, scale: float | None = None):

@@ -1,0 +1,148 @@
+"""High-level trainer: model zoo × mesh × sharded step, one object.
+
+Reference anchor: the reference has no trainer — every example hand-writes
+its TF session/estimator loop inside ``map_fun`` (``SURVEY.md §1 L6``).
+Here the repeated wiring (build model, shard-init params, compile the step,
+feed batches) is one class so examples, ``bench.py``, the pipeline API, and
+``__graft_entry__.py`` all share a single, tested code path.
+
+TPU-first details:
+
+- **Sharded init**: ``jax.jit(init, out_shardings=...)`` materialises the
+  parameters directly in their final sharded layout — a ResNet-50 or
+  BERT-large is never fully resident on one host/device.
+- The step is compiled once (static shapes); epoch loops live in Python
+  *outside* jit, per XLA semantics.
+- ``num_ps > 0`` (reference parameter-server knob) maps to ZeRO sharding of
+  params/optimizer state over the ``fsdp`` axis (``SURVEY.md §2.3``).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import numpy as np
+
+from tensorflowonspark_tpu import models as model_zoo
+from tensorflowonspark_tpu.parallel import (
+    apply_zero_sharding,
+    build_mesh,
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+    mesh as mesh_lib,
+    param_sharding_from_metadata,
+    shard_batch,
+)
+from tensorflowonspark_tpu.parallel.train import TrainState, unbox
+
+logger = logging.getLogger(__name__)
+
+
+class Trainer:
+    """Owns mesh, model, sharded state, and the compiled train/eval steps."""
+
+    def __init__(
+        self,
+        model: str | Any,
+        config: Any = None,
+        mesh_config: "mesh_lib.MeshConfig | None" = None,
+        optimizer: Any = None,
+        learning_rate: float = 1e-3,
+        zero: bool | None = None,
+        seed: int = 0,
+        devices: Any = None,
+    ):
+        import jax
+        import optax
+
+        if isinstance(model, str):
+            self.module_lib = model_zoo.get_model(model)
+        else:
+            self.module_lib = model
+        self.config = config or self.module_lib.Config.tiny()
+        self.mesh = build_mesh(mesh_config, devices=devices)
+        self.model = self.module_lib.make_model(self.config, mesh=self.mesh)
+        self.optimizer = optimizer or optax.adamw(learning_rate)
+        self.sequence_axes = getattr(self.module_lib, "SEQUENCE_AXES", {})
+        if self.mesh.shape.get("sp", 1) <= 1:
+            self.sequence_axes = {}
+        self.loss_fn = self.module_lib.make_loss_fn(self.model, self.config)
+        self.forward_fn = self.module_lib.make_forward_fn(self.model, self.config)
+
+        example = self.module_lib.example_batch(self.config, batch_size=2)
+        init_args = _model_inputs(example)
+
+        # abstract init → shardings from flax partitioning metadata
+        boxed_shapes = jax.eval_shape(
+            lambda: self.model.init(jax.random.PRNGKey(seed), *init_args)
+        )["params"]
+        self.param_shardings = param_sharding_from_metadata(
+            boxed_shapes, self.mesh
+        )
+        if zero is None:
+            zero = self.mesh.shape.get("fsdp", 1) > 1
+        if zero:
+            self.param_shardings = apply_zero_sharding(
+                self.param_shardings, self.mesh, unbox(boxed_shapes)
+            )
+
+        # sharded init: params materialise already laid out across the mesh
+        def _init():
+            return unbox(self.model.init(jax.random.PRNGKey(seed), *init_args))[
+                "params"
+            ]
+
+        params = jax.jit(_init, out_shardings=self.param_shardings)()
+        self.state = create_train_state(params, self.optimizer)
+
+        self.train_step = make_train_step(
+            self.loss_fn, self.optimizer, self.mesh, self.param_shardings,
+            self.state, example, sequence_axes=self.sequence_axes,
+        )
+        self.eval_step = make_eval_step(
+            lambda p, b: self.forward_fn(p, b), self.mesh, self.param_shardings,
+            example, sequence_axes=self.sequence_axes,
+        )
+
+    # -- stepping ------------------------------------------------------------
+
+    def shard(self, batch):
+        return shard_batch(self.mesh, batch, self.sequence_axes)
+
+    def step(self, batch) -> float:
+        """One sharded optimizer step; returns the (replicated) loss."""
+        self.state, loss = self.train_step(self.state, self.shard(batch))
+        return loss
+
+    def predict(self, batch):
+        return self.eval_step(self.state.params, self.shard(batch))
+
+    @property
+    def params(self):
+        return self.state.params
+
+    # -- checkpointing -------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        from tensorflowonspark_tpu import ckpt
+
+        ckpt.save_pytree({"params": self.state.params,
+                          "opt_state": self.state.opt_state,
+                          "step": self.state.step}, path)
+
+    def restore(self, path: str) -> None:
+        from tensorflowonspark_tpu import ckpt
+
+        restored = ckpt.load_pytree(path, {"params": self.state.params,
+                                           "opt_state": self.state.opt_state,
+                                           "step": self.state.step})
+        self.state = TrainState(restored["params"], restored["opt_state"],
+                                restored["step"])
+
+
+def _model_inputs(batch: dict) -> tuple:
+    """Positional model inputs from an example batch (labels stripped)."""
+    label_keys = {"label", "start_positions", "end_positions"}
+    return tuple(v for k, v in batch.items() if k not in label_keys)
