@@ -1,0 +1,101 @@
+"""Headline benchmark: prints ONE JSON line with the framework's throughput.
+
+Metric (``BASELINE.json::metric``): ImageNet ResNet-50 images/sec/chip on the
+sharded training step (`tensorflowonspark_tpu.trainer.Trainer`) — the same
+compiled path the Spark-cluster runtime drives on executors.
+
+The reference publishes no quantitative numbers (``BASELINE.json::published``
+is empty; see ``BASELINE.md``), so ``vs_baseline`` is reported against the
+self-set north-star targets below.
+
+Usage::
+
+    python bench.py                      # resnet50, auto batch/steps
+    python bench.py --model wide_deep    # Criteo steps/sec
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+# Self-set targets (images|steps per sec per chip) — the reference published
+# nothing, so these anchor vs_baseline at a roofline-informed v5e estimate.
+TARGETS = {
+    "resnet50": ("images/sec/chip", 2000.0),
+    "wide_deep": ("steps/sec", 100.0),
+    "bert": ("examples/sec/chip", 100.0),
+    "mnist_mlp": ("images/sec/chip", 100000.0),
+    "cifar10_cnn": ("images/sec/chip", 20000.0),
+}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50", choices=sorted(TARGETS))
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--steps", type=int, default=None)
+    p.add_argument("--warmup", type=int, default=3)
+    args = p.parse_args()
+
+    import jax
+
+    from tensorflowonspark_tpu import models as model_zoo
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    platform = jax.default_backend()
+    on_accel = platform in ("tpu", "gpu")
+    n_chips = len(jax.devices())
+
+    lib = model_zoo.get_model(args.model)
+    config = lib.Config() if on_accel else lib.Config.tiny()
+    if args.batch_size is None:
+        args.batch_size = (128 if on_accel else 16) * max(1, n_chips)
+    if args.steps is None:
+        args.steps = 20 if on_accel else 5
+
+    print(
+        f"bench: model={args.model} platform={platform} chips={n_chips} "
+        f"batch={args.batch_size} steps={args.steps}",
+        file=sys.stderr,
+    )
+
+    trainer = Trainer(args.model, config=config)
+    batch = lib.example_batch(config, batch_size=args.batch_size)
+    device_batch = trainer.shard(batch)  # input pipeline is measured separately
+
+    state = trainer.state
+    for _ in range(args.warmup):
+        state, loss = trainer.train_step(state, device_batch)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, loss = trainer.train_step(state, device_batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    steps_per_sec = args.steps / dt
+    examples_per_sec = steps_per_sec * args.batch_size
+    unit, target = TARGETS[args.model]
+    if unit == "steps/sec":
+        value = steps_per_sec
+    else:
+        value = examples_per_sec / n_chips
+
+    print(json.dumps({
+        "metric": f"{args.model}_{unit.replace('/', '_per_').replace('.', '')}",
+        "value": round(value, 2),
+        "unit": unit,
+        "vs_baseline": round(value / target, 4),
+        "platform": platform,
+        "n_chips": n_chips,
+        "batch_size": args.batch_size,
+        "loss": round(float(loss), 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

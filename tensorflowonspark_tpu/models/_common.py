@@ -1,0 +1,46 @@
+"""Shared helpers for image-classification models (mnist/cifar/resnet).
+
+One implementation of the softmax-xent loss, forward wrapper, and synthetic
+batch so a recipe change (label smoothing, dtype policy, …) lands in every
+classifier at once.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_classification_loss_fn(module):
+    """``loss(params, batch) -> scalar``: softmax cross-entropy in float32
+    over ``batch['image']`` / integer ``batch['label']``."""
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, batch):
+        logits = module.apply({"params": params}, batch["image"])
+        return jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), batch["label"]
+            )
+        )
+
+    return loss_fn
+
+
+def make_classification_forward_fn(module):
+    def forward(params, batch):
+        return module.apply({"params": params}, batch["image"])
+
+    return forward
+
+
+def image_example_batch(image_shape, num_classes: int, batch_size: int = 8,
+                        seed: int = 0):
+    """Synthetic ``{image, label}`` batch; ``image_shape`` excludes batch."""
+    rng = np.random.RandomState(seed)
+    return {
+        "image": rng.rand(batch_size, *image_shape).astype(np.float32),
+        "label": rng.randint(0, num_classes, size=(batch_size,)).astype(
+            np.int32
+        ),
+    }

@@ -80,9 +80,9 @@ def make_model(config: Config, mesh=None):
             qkv = dense((3, h, d), ("embed", None, "heads", "kv"), name="qkv")(x)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (B,S,H,D)
             if use_ring:
-                # sequence is sharded over sp: K/V blocks ring over ICI.
-                # Padding must be handled by packing (mask ignored here).
-                o = sharded_attn(q, k, v)
+                # sequence is sharded over sp: K/V blocks ring over ICI,
+                # the key-padding mask rides along with its block
+                o = sharded_attn(q, k, v, kv_mask=mask)
             else:
                 scale = 1.0 / math.sqrt(d)
                 s_ = jnp.einsum(

@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import dataclasses
 
-import numpy as np
 
 
 @dataclasses.dataclass(frozen=True)
@@ -108,33 +107,21 @@ def make_model(config: Config, mesh=None):
 
 
 def make_loss_fn(module, config: Config):
-    import jax.numpy as jnp
-    import optax
+    from tensorflowonspark_tpu.models._common import make_classification_loss_fn
 
-    def loss_fn(params, batch):
-        logits = module.apply({"params": params}, batch["image"])
-        return jnp.mean(
-            optax.softmax_cross_entropy_with_integer_labels(
-                logits.astype(jnp.float32), batch["label"]
-            )
-        )
-
-    return loss_fn
+    return make_classification_loss_fn(module)
 
 
 def make_forward_fn(module, config: Config):
-    def forward(params, batch):
-        return module.apply({"params": params}, batch["image"])
+    from tensorflowonspark_tpu.models._common import (
+        make_classification_forward_fn,
+    )
 
-    return forward
+    return make_classification_forward_fn(module)
 
 
 def example_batch(config: Config, batch_size: int = 8, seed: int = 0):
-    rng = np.random.RandomState(seed)
-    s = config.image_size
-    return {
-        "image": rng.rand(batch_size, s, s, 3).astype(np.float32),
-        "label": rng.randint(0, config.num_classes, size=(batch_size,)).astype(
-            np.int32
-        ),
-    }
+    from tensorflowonspark_tpu.models._common import image_example_batch
+
+    return image_example_batch((config.image_size, config.image_size, 3), config.num_classes,
+                               batch_size=batch_size, seed=seed)

@@ -21,19 +21,20 @@ Canonical layout: ``(batch, seq, heads, head_dim)``.
 
 from __future__ import annotations
 
-import functools
 import math
 from typing import Any
 
 NEG_INF = -1e30
 
 
-def _block_attn(q, k, v, m, l, o, q_start, k_start, causal, scale):
+def _block_attn(q, k, v, m, l, o, q_start, k_start, causal, scale,
+                kv_mask=None):
     """One K/V block of flash-style attention with running (m, l, o).
 
     q: (B, Sq, H, D); k, v: (B, Sk, H, D); m, l: (B, H, Sq); o like q.
     ``q_start``/``k_start`` are the blocks' global sequence offsets (traced
     scalars — kept out of shapes so the loop stays compiled once).
+    ``kv_mask``: optional (B, Sk) bool — False keys (padding) are excluded.
     """
     import jax.numpy as jnp
 
@@ -43,10 +44,14 @@ def _block_attn(q, k, v, m, l, o, q_start, k_start, causal, scale):
         k_pos = k_start + jnp.arange(k.shape[1])
         mask = q_pos[:, None] >= k_pos[None, :]
         s = jnp.where(mask[None, None], s, NEG_INF)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, NEG_INF)
     m_new = jnp.maximum(m, s.max(axis=-1))
     p = jnp.exp(s - m_new[..., None])
     if causal:
         p = jnp.where(mask[None, None], p, 0.0)
+    if kv_mask is not None:
+        p = jnp.where(kv_mask[:, None, None, :], p, 0.0)
     correction = jnp.exp(m - m_new)
     l_new = l * correction + p.sum(axis=-1)
     pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
@@ -55,12 +60,14 @@ def _block_attn(q, k, v, m, l, o, q_start, k_start, causal, scale):
 
 
 def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
-                   scale: float | None = None):
+                   scale: float | None = None, kv_mask=None):
     """Per-device ring attention body; call under ``shard_map`` with the
     sequence axis sharded over ``axis_name``.
 
     Blocks rotate ``axis_size`` times; at step ``i`` this device holds the
-    K/V block originally owned by rank ``(rank - i) mod n``.
+    K/V block originally owned by rank ``(rank - i) mod n``.  ``kv_mask``
+    (B, Sk local; False = padding key) rotates around the ring with its
+    K/V block.
     """
     import jax
     import jax.numpy as jnp
@@ -77,29 +84,33 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     l0 = jnp.zeros((b, h, sq), dtype=jnp.float32)
     o0 = jnp.zeros(q.shape, dtype=jnp.float32)
     qf = q.astype(jnp.float32)
+    maskb0 = jnp.ones((b, sk), bool) if kv_mask is None else kv_mask.astype(bool)
 
     def body(i, carry):
-        m, l, o, kb, vb = carry
+        m, l, o, kb, vb, maskb = carry
         src = (rank - i) % n
         m, l, o = _block_attn(qf, kb.astype(jnp.float32), vb.astype(jnp.float32),
-                              m, l, o, rank * sq, src * sk, causal, scale)
+                              m, l, o, rank * sq, src * sk, causal, scale,
+                              kv_mask=maskb)
         kb = lax.ppermute(kb, axis_name, perm)
         vb = lax.ppermute(vb, axis_name, perm)
-        return m, l, o, kb, vb
+        maskb = lax.ppermute(maskb, axis_name, perm)
+        return m, l, o, kb, vb, maskb
 
-    m, l, o, _, _ = lax.fori_loop(0, n, body, (m0, l0, o0, k, v))
+    m, l, o, _, _, _ = lax.fori_loop(0, n, body, (m0, l0, o0, k, v, maskb0))
     out = o / jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
-                      scale: float | None = None):
+                      scale: float | None = None, kv_mask=None):
     """All-to-all sequence parallelism (DeepSpeed-Ulysses style).
 
     Re-shards (seq/sp, H) → (seq, H/sp) with one ``all_to_all`` each way,
     runs dense local attention on the full sequence for a head subset.
     Requires ``heads % sp == 0``.  Better than the ring when sp is small and
     heads are plentiful; the ring wins at long seq / many chips.
+    ``kv_mask`` (B, Sk local) is all-gathered to the full sequence.
     """
     import jax.numpy as jnp
     from jax import lax
@@ -121,9 +132,18 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     if causal:
         pos = jnp.arange(sq * n)
         s = jnp.where((pos[:, None] >= pos[None, :])[None, None], s, NEG_INF)
+    if kv_mask is not None:
+        # (B, Sk) -> (B, S global), concatenated in rank order — the same
+        # order a2a_fwd reconstructs the sequence in
+        mask_g = lax.all_gather(kv_mask.astype(bool), axis_name, axis=1,
+                                tiled=True)
+        s = jnp.where(mask_g[:, None, None, :], s, NEG_INF)
     p = jnp.exp(s - s.max(axis=-1, keepdims=True))
     p = p / p.sum(axis=-1, keepdims=True)
     og = jnp.einsum("bhqk,bkhd->bqhd", p, vg.astype(jnp.float32))
+    if kv_mask is not None:
+        # all-padding rows output 0, matching ring_attention (l = 0 there)
+        og = jnp.where(mask_g.any(-1)[:, None, None, None], og, 0.0)
 
     # reverse: split seq chunks back to their devices, gather head groups
     og = og.reshape(b, n, sq, h // n, d)
@@ -142,12 +162,27 @@ def make_sharded_attention(mesh, causal: bool = False, impl: str = "ring"):
     from jax.sharding import PartitionSpec as P
 
     spec = P(("dp", "fsdp"), "sp", None, None)
+    mask_spec = P(("dp", "fsdp"), "sp")
     fn = ring_attention if impl == "ring" else ulysses_attention
 
-    def attn(q, k, v):
+    def attn_plain(q, k, v):
         return fn(q, k, v, axis_name="sp", causal=causal)
 
-    return _shard_map(attn, mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    def attn_masked(q, k, v, kv_mask):
+        return fn(q, k, v, axis_name="sp", causal=causal, kv_mask=kv_mask)
+
+    mapped_plain = _shard_map(attn_plain, mesh,
+                              in_specs=(spec, spec, spec), out_specs=spec)
+    mapped_masked = _shard_map(attn_masked, mesh,
+                               in_specs=(spec, spec, spec, mask_spec),
+                               out_specs=spec)
+
+    def attn(q, k, v, kv_mask=None):
+        if kv_mask is None:  # packed/unmasked: no mask ppermute, no wheres
+            return mapped_plain(q, k, v)
+        return mapped_masked(q, k, v, kv_mask.astype(bool))
+
+    return attn
 
 
 def _shard_map(f, mesh, *, in_specs, out_specs):
@@ -166,7 +201,8 @@ def _shard_map(f, mesh, *, in_specs, out_specs):
                      **{kw: False})
 
 
-def local_attention(q, k, v, causal: bool = False, scale: float | None = None):
+def local_attention(q, k, v, causal: bool = False, scale: float | None = None,
+                    kv_mask=None):
     """Dense single-device attention with the same signature/layout —
     the sp=1 fallback, and the numerical baseline for ring tests."""
     import jax.numpy as jnp
@@ -179,7 +215,12 @@ def local_attention(q, k, v, causal: bool = False, scale: float | None = None):
         sq, sk = q.shape[1], k.shape[1]
         mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
         s = jnp.where(mask[None, None], s, NEG_INF)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :].astype(bool), s, NEG_INF)
     p = jnp.exp(s - s.max(axis=-1, keepdims=True))
     p = p / p.sum(axis=-1, keepdims=True)
     o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    if kv_mask is not None:
+        # all-padding rows output 0, matching ring_attention (l = 0 there)
+        o = jnp.where(kv_mask.astype(bool).any(-1)[:, None, None, None], o, 0.0)
     return o.astype(q.dtype)

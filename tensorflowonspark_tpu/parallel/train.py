@@ -72,31 +72,36 @@ def create_train_state(params, optimizer):
     return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
 
 
-def state_shardings(state: TrainState, param_shardings, mesh, zero: bool = False):
+def state_shardings(state: TrainState, param_shardings, mesh):
     """Shardings for the full train state.
 
-    Optimizer-state leaves that are param-shaped inherit the param's
-    sharding; with ``zero=True`` (the ``num_ps`` mapping) both params and
-    matching optimizer leaves are additionally sharded over ``fsdp``.
-    Scalars (step counts, EMA decay products) replicate.
+    Optimizer-state leaves carry the sharding the eager ``optimizer.init``
+    already propagated from the (committed, sharded) params — param-shaped
+    leaves (Adam ``mu``/``nu``) therefore inherit exactly their param's
+    layout, including ZeRO ``fsdp`` sharding (the ``num_ps`` mapping).
+    Leaves without a mesh sharding (step counts, EMA decay scalars)
+    replicate.
     """
     import jax
 
-    flat_params, _ = jax.tree_util.tree_flatten(state.params)
-    flat_shards, _ = jax.tree_util.tree_flatten(
-        param_shardings, is_leaf=lambda x: hasattr(x, "spec")
-    )
-    by_shape = {}
-    for p, s in zip(flat_params, flat_shards):
-        by_shape.setdefault((p.shape, p.dtype), s).spec  # first wins
+    degraded = []
 
     def _opt_leaf(leaf):
-        key = (getattr(leaf, "shape", ()), getattr(leaf, "dtype", None))
-        if key in by_shape:
-            return by_shape[key]
+        s = getattr(leaf, "sharding", None)
+        if isinstance(s, jax.sharding.NamedSharding) and s.mesh == mesh:
+            return s
+        if getattr(leaf, "ndim", 0) > 0 and getattr(leaf, "size", 0) > 1:
+            degraded.append(getattr(leaf, "shape", ()))
         return mesh_lib.replicated(mesh)
 
     opt_shardings = jax.tree_util.tree_map(_opt_leaf, state.opt_state)
+    if degraded:
+        logger.warning(
+            "%d non-scalar optimizer-state leaves carry no mesh sharding "
+            "(optimizer.init likely ran on uncommitted params) and will be "
+            "REPLICATED — ZeRO memory savings are lost for them; shapes: %s",
+            len(degraded), degraded[:5],
+        )
     return TrainState(param_shardings, opt_shardings, mesh_lib.replicated(mesh))
 
 

@@ -102,7 +102,7 @@ class Trainer:
             self.state, example, sequence_axes=self.sequence_axes,
         )
         self.eval_step = make_eval_step(
-            lambda p, b: self.forward_fn(p, b), self.mesh, self.param_shardings,
+            self.forward_fn, self.mesh, self.param_shardings,
             example, sequence_axes=self.sequence_axes,
         )
 

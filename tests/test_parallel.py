@@ -153,3 +153,17 @@ def test_ring_attention_grads_flow():
     g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b_ in zip(g, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-4)
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_masked_attention_matches_dense(impl):
+    """Padding keys must be excluded on sp>1 meshes exactly as on sp=1."""
+    mesh = build_mesh(MeshConfig(dp=2, sp=4))
+    rng = np.random.RandomState(4)
+    b, s, h, d = 2, 16, 4, 8
+    q, k, v = (jnp.asarray(rng.randn(b, s, h, d), jnp.float32) for _ in range(3))
+    kv_mask = jnp.asarray(rng.rand(b, s) > 0.3)
+    attn = ra.make_sharded_attention(mesh, impl=impl)
+    got = attn(q, k, v, kv_mask=kv_mask)
+    want = ra.local_attention(q, k, v, kv_mask=kv_mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
