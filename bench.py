@@ -67,9 +67,11 @@ def main() -> None:
     device_batch = trainer.shard(batch)  # input pipeline is measured separately
 
     state = trainer.state
+    loss = None
     for _ in range(args.warmup):
         state, loss = trainer.train_step(state, device_batch)
-    jax.block_until_ready(loss)
+    if loss is not None:
+        jax.block_until_ready(loss)
 
     t0 = time.perf_counter()
     for _ in range(args.steps):

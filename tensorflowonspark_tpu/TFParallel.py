@@ -1,0 +1,86 @@
+"""Parallel single-node execution (no cluster formed).
+
+Reference anchor: ``tensorflowonspark/TFParallel.py::run`` — N *independent*
+instances of ``map_fun`` via ``sc.parallelize(...).foreachPartition``, used
+for embarrassingly-parallel inference from an exported model without paying
+for rendezvous/cluster formation (``SURVEY.md §2.1``, §2.3 "Spark-level task
+parallelism").
+
+TPU deltas: instead of GPU allocation (``gpu_info.get_gpus``), each instance
+pins the executor's chip claim (``chip_info``) and gets a single-node
+``TFNodeContext``-shaped ctx (no cluster_spec, no manager queues — data comes
+from the instance's own reading, results via the returned iterator semantics
+of the caller's follow-up jobs).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+from typing import Any, Callable
+
+logger = logging.getLogger(__name__)
+
+
+class _SoloContext:
+    """Single-node stand-in for ``TFNodeContext`` (no cluster)."""
+
+    def __init__(self, executor_id: int, num_workers: int, num_chips: int,
+                 default_fs: str, working_dir: str):
+        self.executor_id = executor_id
+        self.job_name = "worker"
+        self.task_index = executor_id
+        self.num_workers = num_workers
+        self.cluster_spec = None
+        self.defaultFS = default_fs
+        self.working_dir = working_dir
+        self.num_chips = num_chips
+        self.host = socket.gethostname()
+        self.mgr = None  # no queue manager: nothing feeds a solo node
+
+
+class _SoloRunner:
+    def __init__(self, fn: Callable, tf_args: Any, num_workers: int,
+                 num_chips: int, default_fs: str, app_id: str):
+        self.fn = fn
+        self.tf_args = tf_args
+        self.num_workers = num_workers
+        self.num_chips = num_chips
+        self.default_fs = default_fs
+        self.app_id = app_id
+
+    def __call__(self, iterator) -> None:
+        import os
+
+        from tensorflowonspark_tpu import chip_info, util
+
+        part = list(iterator)
+        executor_id = part[0] if part else 0
+        util.ensure_jax_platform()
+        if self.num_chips:
+            chip_info.claim_chips(self.num_chips, self.app_id,
+                                  f"solo_{executor_id}")
+        ctx = _SoloContext(executor_id, self.num_workers, self.num_chips,
+                           self.default_fs, os.getcwd())
+        logger.info("TFParallel instance %d starting", executor_id)
+        self.fn(self.tf_args, ctx)
+
+
+def run(sc, map_fun: Callable, tf_args: Any = None,
+        num_executors: int | None = None, num_chips_per_executor: int = 0,
+        default_fs: str = "file://") -> None:
+    """Run ``num_executors`` independent copies of ``map_fun(tf_args, ctx)``.
+
+    Reference anchor: ``TFParallel.py::run`` (same shape; ``num_gpus`` →
+    ``num_chips_per_executor``).  Blocks until every instance returns;
+    exceptions propagate driver-side with the executor traceback.
+    """
+    import uuid
+
+    if num_executors is None:
+        num_executors = getattr(sc, "defaultParallelism", 1)
+    app_id = getattr(sc, "applicationId", None) or f"tfparallel-{uuid.uuid4().hex[:8]}"
+    sc.parallelize(range(num_executors), num_executors).foreachPartition(
+        _SoloRunner(map_fun, tf_args, num_executors, num_chips_per_executor,
+                    default_fs, app_id)
+    )

@@ -1,0 +1,183 @@
+"""DataFrame ↔ TFRecord conversion.
+
+Reference anchor: ``tensorflowonspark/dfutil.py`` (``saveAsTFRecords``,
+``loadTFRecords``, ``toTFExample``, ``fromTFExample``, ``infer_schema``).
+The reference crosses into the JVM (``saveAsNewAPIHadoopFile`` + the
+``tensorflow-hadoop`` connector jar, ``SURVEY.md §3.5``); this rebuild writes
+the same on-disk format (TFRecord-framed ``tf.train.Example``) directly from
+the executors through :mod:`tensorflowonspark_tpu.tfrecord` — no jar, no JVM
+round-trip, one ``part-r-NNNNN`` file per partition as Hadoop would lay out.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Iterable
+
+from tensorflowonspark_tpu import tfrecord
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Row → Example
+# ---------------------------------------------------------------------------
+
+
+def toTFExample(dtypes: list[tuple[str, str]]):
+    """``mapPartitions`` closure: Rows → serialized ``tf.train.Example``.
+
+    Reference anchor: ``dfutil.py::toTFExample`` — Spark simpleString dtypes
+    pick the feature kind: integral → Int64List, fractional → FloatList,
+    string/binary → BytesList; ``array<...>`` of the same.
+    """
+    return _ToTFExample(dtypes)
+
+
+class _ToTFExample:
+    def __init__(self, dtypes: list[tuple[str, str]]):
+        self.dtypes = [(name, str(dt)) for name, dt in dtypes]
+
+    def __call__(self, iterator) -> Iterable[bytes]:
+        for row in iterator:
+            yield encode_row(row, self.dtypes)
+
+
+def encode_row(row, dtypes: list[tuple[str, str]]) -> bytes:
+    features: dict[str, tuple[int, list]] = {}
+    for name, dt in dtypes:
+        value = row[name] if not isinstance(row, (list, tuple)) else row[
+            [n for n, _ in dtypes].index(name)]
+        elem = dt[6:-1] if dt.startswith("array<") else dt
+        values = list(value) if dt.startswith("array<") else [value]
+        if elem in ("tinyint", "smallint", "int", "bigint", "long", "boolean"):
+            features[name] = (tfrecord.INT64_LIST, [int(v) for v in values])
+        elif elem in ("float", "double", "decimal"):
+            features[name] = (tfrecord.FLOAT_LIST, [float(v) for v in values])
+        elif elem == "string":
+            features[name] = (tfrecord.BYTES_LIST,
+                              [str(v).encode() for v in values])
+        elif elem == "binary":
+            features[name] = (tfrecord.BYTES_LIST,
+                              [bytes(v) for v in values])
+        else:
+            raise TypeError(f"column {name!r}: unsupported dtype {dt!r}")
+    return tfrecord.encode_example(features)
+
+
+# ---------------------------------------------------------------------------
+# Example → Row
+# ---------------------------------------------------------------------------
+
+
+def fromTFExample(data: bytes, binary_features: list[str] | None = None):
+    """Serialized Example → Row (single-element lists unwrap to scalars).
+
+    Reference anchor: ``dfutil.py::fromTFExample``.  ``binary_features``
+    names BytesList columns that stay ``bytes``; other BytesList columns
+    decode as utf-8 strings (the reference's convention).
+    """
+    from tensorflowonspark_tpu.sparkapi.sql import Row
+
+    binary = set(binary_features or [])
+    decoded = tfrecord.decode_example(data)
+    names, values = [], []
+    for name in sorted(decoded):
+        kind, vals = decoded[name]
+        if kind == tfrecord.BYTES_LIST and name not in binary:
+            vals = [v.decode() for v in vals]
+        elif kind == tfrecord.BYTES_LIST:
+            vals = [bytes(v) for v in vals]
+        names.append(name)
+        values.append(vals[0] if len(vals) == 1 else list(vals))
+    return Row.from_fields(names, values)
+
+
+def infer_schema(example: bytes, binary_features: list[str] | None = None):
+    """Schema (StructType) of a serialized Example.
+
+    Reference anchor: ``dfutil.py::infer_schema`` — samples one record.
+    """
+    from tensorflowonspark_tpu.sparkapi.sql import StructField, StructType
+
+    binary = set(binary_features or [])
+    decoded = tfrecord.decode_example(example)
+    fields = []
+    for name in sorted(decoded):
+        kind, vals = decoded[name]
+        if kind == tfrecord.INT64_LIST:
+            elem = "bigint"
+        elif kind == tfrecord.FLOAT_LIST:
+            elem = "float"
+        else:
+            elem = "binary" if name in binary else "string"
+        dt = f"array<{elem}>" if len(vals) != 1 else elem
+        fields.append(StructField(name, dt))
+    return StructType(fields)
+
+
+# ---------------------------------------------------------------------------
+# Save / load
+# ---------------------------------------------------------------------------
+
+
+def saveAsTFRecords(df, output_dir: str) -> None:
+    """Write ``df`` as TFRecord files, one ``part-r-NNNNN`` per partition.
+
+    Reference anchor: ``dfutil.py::saveAsTFRecords`` (via
+    ``saveAsNewAPIHadoopFile``; same directory layout, no JVM here).
+    """
+    os.makedirs(output_dir, exist_ok=True)
+    dtypes = df.dtypes
+    df.rdd.mapPartitionsWithIndex(
+        _SavePartition(output_dir, dtypes)
+    ).count()  # count() forces the job; one small int returns per partition
+    logger.info("saved TFRecords to %s", output_dir)
+
+
+class _SavePartition:
+    def __init__(self, output_dir: str, dtypes):
+        self.output_dir = output_dir
+        self.dtypes = dtypes
+
+    def __call__(self, pindex: int, iterator):
+        path = os.path.join(self.output_dir, f"part-r-{pindex:05d}")
+        n = tfrecord.write_records(
+            path, _ToTFExample(self.dtypes)(iterator)
+        )
+        yield n
+
+
+def loadTFRecords(sc, input_dir: str,
+                  binary_features: list[str] | None = None):
+    """Load a TFRecord directory back into a DataFrame.
+
+    Reference anchor: ``dfutil.py::loadTFRecords`` (Hadoop input format +
+    ``infer_schema`` from one sampled record).
+    """
+    from tensorflowonspark_tpu.sparkapi.sql import DataFrame
+
+    files = sorted(
+        os.path.join(input_dir, f)
+        for f in os.listdir(input_dir)
+        if f.startswith("part-") or f.endswith(".tfrecord")
+    )
+    if not files:
+        raise FileNotFoundError(f"no TFRecord part files in {input_dir}")
+    sample = next(iter(tfrecord.read_records(files[0])))
+    schema = infer_schema(sample, binary_features)
+    rows = sc.parallelize(files, len(files)).mapPartitions(
+        _LoadPartition(binary_features)
+    )
+    return DataFrame(rows, schema)
+
+
+class _LoadPartition:
+    def __init__(self, binary_features):
+        self.binary_features = binary_features
+
+    def __call__(self, iterator):
+        for path in iterator:
+            for payload in tfrecord.read_records(path):
+                yield fromTFExample(payload, self.binary_features)

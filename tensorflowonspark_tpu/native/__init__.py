@@ -1,0 +1,7 @@
+"""Native (C++) runtime pieces, loaded via ctypes with Python fallbacks.
+
+Reference anchor: ``SURVEY.md §2.2`` — the reference's native capability
+lives in external deps (tensorflow-hadoop jar, TF gRPC/NCCL core); the
+rebuild provides its own: a TFRecord codec here, with the XLA runtime
+covering the tensor plane.
+"""

@@ -1,0 +1,115 @@
+"""ctypes binding for the C++ TFRecord codec (``tfrecord_codec.cc``).
+
+Builds ``libtfrecord.so`` with g++ on first use (no pybind11 in the image —
+the ABI is a 5-function ``extern "C"`` surface, so ctypes is the right-sized
+binding).  All functions degrade gracefully: if the compiler or the library
+is unavailable, ``available()`` is False and
+:mod:`tensorflowonspark_tpu.tfrecord` stays on its pure-Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "tfrecord_codec.cc")
+_LIB = os.path.join(_DIR, "libtfrecord.so")
+
+_lock = threading.Lock()
+_lib_state: list = []  # [CDLL_or_None] once probed
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _LIB, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.info("native tfrecord codec build failed (%s); using Python", e)
+        return False
+
+
+def _load():
+    if _lib_state:
+        return _lib_state[0]
+    with _lock:
+        if _lib_state:
+            return _lib_state[0]
+        lib = None
+        if os.environ.get("TFOS_DISABLE_NATIVE") != "1":
+            if not os.path.exists(_LIB) or (
+                os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+            ):
+                _build()
+            if os.path.exists(_LIB):
+                try:
+                    lib = ctypes.CDLL(_LIB)
+                    u64p = ctypes.POINTER(ctypes.c_uint64)
+                    lib.tfr_write.restype = ctypes.c_long
+                    lib.tfr_write.argtypes = [
+                        ctypes.c_char_p, ctypes.c_char_p, u64p, ctypes.c_long]
+                    lib.tfr_index.restype = ctypes.c_long
+                    lib.tfr_index.argtypes = [
+                        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,
+                        ctypes.POINTER(u64p), ctypes.POINTER(u64p)]
+                    lib.tfr_free.argtypes = [ctypes.c_void_p]
+                    lib.tfr_masked_crc.restype = ctypes.c_uint
+                    lib.tfr_masked_crc.argtypes = [
+                        ctypes.c_char_p, ctypes.c_uint64]
+                except OSError as e:  # built for another arch, etc.
+                    logger.info("native tfrecord codec load failed: %s", e)
+                    lib = None
+        _lib_state.append(lib)
+        return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def masked_crc(data: bytes) -> int:
+    return _load().tfr_masked_crc(data, len(data))
+
+
+def write_records(path: str, records) -> int:
+    """One C call per file: payloads are concatenated host-side."""
+    lib = _load()
+    records = [bytes(r) for r in records]
+    blob = b"".join(records)
+    n = len(records)
+    lengths = (ctypes.c_uint64 * n)(*[len(r) for r in records])
+    # fresh file semantics (tfr_write appends, matching Hadoop part writers)
+    if os.path.exists(path):
+        os.remove(path)
+    written = lib.tfr_write(path.encode(), blob, lengths, n)
+    if written != n:
+        raise IOError(f"native TFRecord write to {path} failed")
+    return written
+
+
+def read_records(path: str, verify: bool = True):
+    """Read the file once, index+verify in C, slice payloads in Python."""
+    lib = _load()
+    with open(path, "rb") as f:
+        buf = f.read()
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    offsets, lengths = u64p(), u64p()
+    n = lib.tfr_index(buf, len(buf), int(verify),
+                      ctypes.byref(offsets), ctypes.byref(lengths))
+    if n == -1:
+        raise IOError(f"{path}: corrupt record crc")
+    if n == -2:
+        raise IOError(f"{path}: truncated record")
+    try:
+        for i in range(n):
+            off, length = offsets[i], lengths[i]
+            yield buf[off:off + length]
+    finally:
+        lib.tfr_free(offsets)
+        lib.tfr_free(lengths)

@@ -84,19 +84,22 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     l0 = jnp.zeros((b, h, sq), dtype=jnp.float32)
     o0 = jnp.zeros(q.shape, dtype=jnp.float32)
     qf = q.astype(jnp.float32)
-    maskb0 = jnp.ones((b, sk), bool) if kv_mask is None else kv_mask.astype(bool)
+    masked = kv_mask is not None  # trace-time: unmasked ring carries/permutes
+    # no mask and skips the mask wheres entirely (packed fast path)
 
     def body(i, carry):
         m, l, o, kb, vb, maskb = carry
         src = (rank - i) % n
         m, l, o = _block_attn(qf, kb.astype(jnp.float32), vb.astype(jnp.float32),
                               m, l, o, rank * sq, src * sk, causal, scale,
-                              kv_mask=maskb)
+                              kv_mask=maskb if masked else None)
         kb = lax.ppermute(kb, axis_name, perm)
         vb = lax.ppermute(vb, axis_name, perm)
-        maskb = lax.ppermute(maskb, axis_name, perm)
+        if masked:
+            maskb = lax.ppermute(maskb, axis_name, perm)
         return m, l, o, kb, vb, maskb
 
+    maskb0 = kv_mask.astype(bool) if masked else jnp.zeros((b, 0), bool)
     m, l, o, _, _, _ = lax.fori_loop(0, n, body, (m0, l0, o0, k, v, maskb0))
     out = o / jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
@@ -142,8 +145,11 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     p = p / p.sum(axis=-1, keepdims=True)
     og = jnp.einsum("bhqk,bkhd->bqhd", p, vg.astype(jnp.float32))
     if kv_mask is not None:
-        # all-padding rows output 0, matching ring_attention (l = 0 there)
-        og = jnp.where(mask_g.any(-1)[:, None, None, None], og, 0.0)
+        # query rows with NO visible key (all-padding, or causal window
+        # fully padded) output 0, matching ring_attention (l = 0 there);
+        # visibility comes from s so causal ∧ kv_mask compose correctly
+        visible = (s > NEG_INF / 2).any(axis=-1)  # (B, H, Q)
+        og = jnp.where(visible.transpose(0, 2, 1)[..., None], og, 0.0)
 
     # reverse: split seq chunks back to their devices, gather head groups
     og = og.reshape(b, n, sq, h // n, d)
@@ -221,6 +227,8 @@ def local_attention(q, k, v, causal: bool = False, scale: float | None = None,
     p = p / p.sum(axis=-1, keepdims=True)
     o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     if kv_mask is not None:
-        # all-padding rows output 0, matching ring_attention (l = 0 there)
-        o = jnp.where(kv_mask.astype(bool).any(-1)[:, None, None, None], o, 0.0)
+        # query rows with NO visible key output 0, matching ring_attention
+        # (causal ∧ kv_mask compose via s; see ulysses_attention)
+        visible = (s > NEG_INF / 2).any(axis=-1)  # (B, H, Q)
+        o = jnp.where(visible.transpose(0, 2, 1)[..., None], o, 0.0)
     return o.astype(q.dtype)

@@ -84,22 +84,46 @@ def state_shardings(state: TrainState, param_shardings, mesh):
     """
     import jax
 
+    def _norm(path) -> tuple:
+        return tuple(
+            str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k))))
+            for k in path
+        )
+
+    # param tree path -> (shape, sharding): optax state trees (Adam mu/nu,
+    # momentum, …) embed the SAME sub-tree structure as params, so an opt
+    # leaf's path ends with its param's path
+    flat_params = jax.tree_util.tree_flatten_with_path(state.params)[0]
+    flat_shards = jax.tree_util.tree_leaves(
+        param_shardings, is_leaf=lambda x: hasattr(x, "spec")
+    )
+    by_path = {
+        _norm(path): (getattr(leaf, "shape", ()), shard)
+        for (path, leaf), shard in zip(flat_params, flat_shards)
+    }
+
     degraded = []
 
-    def _opt_leaf(leaf):
+    def _opt_leaf(path, leaf):
+        shape = getattr(leaf, "shape", ())
+        norm = _norm(path)
+        for i in range(len(norm)):  # longest param-path suffix wins
+            hit = by_path.get(norm[i:])
+            if hit and hit[0] == shape:
+                return hit[1]
         s = getattr(leaf, "sharding", None)
         if isinstance(s, jax.sharding.NamedSharding) and s.mesh == mesh:
             return s
         if getattr(leaf, "ndim", 0) > 0 and getattr(leaf, "size", 0) > 1:
-            degraded.append(getattr(leaf, "shape", ()))
+            degraded.append(shape)
         return mesh_lib.replicated(mesh)
 
-    opt_shardings = jax.tree_util.tree_map(_opt_leaf, state.opt_state)
+    opt_shardings = jax.tree_util.tree_map_with_path(_opt_leaf, state.opt_state)
     if degraded:
         logger.warning(
-            "%d non-scalar optimizer-state leaves carry no mesh sharding "
-            "(optimizer.init likely ran on uncommitted params) and will be "
-            "REPLICATED — ZeRO memory savings are lost for them; shapes: %s",
+            "%d non-scalar optimizer-state leaves match no param by tree "
+            "path and carry no mesh sharding; they will be REPLICATED "
+            "(ZeRO memory savings lost for them); shapes: %s",
             len(degraded), degraded[:5],
         )
     return TrainState(param_shardings, opt_shardings, mesh_lib.replicated(mesh))

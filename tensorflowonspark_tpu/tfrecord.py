@@ -1,0 +1,260 @@
+"""TFRecord file framing + ``tf.train.Example`` wire-format codec.
+
+Reference anchor: the reference reads/writes TFRecords through the external
+``tensorflow-hadoop`` connector jar (``dfutil.py`` →
+``org.tensorflow.hadoop.io.TFRecordFileOutputFormat``; ``SURVEY.md §2.2``) and
+TF's own proto classes.  This rebuild has neither a JVM connector nor a
+TensorFlow dependency, so both layers are implemented here:
+
+- **Framing**: every record is ``uint64le length ║ uint32le masked-crc32c of
+  the length bytes ║ payload ║ uint32le masked-crc32c of the payload`` —
+  byte-compatible with files written by TF/the Hadoop connector.  CRCs use
+  the C-accelerated ``google_crc32c`` wheel; a native C++ codec
+  (``tensorflowonspark_tpu/native``) is loaded via ctypes when built and
+  takes over bulk encode/decode.
+- **Example codec**: hand-rolled protobuf wire format for the fixed, frozen
+  ``tf.train.Example`` schema (Features map of BytesList/FloatList/Int64List)
+  — ~the only message TFoS ever exchanges, so no proto toolchain is needed.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Any, Iterable, Iterator
+
+import google_crc32c
+
+_MASK_DELTA = 0xA282EAD8
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = google_crc32c.value(data)
+    return ((crc >> 15) | (crc << 17)) + _MASK_DELTA & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# Record framing
+# ---------------------------------------------------------------------------
+
+
+def write_records(path: str, records: Iterable[bytes]) -> int:
+    """Write ``records`` to ``path`` in TFRecord framing; returns count."""
+    native = _native()
+    if native is not None:
+        return native.write_records(path, records)
+    n = 0
+    with open(path, "wb") as f:
+        for rec in records:
+            f.write(encode_record(rec))
+            n += 1
+    return n
+
+
+def encode_record(payload: bytes) -> bytes:
+    header = struct.pack("<Q", len(payload))
+    return b"".join([
+        header,
+        struct.pack("<I", _masked_crc(header)),
+        payload,
+        struct.pack("<I", _masked_crc(payload)),
+    ])
+
+
+def read_records(path: str, verify: bool = True) -> Iterator[bytes]:
+    """Yield record payloads from a TFRecord file."""
+    native = _native()
+    if native is not None:
+        yield from native.read_records(path, verify)
+        return
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if not header:
+                return
+            if len(header) < 12:
+                raise IOError(f"{path}: truncated record header")
+            (length,) = struct.unpack("<Q", header[:8])
+            (len_crc,) = struct.unpack("<I", header[8:12])
+            if verify and _masked_crc(header[:8]) != len_crc:
+                raise IOError(f"{path}: corrupt record length crc")
+            payload = f.read(length)
+            if len(payload) < length:
+                raise IOError(f"{path}: truncated record payload")
+            (data_crc,) = struct.unpack("<I", f.read(4))
+            if verify and _masked_crc(payload) != data_crc:
+                raise IOError(f"{path}: corrupt record data crc")
+            yield payload
+
+
+_NATIVE_STATE: list = []  # [module_or_None] once probed
+
+
+def _native():
+    """The C++ codec binding, if its shared library has been built."""
+    if not _NATIVE_STATE:
+        try:
+            from tensorflowonspark_tpu.native import tfrecord_native
+
+            _NATIVE_STATE.append(
+                tfrecord_native if tfrecord_native.available() else None
+            )
+        except Exception:
+            _NATIVE_STATE.append(None)
+    return _NATIVE_STATE[0]
+
+
+# ---------------------------------------------------------------------------
+# Protobuf wire-format primitives (for the frozen Example schema)
+# ---------------------------------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint(field << 3 | wire)
+
+
+def _len_delimited(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+# ---------------------------------------------------------------------------
+# tf.train.Example encode
+# ---------------------------------------------------------------------------
+
+#: feature kinds (the Feature oneof field numbers)
+BYTES_LIST, FLOAT_LIST, INT64_LIST = 1, 2, 3
+
+
+def encode_example(features: dict[str, tuple[int, list]]) -> bytes:
+    """``{name: (kind, values)}`` → serialized ``tf.train.Example`` bytes.
+
+    ``kind`` is one of :data:`BYTES_LIST` / :data:`FLOAT_LIST` /
+    :data:`INT64_LIST`; values are python bytes/float/int lists.
+    """
+    entries = []
+    for name, (kind, values) in sorted(features.items()):
+        if kind == BYTES_LIST:
+            body = b"".join(_len_delimited(1, v) for v in values)
+        elif kind == FLOAT_LIST:  # packed repeated float
+            packed = struct.pack(f"<{len(values)}f", *values)
+            body = _len_delimited(1, packed) if values else b""
+        elif kind == INT64_LIST:  # packed repeated varint
+            packed = b"".join(_varint(v & 0xFFFFFFFFFFFFFFFF) for v in values)
+            body = _len_delimited(1, packed) if values else b""
+        else:
+            raise ValueError(f"unknown feature kind {kind}")
+        feature_msg = _len_delimited(kind, body)
+        entry = _len_delimited(1, name.encode()) + _len_delimited(2, feature_msg)
+        entries.append(_len_delimited(1, entry))  # Features.feature map entry
+    features_msg = b"".join(entries)
+    return _len_delimited(1, features_msg)  # Example.features
+
+
+# ---------------------------------------------------------------------------
+# tf.train.Example decode
+# ---------------------------------------------------------------------------
+
+
+def decode_example(data: bytes) -> dict[str, tuple[int, list]]:
+    """Serialized ``tf.train.Example`` → ``{name: (kind, values)}``."""
+    features_msg = None
+    for field, wire, value in _iter_fields(data):
+        if field == 1 and wire == 2:
+            features_msg = value
+    out: dict[str, tuple[int, list]] = {}
+    if features_msg is None:
+        return out
+    for field, wire, entry in _iter_fields(features_msg):
+        if field != 1 or wire != 2:
+            continue
+        name, feature_msg = None, b""
+        for efield, ewire, evalue in _iter_fields(entry):
+            if efield == 1:
+                name = evalue.decode()
+            elif efield == 2:
+                feature_msg = evalue
+        if name is None:
+            continue
+        out[name] = _decode_feature(feature_msg)
+    return out
+
+
+def _decode_feature(feature_msg: bytes) -> tuple[int, list]:
+    for kind, wire, body in _iter_fields(feature_msg):
+        if kind == BYTES_LIST:
+            return kind, [v for f, w, v in _iter_fields(body) if f == 1]
+        if kind == FLOAT_LIST:
+            values: list = []
+            for f, w, v in _iter_fields(body):
+                if f != 1:
+                    continue
+                if w == 2:  # packed
+                    values.extend(struct.unpack(f"<{len(v) // 4}f", v))
+                else:  # unpacked fixed32
+                    values.append(struct.unpack("<f", v)[0])
+            return kind, values
+        if kind == INT64_LIST:
+            values = []
+            for f, w, v in _iter_fields(body):
+                if f != 1:
+                    continue
+                if w == 2:  # packed varints
+                    pos = 0
+                    while pos < len(v):
+                        n, pos = _read_varint(v, pos)
+                        values.append(_signed64(n))
+                else:
+                    values.append(_signed64(v))
+            return kind, values
+    return BYTES_LIST, []
+
+
+def _signed64(n: int) -> int:
+    return n - (1 << 64) if n >= 1 << 63 else n
+
+
+def _iter_fields(buf: bytes) -> Iterator[tuple[int, int, Any]]:
+    """Yield ``(field, wire_type, value)``; value is bytes for LEN fields,
+    int for varint, raw 4/8 bytes for fixed32/64."""
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            value, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            length, pos = _read_varint(buf, pos)
+            value = buf[pos:pos + length]
+            pos += length
+        elif wire == 5:
+            value = buf[pos:pos + 4]
+            pos += 4
+        elif wire == 1:
+            value = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, value

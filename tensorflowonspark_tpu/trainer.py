@@ -71,7 +71,11 @@ class Trainer:
         self.loss_fn = self.module_lib.make_loss_fn(self.model, self.config)
         self.forward_fn = self.module_lib.make_forward_fn(self.model, self.config)
 
-        example = self.module_lib.example_batch(self.config, batch_size=2)
+        # example batch sized to the data-parallel world so the compiled
+        # shardings divide evenly for any mesh (dp*fsdp may be odd)
+        data_world = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+        example = self.module_lib.example_batch(self.config,
+                                                batch_size=2 * data_world)
         init_args = _model_inputs(example)
 
         # abstract init → shardings from flax partitioning metadata

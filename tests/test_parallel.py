@@ -167,3 +167,48 @@ def test_masked_attention_matches_dense(impl):
     got = attn(q, k, v, kv_mask=kv_mask)
     want = ra.local_attention(q, k, v, kv_mask=kv_mask)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_masked_causal_attention_consistent():
+    """causal ∧ kv_mask: all three impls must agree, including query rows
+    whose visible window is fully padding (output 0, ring semantics)."""
+    mesh = build_mesh(MeshConfig(dp=2, sp=4))
+    rng = np.random.RandomState(5)
+    b, s, h, d = 2, 16, 4, 8
+    q, k, v = (jnp.asarray(rng.randn(b, s, h, d), jnp.float32) for _ in range(3))
+    kv_mask = jnp.asarray(rng.rand(b, s) > 0.3)
+    kv_mask = kv_mask.at[0, 0].set(False)  # query 0 row 0: empty causal window
+    want = ra.local_attention(q, k, v, causal=True, kv_mask=kv_mask)
+    np.testing.assert_allclose(np.asarray(want[0, 0]), 0.0)
+    for impl in ("ring", "ulysses"):
+        attn = ra.make_sharded_attention(mesh, causal=True, impl=impl)
+        got = attn(q, k, v, kv_mask=kv_mask)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, err_msg=impl)
+
+
+def test_opt_state_inherits_zero_sharding_from_host_params():
+    """create_train_state on HOST arrays: the structural path match must
+    still give Adam mu/nu the param's fsdp sharding (ZeRO preserved)."""
+    import optax
+
+    from tensorflowonspark_tpu.parallel.train import (
+        create_train_state,
+        state_shardings,
+    )
+
+    mesh = build_mesh(MeshConfig(dp=2, fsdp=4))
+    params = {"layer": {"kernel": np.zeros((16, 8), np.float32),
+                        "bias": np.zeros((8,), np.float32)}}
+    shardings = {"layer": {
+        "kernel": jax.NamedSharding(mesh, jax.sharding.PartitionSpec("fsdp")),
+        "bias": jax.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+    }}
+    state = create_train_state(params, optax.adamw(1e-3))
+    st_shard = state_shardings(state, shardings, mesh)
+    flat = jax.tree_util.tree_flatten_with_path(st_shard.opt_state)[0]
+    kernel_shards = [s for path, s in flat
+                     if any(getattr(k, "key", None) == "kernel" for k in path)]
+    assert kernel_shards, "no kernel-shaped opt leaves found"
+    for s in kernel_shards:
+        assert s.spec == jax.sharding.PartitionSpec("fsdp"), s.spec

@@ -1,0 +1,133 @@
+"""Spark ML pipeline layer: param protocol units + fit/transform end-to-end
+on the local substrate (SURVEY.md §4 — test/test_pipeline.py analogue)."""
+
+import sys
+
+import cloudpickle
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import pipeline
+from tensorflowonspark_tpu.pipeline import TFEstimator, TFModel
+from tensorflowonspark_tpu.sparkapi import LocalSparkContext
+from tensorflowonspark_tpu.sparkapi.sql import LocalSparkSession
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+# ---------------------------------------------------------------------------
+# Param protocol units
+# ---------------------------------------------------------------------------
+
+
+def test_params_set_get_chain_and_defaults():
+    est = TFEstimator(train_fn=lambda a, c: None)
+    assert est.getBatchSize() == 100  # default
+    assert est.setBatchSize(32).setEpochs(3) is est  # chaining
+    assert est.getBatchSize() == 32
+    assert est.getEpochs() == 3
+    with pytest.raises(KeyError):
+        est._set("not_a_param", 1)
+
+
+def test_copy_values_to_model():
+    est = TFEstimator(train_fn=lambda a, c: None)
+    est.setBatchSize(7).setExportDir("/tmp/x").setEpochs(5)
+    model = TFModel()
+    est._copyValues(model)
+    assert model.getBatchSize() == 7
+    assert model.getExportDir() == "/tmp/x"
+    # epochs is an estimator-only param: not copied, not gettable on model
+    with pytest.raises(KeyError):
+        model.getOrDefault("epochs")
+
+
+def test_merge_args_tf_args_wins():
+    est = TFEstimator(train_fn=lambda a, c: None,
+                      tf_args={"batch_size": 64, "custom_flag": True})
+    est.setBatchSize(32).setModelDir("/m")
+    args = est.merge_args()
+    assert args.batch_size == 64  # tf_args overrides the param
+    assert args.custom_flag is True
+    assert args.model_dir == "/m"
+
+
+# ---------------------------------------------------------------------------
+# fit/transform end-to-end
+# ---------------------------------------------------------------------------
+
+
+def mnist_train_fun(args, ctx):
+    """Estimator map_fun: train mnist-tiny from the Spark feed, chief
+    exports the params pytree to ``args.export_dir``."""
+    from tensorflowonspark_tpu import util
+
+    util.ensure_jax_platform()
+    import numpy as np
+
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    trainer = Trainer("mnist_mlp", config=mnist.Config.tiny())
+    feed = ctx.get_data_feed(train_mode=True, input_mapping=["image", "label"])
+    steps = 0
+    while not feed.should_stop():
+        batch = feed.next_batch(args.batch_size)
+        if not batch or batch["image"].shape[0] != args.batch_size:
+            continue  # drop_remainder: keep one compiled shape
+        trainer.step({"image": batch["image"].astype(np.float32),
+                      "label": batch["label"].astype(np.int32)})
+        steps += 1
+    ctx.mgr.set("steps", steps)
+    if ctx.job_name == "chief":
+        from tensorflowonspark_tpu import compat
+
+        compat.export_saved_model({"params": trainer.params}, args.export_dir)
+
+
+def _mnist_df(spark, n=256, parts=2, seed=0):
+    rng = np.random.RandomState(seed)
+    rows = [
+        (rng.rand(64).astype(np.float64).tolist(), int(rng.randint(0, 10)))
+        for _ in range(n)
+    ]
+    df = spark.createDataFrame(rows, ["image", "label"])
+    return df.repartition(parts)
+
+
+def test_estimator_fit_then_model_transform(tmp_path):
+    sc = LocalSparkContext("local-cluster[2,1,1024]", "pipeline-test")
+    spark = LocalSparkSession(sc)
+    export_dir = str(tmp_path / "export")
+    try:
+        est = (TFEstimator(mnist_train_fun)
+               .setClusterSize(2)
+               .setBatchSize(32)
+               .setEpochs(2)
+               .setExportDir(export_dir)
+               .setModelName("mnist_mlp"))
+        model = est.fit(_mnist_df(spark))
+        assert isinstance(model, TFModel)
+        assert model.getExportDir() == export_dir
+        assert model.getModelName() == "mnist_mlp"
+
+        infer_df = _mnist_df(spark, n=32, parts=2, seed=1)
+        model.setBatchSize(16).setInputMapping({"image": "image"})
+        out = model.transform(infer_df)
+        assert "prediction" in out.columns
+        rows = out.collect()
+        assert len(rows) == 32
+        for r in rows:
+            assert len(r.prediction) == 10  # logits over 10 classes
+    finally:
+        sc.stop()
+
+
+def test_get_meta_graph_def_lists_export(tmp_path):
+    from tensorflowonspark_tpu import compat
+
+    state = {"params": {"w": np.zeros((3, 2), np.float32)}}
+    export_dir = str(tmp_path / "exp")
+    compat.export_saved_model(state, export_dir)
+    meta = pipeline.get_meta_graph_def(export_dir)
+    assert meta == {"params/w": {"shape": (3, 2), "dtype": "float32"}}

@@ -1,0 +1,55 @@
+"""TFParallel: N independent single-node instances, no cluster
+(SURVEY.md §2.1 — TFParallel.py)."""
+
+import os
+import sys
+
+import cloudpickle
+import pytest
+
+from tensorflowonspark_tpu import TFParallel
+from tensorflowonspark_tpu.sparkapi import LocalSparkContext
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+def solo_fun(args, ctx):
+    """Write one marker file per instance proving ctx wiring + JAX works."""
+    from tensorflowonspark_tpu import util
+
+    util.ensure_jax_platform()
+    import jax
+    import jax.numpy as jnp
+
+    assert ctx.cluster_spec is None and ctx.mgr is None  # truly solo
+    y = float(jax.jit(lambda x: (x * 2).sum())(jnp.arange(4.0)))
+    with open(os.path.join(args["out_dir"], f"done_{ctx.executor_id}"),
+              "w", encoding="utf-8") as f:
+        f.write(f"{ctx.job_name}:{ctx.task_index}:{y}")
+
+
+def failing_solo_fun(args, ctx):
+    raise ValueError("solo instance failure")
+
+
+def test_parallel_instances_run_independently(tmp_path):
+    sc = LocalSparkContext("local-cluster[2,1,1024]", "tfparallel-test")
+    try:
+        TFParallel.run(sc, solo_fun, {"out_dir": str(tmp_path)},
+                       num_executors=2)
+        done = sorted(os.listdir(tmp_path))
+        assert done == ["done_0", "done_1"]
+        for i, name in enumerate(done):
+            content = open(tmp_path / name, encoding="utf-8").read()
+            assert content == f"worker:{i}:12.0"
+    finally:
+        sc.stop()
+
+
+def test_parallel_failure_propagates():
+    sc = LocalSparkContext("local-cluster[2,1,1024]", "tfparallel-fail")
+    try:
+        with pytest.raises(Exception, match="solo instance failure"):
+            TFParallel.run(sc, failing_solo_fun, {}, num_executors=2)
+    finally:
+        sc.stop()
