@@ -1,0 +1,137 @@
+"""BERT SQuAD fine-tune streamed from a Spark DataFrame — config #5.
+
+Reference anchor: **none exists in the reference** — this config comes from
+``BASELINE.json`` ("BERT-base SQuAD fine-tune streamed from Spark DataFrame,
+sharded over TPU pod").  The mesh axes come from the CLI: ``--dp/--fsdp/
+--sp/--tp`` map straight onto the named mesh; ``--sp > 1`` activates ring
+attention over ICI (sequence sharded across devices, K/V blocks rotating via
+``ppermute`` — long-context first-class).
+
+    python examples/bert/bert_squad.py --cluster_size 2 --tiny --sp 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _repo_root not in sys.path:
+    sys.path.insert(0, _repo_root)
+
+
+def map_fun(args, ctx):
+    from tensorflowonspark_tpu import util
+
+    util.ensure_jax_platform()
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.models import bert
+    from tensorflowonspark_tpu.parallel import distributed
+    from tensorflowonspark_tpu.parallel.mesh import MeshConfig
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    distributed.maybe_initialize(ctx)
+    config = bert.Config.tiny() if args.tiny else bert.Config(remat=True)
+    trainer = Trainer(
+        "bert", config=config,
+        mesh_config=MeshConfig(dp=args.dp, fsdp=args.fsdp, sp=args.sp,
+                               tp=args.tp),
+        optimizer=optax.adamw(args.lr, weight_decay=0.01),
+        zero=args.fsdp > 1 or ctx.num_ps > 0,  # num_ps parity: ZeRO mapping
+    )
+    feed = ctx.get_data_feed(
+        train_mode=True,
+        input_mapping=["input_ids", "token_type_ids", "attention_mask",
+                       "start_positions", "end_positions"],
+    )
+    loss, steps = None, 0
+    while not feed.should_stop():
+        batch = feed.next_batch(args.batch_size)
+        if not batch or batch["input_ids"].shape[0] != args.batch_size:
+            continue
+        loss = trainer.step({k: v.astype(np.int32) for k, v in batch.items()})
+        steps += 1
+    ctx.mgr.set("final_loss", float(loss) if loss is not None else None)
+    ctx.mgr.set("steps", steps)
+    ctx.mgr.set("mesh", dict(trainer.mesh.shape))
+    if args.model_dir and ctx.executor_id == 0:
+        from tensorflowonspark_tpu import compat
+
+        compat.export_saved_model(
+            {"params": trainer.params}, ctx.absolute_path(args.model_dir))
+
+
+def synth_squad(n: int, vocab: int, seq_len: int, seed: int = 0):
+    """Tokenised SQuAD-shaped rows (a real run plugs a tokenizer in here)."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    rows = []
+    for i in range(n):
+        length = rng.randint(seq_len // 2, seq_len + 1)
+        ids = np.zeros(seq_len, np.int64)
+        ids[:length] = rng.randint(5, vocab, size=length)
+        mask = (ids != 0).astype(np.int64)
+        types = np.zeros(seq_len, np.int64)
+        types[length // 2:length] = 1  # question | context halves
+        s = rng.randint(length // 2, length)
+        e = rng.randint(s, length)
+        rows.append((ids.tolist(), types.tolist(), mask.tolist(), int(s), int(e)))
+    return rows
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--cluster_size", type=int, default=2)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch_size", type=int, default=8)
+    p.add_argument("--seq_len", type=int, default=64)
+    p.add_argument("--lr", type=float, default=5e-5)
+    p.add_argument("--dp", type=int, default=-1)
+    p.add_argument("--fsdp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--num_samples", type=int, default=512)
+    p.add_argument("--model_dir", default=None)
+    p.add_argument("--tiny", action="store_true")
+    p.add_argument("--master", default=None)
+    args = p.parse_args(argv)
+
+    from tensorflowonspark_tpu import TFCluster, TFManager
+    from tensorflowonspark_tpu.models import bert
+    from tensorflowonspark_tpu.sparkapi import get_spark_context
+    from tensorflowonspark_tpu.sparkapi.sql import LocalSparkSession
+
+    sc = get_spark_context(
+        args.master or f"local-cluster[{args.cluster_size},1,1024]",
+        "bert-squad")
+    spark = LocalSparkSession(sc)
+
+    vocab = (bert.Config.tiny() if args.tiny else bert.Config()).vocab_size
+    df = spark.createDataFrame(
+        synth_squad(args.num_samples, vocab, args.seq_len),
+        ["input_ids", "token_type_ids", "attention_mask",
+         "start_positions", "end_positions"],
+    ).repartition(args.cluster_size)
+
+    cluster = TFCluster.run(
+        sc, map_fun, args, num_executors=args.cluster_size,
+        input_mode=TFCluster.InputMode.SPARK, master_node="chief",
+    )
+    cluster.train(df.rdd.map(list), num_epochs=args.epochs)
+    cluster.shutdown(grace_secs=120)
+
+    authkey = bytes.fromhex(cluster.cluster_meta["authkey_hex"])
+    for meta in cluster.cluster_info:
+        mgr = TFManager.connect(tuple(meta["addr"]), authkey)
+        print(f"node {meta['job_name']}:{meta['task_index']} "
+              f"loss={mgr.get('final_loss'):.4f} steps={mgr.get('steps')} "
+              f"mesh={mgr.get('mesh')}")
+    sc.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,143 @@
+"""CIFAR-10 CNN — InputMode.TENSORFLOW with TFRecords, acceptance config #2.
+
+Reference anchor: ``examples/cifar10`` (the reference's multi-GPU CNN with
+TFRecord input via ``MultiWorkerMirroredStrategy``; ``SURVEY.md §1 L6``).
+In TENSORFLOW input mode the Spark task blocks while the trainer reads its
+own data: each node lists the TFRecord part files and reads a
+``task_index``-strided shard (the file-level sharding the reference got from
+``tf.data`` auto-shard).  The MWMS collective path is the Trainer's mesh —
+gradients ``psum`` over the node's devices; multi-host meshes form when
+chips are present (``parallel.distributed``).
+
+Run (synthesises data, writes TFRecords, trains):
+
+    python examples/cifar10/cifar10_spark.py --cluster_size 2 --epochs 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+_repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _repo_root not in sys.path:
+    sys.path.insert(0, _repo_root)
+
+
+def map_fun(args, ctx):
+    """TENSORFLOW-mode trainer: read own TFRecord shard, train, export."""
+    from tensorflowonspark_tpu import util
+
+    util.ensure_jax_platform()
+    import glob as g
+
+    import numpy as np
+
+    from tensorflowonspark_tpu import tfrecord
+    from tensorflowonspark_tpu.models import cifar
+    from tensorflowonspark_tpu.parallel import distributed
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    distributed.maybe_initialize(ctx)
+    config = cifar.Config.tiny() if args.tiny else cifar.Config()
+    trainer = Trainer("cifar10_cnn", config=config, learning_rate=args.lr)
+
+    # file-level sharding: every node takes a strided slice of part files
+    files = sorted(g.glob(os.path.join(args.data_dir, "part-*")))
+    shard = files[ctx.task_index::ctx.num_workers]
+    side = config.image_size
+
+    def batches():
+        for epoch in range(args.epochs):
+            images, labels = [], []
+            for path in shard:
+                for payload in tfrecord.read_records(path):
+                    ex = tfrecord.decode_example(payload)
+                    images.append(np.asarray(ex["image"][1], np.float32)
+                                  .reshape(side, side, 3))
+                    labels.append(ex["label"][1][0])
+                    if len(images) == args.batch_size:
+                        yield {"image": np.stack(images) / 255.0,
+                               "label": np.asarray(labels, np.int32)}
+                        images, labels = [], []
+
+    loss, steps = None, 0
+    for batch in batches():
+        loss = trainer.step(batch)
+        steps += 1
+    ctx.mgr.set("final_loss", float(loss) if loss is not None else None)
+    ctx.mgr.set("steps", steps)
+    ctx.mgr.set("shard_files", [os.path.basename(f) for f in shard])
+    if args.model_dir and ctx.executor_id == 0:
+        from tensorflowonspark_tpu import compat
+
+        compat.export_saved_model(
+            {"params": trainer.params}, ctx.absolute_path(args.model_dir))
+
+
+def prep_tfrecords(spark, data_dir: str, n: int, parts: int, side: int,
+                   seed: int = 0) -> None:
+    """Synthesise CIFAR-shaped data and write it as TFRecord part files."""
+    import numpy as np
+
+    from tensorflowonspark_tpu import dfutil
+
+    rng = np.random.default_rng(seed)
+    protos = rng.normal(size=(10, side * side * 3)) * 40 + 128
+    labels = rng.integers(0, 10, size=n)
+    images = np.clip(protos[labels] + rng.normal(size=(n, side * side * 3)) * 25,
+                     0, 255)
+    rows = [(images[i].astype(np.float64).tolist(), int(labels[i]))
+            for i in range(n)]
+    df = spark.createDataFrame(rows, ["image", "label"]).repartition(parts)
+    dfutil.saveAsTFRecords(df, data_dir)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--cluster_size", type=int, default=2)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch_size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--num_samples", type=int, default=2048)
+    p.add_argument("--data_dir", default="/tmp/cifar10_tfr")
+    p.add_argument("--model_dir", default=None)
+    p.add_argument("--tiny", action="store_true",
+                   help="tiny model + 8x8 images (CI-sized)")
+    p.add_argument("--master", default=None)
+    args = p.parse_args(argv)
+
+    from tensorflowonspark_tpu import TFCluster, TFManager
+    from tensorflowonspark_tpu.models import cifar
+    from tensorflowonspark_tpu.sparkapi import get_spark_context
+    from tensorflowonspark_tpu.sparkapi.sql import LocalSparkSession
+
+    sc = get_spark_context(
+        args.master or f"local-cluster[{args.cluster_size},1,1024]",
+        "cifar10-spark")
+
+    side = (cifar.Config.tiny() if args.tiny else cifar.Config()).image_size
+    if not glob.glob(os.path.join(args.data_dir, "part-*")):
+        prep_tfrecords(LocalSparkSession(sc), args.data_dir,
+                       args.num_samples, args.cluster_size * 2, side)
+
+    # TENSORFLOW mode: bootstrap tasks block until map_fun returns
+    cluster = TFCluster.run(
+        sc, map_fun, args, num_executors=args.cluster_size,
+        input_mode=TFCluster.InputMode.TENSORFLOW, master_node="chief",
+    )
+    cluster.shutdown(grace_secs=120)
+
+    authkey = bytes.fromhex(cluster.cluster_meta["authkey_hex"])
+    for meta in cluster.cluster_info:
+        mgr = TFManager.connect(tuple(meta["addr"]), authkey)
+        print(f"node {meta['job_name']}:{meta['task_index']} "
+              f"loss={mgr.get('final_loss'):.4f} steps={mgr.get('steps')} "
+              f"shard={mgr.get('shard_files')}")
+    sc.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,128 @@
+"""Criteo wide-and-deep via the Spark ML pipeline — acceptance config #4.
+
+Reference anchor: the estimator-era wide&deep example (``SURVEY.md §1 L6``)
+driven through ``pipeline.py::TFEstimator`` exactly as the reference's
+pipeline tests do: ``TFEstimator(train_fn).fit(df)`` trains from the
+DataFrame feed, ``TFModel.transform(df)`` scores it back into a DataFrame
+(per-executor cached jitted apply).
+
+    python examples/criteo/criteo_pipeline.py --cluster_size 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _repo_root not in sys.path:
+    sys.path.insert(0, _repo_root)
+
+
+def train_fun(args, ctx):
+    """Per-node wide&deep trainer fed by the DataFrame partitions."""
+    from tensorflowonspark_tpu import util
+
+    util.ensure_jax_platform()
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.models import widedeep
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    config = widedeep.Config.tiny() if args.tiny else widedeep.Config()
+    trainer = Trainer("wide_deep", config=config,
+                      optimizer=optax.adagrad(args.lr))  # CTR-standard opt
+    feed = ctx.get_data_feed(train_mode=True,
+                             input_mapping=["dense", "cat", "label"])
+    loss, steps = None, 0
+    while not feed.should_stop():
+        batch = feed.next_batch(args.batch_size)
+        if not batch or batch["dense"].shape[0] != args.batch_size:
+            continue
+        loss = trainer.step({
+            "dense": batch["dense"].astype(np.float32),
+            "cat": batch["cat"].astype(np.int32),
+            "label": batch["label"].astype(np.int32),
+        })
+        steps += 1
+    ctx.mgr.set("final_loss", float(loss) if loss is not None else None)
+    ctx.mgr.set("steps", steps)
+    if ctx.job_name == "chief":
+        from tensorflowonspark_tpu import compat
+
+        compat.export_saved_model(
+            {"params": trainer.params}, ctx.absolute_path(args.export_dir))
+
+
+def synth_criteo(n: int, buckets: int, seed: int = 0):
+    """Criteo-shaped rows with a learnable click signal."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.models.widedeep import NUM_CAT, NUM_DENSE
+
+    rng = np.random.RandomState(seed)
+    dense = rng.rand(n, NUM_DENSE)
+    cat = rng.randint(0, buckets, size=(n, NUM_CAT))
+    # clicks driven by dense[0] and one categorical bucket parity
+    logit = 3.0 * (dense[:, 0] - 0.5) + (cat[:, 0] % 2) - 0.5
+    label = (1 / (1 + np.exp(-logit)) > rng.rand(n)).astype(int)
+    return [
+        (dense[i].tolist(), cat[i].tolist(), int(label[i])) for i in range(n)
+    ]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--cluster_size", type=int, default=2)
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--batch_size", type=int, default=128)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--num_samples", type=int, default=4096)
+    p.add_argument("--export_dir", default="/tmp/criteo_export")
+    p.add_argument("--tiny", action="store_true", default=True)
+    p.add_argument("--full", dest="tiny", action="store_false")
+    p.add_argument("--master", default=None)
+    args = p.parse_args(argv)
+
+    from tensorflowonspark_tpu.models import widedeep
+    from tensorflowonspark_tpu.pipeline import TFEstimator
+    from tensorflowonspark_tpu.sparkapi import get_spark_context
+    from tensorflowonspark_tpu.sparkapi.sql import LocalSparkSession
+
+    sc = get_spark_context(
+        args.master or f"local-cluster[{args.cluster_size},1,1024]",
+        "criteo-pipeline")
+    spark = LocalSparkSession(sc)
+
+    buckets = (widedeep.Config.tiny() if args.tiny
+               else widedeep.Config()).hash_buckets
+    df = spark.createDataFrame(
+        synth_criteo(args.num_samples, buckets), ["dense", "cat", "label"]
+    ).repartition(args.cluster_size)
+
+    est = (TFEstimator(train_fun, tf_args=args)
+           .setClusterSize(args.cluster_size)
+           .setBatchSize(args.batch_size)
+           .setEpochs(args.epochs)
+           .setExportDir(args.export_dir)
+           .setModelName("wide_deep"))
+    model = est.fit(df)
+
+    scored = (model
+              .setBatchSize(256)
+              .setInputMapping({"dense": "dense", "cat": "cat"})
+              .setOutputMapping({"prediction": "ctr"})
+              .transform(df.select("dense", "cat")))
+    rows = scored.collect()
+    import numpy as np
+
+    ctrs = np.asarray([r.ctr for r in rows])
+    print(f"scored {len(rows)} rows; ctr mean={ctrs.mean():.3f} "
+          f"min={ctrs.min():.3f} max={ctrs.max():.3f}")
+    sc.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""ImageNet ResNet-50 data-parallel training — acceptance config #3.
+
+Reference anchor: ``examples/imagenet`` (Inception/ResNet DP across
+executors; ``SURVEY.md §1 L6``).  Each executor hosts one slice-local mesh
+(multi-host when chips are present via ``jax.distributed``); the batch
+shards over dp, gradients ``psum`` over ICI — the reference's
+near-linear-scaling claim is the scenario this reproduces on TPU.
+
+Reports per-node step throughput, the headline ``BASELINE.json`` metric.
+
+    python examples/imagenet/resnet_spark.py --cluster_size 2 --tiny
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _repo_root not in sys.path:
+    sys.path.insert(0, _repo_root)
+
+
+def map_fun(args, ctx):
+    from tensorflowonspark_tpu import util
+
+    util.ensure_jax_platform()
+    import time
+
+    import jax
+
+    from tensorflowonspark_tpu.models import resnet
+    from tensorflowonspark_tpu.parallel import distributed
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    distributed.maybe_initialize(ctx)
+    config = resnet.Config.tiny() if args.tiny else resnet.Config()
+    trainer = Trainer("resnet50", config=config, learning_rate=args.lr)
+
+    # synthetic ImageNet-shaped shard (TFRecord/imagenet readers plug in via
+    # --data_dir once real data is mounted; the compute path is identical)
+    batch = resnet.example_batch(config, batch_size=args.batch_size,
+                                 seed=ctx.task_index)
+    device_batch = trainer.shard(batch)
+
+    state, loss = trainer.state, None
+    for _ in range(args.warmup):
+        state, loss = trainer.train_step(state, device_batch)
+    if loss is not None:
+        jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, loss = trainer.train_step(state, device_batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    trainer.state = state
+
+    ips = args.steps * args.batch_size / dt
+    ctx.mgr.set("images_per_sec", round(ips, 2))
+    ctx.mgr.set("final_loss", float(loss))
+    if args.model_dir and ctx.executor_id == 0:
+        from tensorflowonspark_tpu import compat
+
+        compat.export_saved_model(
+            {"params": trainer.params}, ctx.absolute_path(args.model_dir))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--cluster_size", type=int, default=2)
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--model_dir", default=None)
+    p.add_argument("--tiny", action="store_true")
+    p.add_argument("--master", default=None)
+    args = p.parse_args(argv)
+
+    from tensorflowonspark_tpu import TFCluster, TFManager
+    from tensorflowonspark_tpu.sparkapi import get_spark_context
+
+    sc = get_spark_context(
+        args.master or f"local-cluster[{args.cluster_size},1,1024]",
+        "resnet-spark")
+    cluster = TFCluster.run(
+        sc, map_fun, args, num_executors=args.cluster_size,
+        input_mode=TFCluster.InputMode.TENSORFLOW, master_node="chief",
+    )
+    cluster.shutdown(grace_secs=600)
+
+    authkey = bytes.fromhex(cluster.cluster_meta["authkey_hex"])
+    total = 0.0
+    for meta in cluster.cluster_info:
+        mgr = TFManager.connect(tuple(meta["addr"]), authkey)
+        ips = mgr.get("images_per_sec")
+        total += ips
+        print(f"node {meta['job_name']}:{meta['task_index']} "
+              f"{ips} images/sec (loss {mgr.get('final_loss'):.3f})")
+    print(f"cluster total: {total:.2f} images/sec")
+    sc.stop()
+
+
+if __name__ == "__main__":
+    main()

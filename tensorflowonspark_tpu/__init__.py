@@ -18,6 +18,9 @@ Public surface mirrors the reference package:
   (``run/train/inference/shutdown``), ``InputMode``.
 - :mod:`tensorflowonspark_tpu.TFNode` — in-``map_fun`` helpers
   (``DataFeed``, ``hdfs_path``, ``start_cluster_server``).
+- :mod:`tensorflowonspark_tpu.pipeline` — Spark ML ``TFEstimator``/``TFModel``.
+- :mod:`tensorflowonspark_tpu.dfutil` — DataFrame↔TFRecord conversion.
+- :mod:`tensorflowonspark_tpu.TFParallel` — independent single-node runs.
 """
 
 __version__ = "0.1.0"

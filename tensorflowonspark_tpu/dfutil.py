@@ -38,22 +38,26 @@ def toTFExample(dtypes: list[tuple[str, str]]):
 class _ToTFExample:
     def __init__(self, dtypes: list[tuple[str, str]]):
         self.dtypes = [(name, str(dt)) for name, dt in dtypes]
+        self.index = {name: i for i, (name, _) in enumerate(self.dtypes)}
 
     def __call__(self, iterator) -> Iterable[bytes]:
         for row in iterator:
-            yield encode_row(row, self.dtypes)
+            yield encode_row(row, self.dtypes, self.index)
 
 
-def encode_row(row, dtypes: list[tuple[str, str]]) -> bytes:
+def encode_row(row, dtypes: list[tuple[str, str]],
+               index: dict[str, int] | None = None) -> bytes:
+    if index is None:
+        index = {name: i for i, (name, _) in enumerate(dtypes)}
+    by_position = isinstance(row, (list, tuple))
     features: dict[str, tuple[int, list]] = {}
     for name, dt in dtypes:
-        value = row[name] if not isinstance(row, (list, tuple)) else row[
-            [n for n, _ in dtypes].index(name)]
+        value = row[index[name]] if by_position else row[name]
         elem = dt[6:-1] if dt.startswith("array<") else dt
         values = list(value) if dt.startswith("array<") else [value]
         if elem in ("tinyint", "smallint", "int", "bigint", "long", "boolean"):
             features[name] = (tfrecord.INT64_LIST, [int(v) for v in values])
-        elif elem in ("float", "double", "decimal"):
+        elif elem in ("float", "double") or elem.startswith("decimal"):
             features[name] = (tfrecord.FLOAT_LIST, [float(v) for v in values])
         elif elem == "string":
             features[name] = (tfrecord.BYTES_LIST,
@@ -165,7 +169,13 @@ def loadTFRecords(sc, input_dir: str,
     )
     if not files:
         raise FileNotFoundError(f"no TFRecord part files in {input_dir}")
-    sample = next(iter(tfrecord.read_records(files[0])))
+    sample = None  # first file may be an empty partition's part file
+    for f in files:
+        sample = next(iter(tfrecord.read_records(f)), None)
+        if sample is not None:
+            break
+    if sample is None:
+        raise ValueError(f"all TFRecord part files in {input_dir} are empty")
     schema = infer_schema(sample, binary_features)
     rows = sc.parallelize(files, len(files)).mapPartitions(
         _LoadPartition(binary_features)

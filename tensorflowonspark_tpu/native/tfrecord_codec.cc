@@ -117,12 +117,14 @@ long tfr_index(const unsigned char* buf, unsigned long long size, int verify,
   if (!offs || !lens) { free(offs); free(lens); return -1; }
   uint64_t pos = 0;
   while (pos < size) {
-    if (size - pos < 12) { free(offs); free(lens); return -2; }
+    uint64_t avail = size - pos;
+    if (avail < 12) { free(offs); free(lens); return -2; }
     uint64_t len = get_u64le(buf + pos);
     if (verify && masked_crc(buf + pos, 8) != get_u32le(buf + pos + 8)) {
       free(offs); free(lens); return -1;
     }
-    if (size - pos - 12 < len + 4) { free(offs); free(lens); return -2; }
+    // overflow-safe: a huge/garbage len must not wrap the arithmetic
+    if (avail < 16 || len > avail - 16) { free(offs); free(lens); return -2; }
     const uint8_t* payload = buf + pos + 12;
     if (verify && masked_crc(payload, len) != get_u32le(payload + len)) {
       free(offs); free(lens); return -1;

@@ -250,11 +250,12 @@ class TFEstimator(TFParams, HasBatchSize, HasEpochs, HasSteps, HasClusterSize,
 # Model (transformer)
 # ---------------------------------------------------------------------------
 
-#: per-executor-process singleton: {export_dir: (predict_fn, params)}
+#: per-executor-process singleton: {cache_key: (predict_fn, params)}
 #: (reference anchor: the ``global_sess``-style cache in
 #: ``pipeline.py::_run_model`` — one loaded model per executor, reused
-#: across partitions)
-_MODEL_CACHE: dict[str, tuple[Callable, Any]] = {}
+#: across partitions).  The key includes the apply-fn source and the
+#: checkpoint mtime so changing the model or re-exporting invalidates it.
+_MODEL_CACHE: dict[tuple, tuple[Callable, Any]] = {}
 
 
 class TFModel(TFParams, HasBatchSize, HasInputMapping, HasOutputMapping,
@@ -280,7 +281,8 @@ class TFModel(TFParams, HasBatchSize, HasInputMapping, HasOutputMapping,
     def _transform(self, df):
         from tensorflowonspark_tpu.sparkapi.sql import (
             DataFrame,
-            Row,
+            StructField,
+            StructType,
             infer_schema,
         )
 
@@ -297,9 +299,16 @@ class TFModel(TFParams, HasBatchSize, HasInputMapping, HasOutputMapping,
             output_mapping=self.getOrDefault("output_mapping"),
             columns=df.columns,
         )
-        out_rdd = df.rdd.mapPartitions(run_model)
-        first = out_rdd.first()
-        return DataFrame(out_rdd, infer_schema(first))
+        # materialize once: the local substrate has no RDD cache, and a lazy
+        # first()-for-schema would re-run partition 0's inference on every
+        # downstream action
+        rows = df.rdd.mapPartitions(run_model).collect()
+        if not rows:
+            out_names = list((self.getOrDefault("output_mapping") or
+                              {"prediction": "prediction"}).values())
+            empty = StructType([StructField(n, "double") for n in out_names])
+            return DataFrame(_rdd_of(df, []), empty)
+        return DataFrame(_rdd_of(df, rows), infer_schema(rows[0]))
 
 
 class _RunModel:
@@ -323,17 +332,25 @@ class _RunModel:
     # -- executor-side ------------------------------------------------------
 
     def _load(self):
-        if self.export_dir in _MODEL_CACHE:
-            return _MODEL_CACHE[self.export_dir]
-        single_node_env()
         import os
-
-        from tensorflowonspark_tpu import ckpt
 
         path = self.export_dir
         model_sub = os.path.join(path, "model")
         if "://" not in path and os.path.isdir(model_sub):
             path = model_sub  # layout written by compat.export_saved_model
+        mtime = 0.0
+        if "://" not in path:
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                pass
+        fn_id = getattr(self.predict_fn, "__qualname__", self.model_name)
+        key = (path, fn_id, mtime)
+        if key in _MODEL_CACHE:
+            return _MODEL_CACHE[key]
+        single_node_env()
+        from tensorflowonspark_tpu import ckpt
+
         state = ckpt.load_pytree(path)
         params = state.get("params", state) if isinstance(state, dict) else state
 
@@ -351,7 +368,7 @@ class _RunModel:
         else:
             raise ValueError("TFModel needs model_name or predict_fn")
         logger.info("executor loaded model from %s", self.export_dir)
-        _MODEL_CACHE[self.export_dir] = (fn, params)
+        _MODEL_CACHE[key] = (fn, params)
         return fn, params
 
     def __call__(self, iterator):
@@ -497,3 +514,10 @@ def _spark_context_of(df):
     if sc is None:
         raise ValueError("cannot find SparkContext on DataFrame.rdd")
     return sc
+
+
+def _rdd_of(df, rows):
+    """Parallelize materialized result rows, keeping df's partition count."""
+    return _spark_context_of(df).parallelize(
+        rows, max(1, df.rdd.getNumPartitions())
+    )

@@ -156,3 +156,35 @@ def test_native_codec_matches_python(tmp_path):
     open(bad, "wb").write(bytes(raw))
     with pytest.raises(IOError, match="corrupt|truncated"):
         list(tfrecord_native.read_records(bad))
+
+
+def test_native_index_rejects_garbage_length(tmp_path):
+    """A garbage 8-byte length (~2^64) must not wrap the bounds check."""
+    from tensorflowonspark_tpu.native import tfrecord_native
+
+    if not tfrecord_native.available():
+        pytest.skip("native codec unavailable")
+    bad = str(tmp_path / "garbage.tfrecord")
+    open(bad, "wb").write(struct.pack("<Q", 0xFFFFFFFFFFFFFFFD) + b"\x00" * 8)
+    for verify in (True, False):
+        with pytest.raises(IOError, match="corrupt|truncated"):
+            list(tfrecord_native.read_records(bad, verify=verify))
+
+
+def test_load_skips_empty_part_files(tmp_path):
+    sc = LocalSparkContext("local-cluster[2,1,1024]", "dfutil-empty")
+    spark = LocalSparkSession(sc)
+    out = str(tmp_path / "tfr")
+    try:
+        import os
+
+        os.makedirs(out)
+        tfrecord.write_records(os.path.join(out, "part-r-00000"), [])  # empty
+        df = spark.createDataFrame([(1, "a")], ["n", "s"])
+        dfutil.saveAsTFRecords(df, str(tmp_path / "tmp2"))
+        os.rename(os.path.join(str(tmp_path / "tmp2"), "part-r-00000"),
+                  os.path.join(out, "part-r-00001"))
+        df2 = dfutil.loadTFRecords(sc, out)
+        assert [(r.n, r.s) for r in df2.collect()] == [(1, "a")]
+    finally:
+        sc.stop()
