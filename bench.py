@@ -2,7 +2,15 @@
 
 Metric (``BASELINE.json::metric``): ImageNet ResNet-50 images/sec/chip on the
 sharded training step (`tensorflowonspark_tpu.trainer.Trainer`) — the same
-compiled path the Spark-cluster runtime drives on executors.
+compiled path the Spark-cluster runtime drives on executors.  Also reports
+**MFU** (model FLOPs utilization): compiled FLOPs/step (from XLA's own cost
+analysis, analytic fallback) × steps/sec ÷ aggregate peak chip FLOPs.
+
+Fail-soft by design: the measurement runs in a child process under a bounded
+timeout; if the primary (accelerator) attempt dies or hangs — e.g. the
+remote-compile service is down — the parent retries on the forced-CPU
+backend and, failing that too, still emits a parseable diagnostic JSON line
+and exits 0.  ``parsed`` is never null.
 
 The reference publishes no quantitative numbers (``BASELINE.json::published``
 is empty; see ``BASELINE.md``), so ``vs_baseline`` is reported against the
@@ -18,6 +26,8 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -31,15 +41,61 @@ TARGETS = {
     "cifar10_cnn": ("images/sec/chip", 20000.0),
 }
 
+# Peak dense bf16 FLOP/s per chip, keyed by a substring of device_kind.
+# (MFU is conventionally quoted against the bf16 matmul peak.)
+PEAK_FLOPS = [
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12), ("v5", 459e12),
+    ("v6", 918e12), ("trillium", 918e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+]
 
-def main() -> None:
+_PRIMARY_TIMEOUT_S = 900
+_FALLBACK_TIMEOUT_S = 420
+
+
+def _parse_args(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50", choices=sorted(TARGETS))
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--steps", type=int, default=None)
     p.add_argument("--warmup", type=int, default=3)
-    args = p.parse_args()
+    p.add_argument("--_measure", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--_force-cpu", action="store_true", help=argparse.SUPPRESS)
+    return p.parse_args(argv)
 
+
+def _peak_flops(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for key, peak in PEAK_FLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+def _analytic_flops(model: str, config, batch_size: int) -> float | None:
+    """Analytic FLOPs/step fallback when XLA cost analysis is n/a.
+
+    Train step ≈ 3× forward (fwd + 2× bwd).  Only the full-size configs the
+    constants were derived for are claimed; a tiny/test config returns None
+    rather than a number off by orders of magnitude.
+    """
+    if model == "resnet50" and getattr(config, "image_size", 0) == 224 and \
+            tuple(getattr(config, "stage_sizes", ())) == (3, 4, 6, 3):
+        return 3.0 * 8.2e9 * batch_size  # ~4.1 GMACs fwd per 224x224 image
+    return None
+
+
+def measure(args) -> dict:
+    """Run the timed measurement in-process and return the result dict."""
+    if args._force_cpu:
+        os.environ["TFOS_JAX_PLATFORM"] = "cpu"
+        os.environ.setdefault("TFOS_NUM_CHIPS", "0")
+    from tensorflowonspark_tpu import util
+
+    util.ensure_jax_platform()
     import jax
 
     from tensorflowonspark_tpu import models as model_zoo
@@ -51,51 +107,142 @@ def main() -> None:
 
     lib = model_zoo.get_model(args.model)
     config = lib.Config() if on_accel else lib.Config.tiny()
-    if args.batch_size is None:
-        args.batch_size = (128 if on_accel else 16) * max(1, n_chips)
-    if args.steps is None:
-        args.steps = 20 if on_accel else 5
+    batch_size = args.batch_size
+    if batch_size is None:
+        batch_size = (128 if on_accel else 16) * max(1, n_chips)
+    steps = args.steps
+    if steps is None:
+        steps = 20 if on_accel else 5
 
     print(
         f"bench: model={args.model} platform={platform} chips={n_chips} "
-        f"batch={args.batch_size} steps={args.steps}",
+        f"batch={batch_size} steps={steps}",
         file=sys.stderr,
     )
 
     trainer = Trainer(args.model, config=config)
-    batch = lib.example_batch(config, batch_size=args.batch_size)
+    batch = lib.example_batch(config, batch_size=batch_size)
     device_batch = trainer.shard(batch)  # input pipeline is measured separately
+
+    # AOT-compile ONCE and reuse the executable for both cost analysis and
+    # the timing loop (a separate .lower().compile() would not populate the
+    # jit dispatch cache and would double compile time).
+    step_fn = trainer.train_step
+    flops_per_step = None  # GLOBAL flops across all chips
+    try:
+        compiled = step_fn.lower(trainer.state, device_batch).compile()
+        step_fn = compiled
+        cost = compiled.cost_analysis()
+        if cost:
+            cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+            f = cost.get("flops")
+            if f and f > 0:
+                # cost_analysis reports the per-device (post-SPMD) program
+                flops_per_step = float(f) * n_chips
+    except Exception as e:  # AOT/cost analysis is best-effort on some backends
+        print(f"bench: AOT compile/cost_analysis unavailable ({e!r})",
+              file=sys.stderr)
+    if flops_per_step is None:
+        flops_per_step = _analytic_flops(args.model, config, batch_size)
 
     state = trainer.state
     loss = None
     for _ in range(args.warmup):
-        state, loss = trainer.train_step(state, device_batch)
+        state, loss = step_fn(state, device_batch)
     if loss is not None:
         jax.block_until_ready(loss)
 
     t0 = time.perf_counter()
-    for _ in range(args.steps):
-        state, loss = trainer.train_step(state, device_batch)
+    for _ in range(steps):
+        state, loss = step_fn(state, device_batch)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
 
-    steps_per_sec = args.steps / dt
-    examples_per_sec = steps_per_sec * args.batch_size
+    steps_per_sec = steps / dt
+    examples_per_sec = steps_per_sec * batch_size
     unit, target = TARGETS[args.model]
-    if unit == "steps/sec":
-        value = steps_per_sec
-    else:
-        value = examples_per_sec / n_chips
+    value = steps_per_sec if unit == "steps/sec" else examples_per_sec / n_chips
 
-    print(json.dumps({
+    peak = _peak_flops(jax.devices()[0].device_kind) if on_accel else None
+    mfu = None
+    if peak and flops_per_step:
+        mfu = flops_per_step * steps_per_sec / (peak * n_chips)
+
+    result = {
         "metric": f"{args.model}_{unit.replace('/', '_per_').replace('.', '')}",
         "value": round(value, 2),
         "unit": unit,
         "vs_baseline": round(value / target, 4),
         "platform": platform,
         "n_chips": n_chips,
-        "batch_size": args.batch_size,
+        "batch_size": batch_size,
         "loss": round(float(loss), 4),
+    }
+    if mfu is not None:
+        result["mfu"] = round(mfu, 4)
+    if flops_per_step is not None:
+        result["flops_per_step"] = flops_per_step
+    return result
+
+
+def _run_child(argv: list[str], timeout_s: int) -> dict | None:
+    """Run ``bench.py --_measure`` in a subprocess; return its JSON or None."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--_measure", *argv],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {"_error": f"timeout after {timeout_s}s"}
+    sys.stderr.write(proc.stderr[-4000:])
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    tail = (proc.stderr.strip().splitlines() or ["no output"])[-1]
+    return {"_error": f"rc={proc.returncode}: {tail[:400]}"}
+
+
+def main() -> None:
+    args = _parse_args()
+    if args._measure:
+        print(json.dumps(measure(args)))
+        return
+
+    passthrough = [f"--model={args.model}", f"--warmup={args.warmup}"]
+    if args.batch_size is not None:
+        passthrough.append(f"--batch-size={args.batch_size}")
+    if args.steps is not None:
+        passthrough.append(f"--steps={args.steps}")
+
+    result = _run_child(passthrough, _PRIMARY_TIMEOUT_S)
+    if result is not None and "_error" not in result:
+        print(json.dumps(result))
+        return
+
+    primary_error = (result or {}).get("_error", "no JSON from child")
+    print(f"bench: primary attempt failed ({primary_error}); "
+          "retrying on forced-CPU backend", file=sys.stderr)
+    fallback = _run_child(passthrough + ["--_force-cpu"], _FALLBACK_TIMEOUT_S)
+    if fallback is not None and "_error" not in fallback:
+        fallback["degraded"] = f"accelerator unavailable: {primary_error}"
+        print(json.dumps(fallback))
+        return
+
+    unit, _ = TARGETS[args.model]
+    print(json.dumps({
+        "metric": f"{args.model}_{unit.replace('/', '_per_').replace('.', '')}",
+        "value": 0.0,
+        "unit": unit,
+        "vs_baseline": 0.0,
+        "error": primary_error,
+        "fallback_error": (fallback or {}).get("_error", "no JSON from child"),
     }))
 
 

@@ -32,19 +32,36 @@ from typing import Any, Iterable
 # this module there; the callables below close over these globals).
 _queues: dict[str, _queue_mod.Queue] = {}
 _kv: dict[str, Any] = {}
+_maxsize: list[int] = [1024]
 
 
 def _setup(qnames: Iterable[str], maxsize: int) -> None:
+    _maxsize[0] = maxsize
     for name in qnames:
         _queues[name] = _queue_mod.Queue(maxsize)
 
 
 def _get_queue(qname: str) -> _queue_mod.Queue:
-    return _queues[qname]
+    # Per-partition-task result queues ("output:<tag>") are named by
+    # short-lived Spark tasks after the manager has started, so ":"-suffixed
+    # names create on demand.  Plain names keep the fail-fast KeyError — a
+    # typo ('inputs') must not become a silent empty queue that hangs get().
+    q = _queues.get(qname)
+    if q is None:
+        if ":" not in qname:
+            raise KeyError(qname)
+        q = _queues.setdefault(qname, _queue_mod.Queue(_maxsize[0]))
+    return q
 
 
 def _get_kv() -> dict[str, Any]:
     return _kv
+
+
+def _del_queue(qname: str) -> bool:
+    """Drop a dynamically-created queue (per-task result queues would
+    otherwise accumulate in the server process forever)."""
+    return _queues.pop(qname, None) is not None
 
 
 class _TFManagerBase(BaseManager):
@@ -53,6 +70,7 @@ class _TFManagerBase(BaseManager):
 
 _TFManagerBase.register("get_queue", callable=_get_queue)
 _TFManagerBase.register("get_kv", callable=_get_kv)
+_TFManagerBase.register("del_queue", callable=_del_queue)
 
 
 class TFManager:
@@ -76,6 +94,10 @@ class TFManager:
     def set(self, key: str, value: Any) -> None:
         """kv write. Reference anchor: ``TFManager.py::_set``."""
         self._kv().update({key: value})
+
+    def del_queue(self, qname: str) -> None:
+        """Remove a dynamically-created queue from the server."""
+        self._manager.del_queue(qname)
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -53,6 +53,14 @@ class DataFeed:
         self._queue_in = mgr.get_queue(qname_in)
         self._queue_out = mgr.get_queue(qname_out)
         self._buffer: list[Any] = []  # rows not yet returned
+        # provenance of buffered / handed-out rows, as [tag, count] runs in
+        # FIFO order (tag None = untagged feeder). batch_results uses
+        # _out_route to send each result to its feeding task's own queue —
+        # two concurrent partition tasks on one executor must not interleave
+        # (multi-slot executors; see marker.TaggedChunk)
+        self._buffer_tags: list[list] = []
+        self._out_route: list[list] = []
+        self._out_queues: dict[Any, Any] = {None: self._queue_out}
 
     # -- input -------------------------------------------------------------
 
@@ -76,12 +84,20 @@ class DataFeed:
                 # EndPartition / generic marker: release what we have (the
                 # feeder's partition ended); empty buffer yields empty batch
                 break
+            elif isinstance(item, marker.TaggedChunk):
+                self._buffer.extend(item.rows)
+                self._note_rows(self._buffer_tags, item.tag, len(item.rows))
+                if len(self._buffer) >= batch_size:
+                    break
             else:
-                self._buffer.extend(item if isinstance(item, list) else [item])
+                rows = item if isinstance(item, list) else [item]
+                self._buffer.extend(rows)
+                self._note_rows(self._buffer_tags, None, len(rows))
                 if len(self._buffer) >= batch_size:
                     break
         rows = self._buffer[:batch_size]
         self._buffer = self._buffer[batch_size:]
+        self._consume_tags(len(rows))
         return self._columnarize(rows, device_put)
 
     def should_stop(self) -> bool:
@@ -93,11 +109,25 @@ class DataFeed:
     def batch_results(self, results: Iterable[Any]) -> None:
         """Push one batch of inference results back to the Spark side.
 
-        Reference anchor: ``TFNode.py::DataFeed.batch_results``.
+        Reference anchor: ``TFNode.py::DataFeed.batch_results``.  Results
+        are routed positionally back to the task that fed the matching input
+        rows (one result per row, the reference's inference contract): the
+        i-th result goes to the queue of the i-th consumed row's feeder.
         """
         results = list(results)
-        if results:
-            self._queue_out.put(results)
+        i = 0
+        while i < len(results) and self._out_route:
+            tag, count = self._out_route[0]
+            n = min(count, len(results) - i)
+            self._route_queue(tag).put(results[i:i + n])
+            i += n
+            if n == count:
+                self._out_route.pop(0)
+                self._forget_tag(tag)
+            else:
+                self._out_route[0][1] = count - n
+        if i < len(results):  # surplus (no matching inputs): default queue
+            self._queue_out.put(results[i:])
 
     def terminate(self) -> None:
         """Drain remaining input so blocked feeder tasks can finish.
@@ -117,6 +147,48 @@ class DataFeed:
                 return
 
     # -- internals ---------------------------------------------------------
+
+    @staticmethod
+    def _note_rows(runs: list[list], tag, count: int) -> None:
+        """Append a [tag, count] run, merging with the tail run of the same
+        tag (keeps the untagged training path at O(1) bookkeeping)."""
+        if count <= 0:
+            return
+        if runs and runs[-1][0] == tag:
+            runs[-1][1] += count
+        else:
+            runs.append([tag, count])
+
+    def _consume_tags(self, count: int) -> None:
+        """Move ``count`` rows' provenance from buffered to handed-out."""
+        while count > 0 and self._buffer_tags:
+            tag, c = self._buffer_tags[0]
+            n = min(c, count)
+            self._note_rows(self._out_route, tag, n)
+            count -= n
+            if n == c:
+                self._buffer_tags.pop(0)
+            else:
+                self._buffer_tags[0][1] = c - n
+
+    def _route_queue(self, tag):
+        q = self._out_queues.get(tag)
+        if q is None:
+            q = self.mgr.get_queue(f"{self.qname_out}:{tag}")
+            self._out_queues[tag] = q
+        return q
+
+    def _forget_tag(self, tag) -> None:
+        """Drop a finished task's cached queue proxy (tags are per-task
+        uuids; a long-lived worker would otherwise accumulate one proxy per
+        partition task forever)."""
+        if tag is None:
+            return
+        if any(t == tag for t, _ in self._out_route):
+            return
+        if any(t == tag for t, _ in self._buffer_tags):
+            return
+        self._out_queues.pop(tag, None)
 
     def _columnarize(self, rows: list[Any], device_put: bool):
         if not rows:

@@ -415,11 +415,18 @@ class _InferenceFn:
         self.timeout = timeout
 
     def __call__(self, iterator: Iterator):
+        import uuid
+
         node = _resolve_node(self.cluster_info, self.meta["id"])
         mgr = _connect_mgr(node, bytes.fromhex(self.meta["authkey_hex"]))
         _raise_worker_error(mgr)
         qin = mgr.get_queue(self.qname_in)
-        qout = mgr.get_queue(self.qname_out)
+        # per-task result queue: chunks are tagged with this task's identity
+        # and DataFeed.batch_results routes each row's result back to
+        # "output:<tag>", so concurrent partition tasks on one executor
+        # (multi-slot) cannot steal each other's predictions
+        tag = uuid.uuid4().hex[:12]
+        qout = mgr.get_queue(f"{self.qname_out}:{tag}")
         chunk_size = self.meta.get("feed_chunk", 256)
         deadline = time.monotonic() + self.timeout
 
@@ -430,10 +437,12 @@ class _InferenceFn:
                 chunk.append(row)
                 count += 1
                 if len(chunk) >= chunk_size:
-                    qin.put(chunk, timeout=max(0.0, deadline - time.monotonic()))
+                    qin.put(marker.TaggedChunk(tag, chunk),
+                            timeout=max(0.0, deadline - time.monotonic()))
                     chunk = []
             if chunk:
-                qin.put(chunk, timeout=max(0.0, deadline - time.monotonic()))
+                qin.put(marker.TaggedChunk(tag, chunk),
+                        timeout=max(0.0, deadline - time.monotonic()))
             qin.put(
                 marker.EndPartition(), timeout=max(0.0, deadline - time.monotonic())
             )
@@ -445,18 +454,24 @@ class _InferenceFn:
             ) from None
 
         results: list[Any] = []
-        while len(results) < count:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise RuntimeError(
-                    f"inference timed out: got {len(results)} of {count} results"
-                )
-            try:
-                batch = qout.get(timeout=min(1.0, remaining))
-            except _queue_mod.Empty:
-                _raise_worker_error(mgr)
-                continue
-            results.extend(batch if isinstance(batch, list) else [batch])
+        try:
+            while len(results) < count:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RuntimeError(
+                        f"inference timed out: got {len(results)} of {count} results"
+                    )
+                try:
+                    batch = qout.get(timeout=min(1.0, remaining))
+                except _queue_mod.Empty:
+                    _raise_worker_error(mgr)
+                    continue
+                results.extend(batch if isinstance(batch, list) else [batch])
+        finally:
+            try:  # drop the per-task queue so the server doesn't accumulate
+                mgr.del_queue(f"{self.qname_out}:{tag}")
+            except Exception:
+                pass
         if len(results) != count:
             raise RuntimeError(
                 f"inference produced {len(results)} results for {count} inputs"

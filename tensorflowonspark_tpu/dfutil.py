@@ -75,14 +75,16 @@ def encode_row(row, dtypes: list[tuple[str, str]],
 # ---------------------------------------------------------------------------
 
 
-def fromTFExample(data: bytes, binary_features: list[str] | None = None):
+def fromTFExample(data: bytes, binary_features: list[str] | None = None,
+                  backend: str = "sparkapi"):
     """Serialized Example → Row (single-element lists unwrap to scalars).
 
     Reference anchor: ``dfutil.py::fromTFExample``.  ``binary_features``
     names BytesList columns that stay ``bytes``; other BytesList columns
-    decode as utf-8 strings (the reference's convention).
+    decode as utf-8 strings (the reference's convention).  ``backend``
+    selects pyspark vs the local substrate for the produced Row.
     """
-    from tensorflowonspark_tpu.sparkapi.sql import Row
+    from tensorflowonspark_tpu import sql_compat
 
     binary = set(binary_features or [])
     decoded = tfrecord.decode_example(data)
@@ -95,16 +97,13 @@ def fromTFExample(data: bytes, binary_features: list[str] | None = None):
             vals = [bytes(v) for v in vals]
         names.append(name)
         values.append(vals[0] if len(vals) == 1 else list(vals))
-    return Row.from_fields(names, values)
+    return sql_compat.make_row(names, values, backend)
 
 
-def infer_schema(example: bytes, binary_features: list[str] | None = None):
-    """Schema (StructType) of a serialized Example.
-
-    Reference anchor: ``dfutil.py::infer_schema`` — samples one record.
-    """
-    from tensorflowonspark_tpu.sparkapi.sql import StructField, StructType
-
+def _infer_fields(example: bytes,
+                  binary_features: list[str] | None = None
+                  ) -> list[tuple[str, str]]:
+    """[(name, simpleString)] schema of one serialized Example."""
     binary = set(binary_features or [])
     decoded = tfrecord.decode_example(example)
     fields = []
@@ -117,8 +116,21 @@ def infer_schema(example: bytes, binary_features: list[str] | None = None):
         else:
             elem = "binary" if name in binary else "string"
         dt = f"array<{elem}>" if len(vals) != 1 else elem
-        fields.append(StructField(name, dt))
-    return StructType(fields)
+        fields.append((name, dt))
+    return fields
+
+
+def infer_schema(example: bytes, binary_features: list[str] | None = None,
+                 backend: str = "sparkapi"):
+    """Schema (StructType) of a serialized Example.
+
+    Reference anchor: ``dfutil.py::infer_schema`` — samples one record.
+    """
+    from tensorflowonspark_tpu import sql_compat
+
+    return sql_compat.struct_type(
+        _infer_fields(example, binary_features), backend
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -160,8 +172,9 @@ def loadTFRecords(sc, input_dir: str,
     Reference anchor: ``dfutil.py::loadTFRecords`` (Hadoop input format +
     ``infer_schema`` from one sampled record).
     """
-    from tensorflowonspark_tpu.sparkapi.sql import DataFrame
+    from tensorflowonspark_tpu import sql_compat
 
+    backend = sql_compat.backend_of(sc)
     files = sorted(
         os.path.join(input_dir, f)
         for f in os.listdir(input_dir)
@@ -176,18 +189,19 @@ def loadTFRecords(sc, input_dir: str,
             break
     if sample is None:
         raise ValueError(f"all TFRecord part files in {input_dir} are empty")
-    schema = infer_schema(sample, binary_features)
+    fields = _infer_fields(sample, binary_features)
     rows = sc.parallelize(files, len(files)).mapPartitions(
-        _LoadPartition(binary_features)
+        _LoadPartition(binary_features, backend)
     )
-    return DataFrame(rows, schema)
+    return sql_compat.create_dataframe(rows, fields, backend)
 
 
 class _LoadPartition:
-    def __init__(self, binary_features):
+    def __init__(self, binary_features, backend="sparkapi"):
         self.binary_features = binary_features
+        self.backend = backend
 
     def __call__(self, iterator):
         for path in iterator:
             for payload in tfrecord.read_records(path):
-                yield fromTFExample(payload, self.binary_features)
+                yield fromTFExample(payload, self.binary_features, self.backend)

@@ -38,3 +38,23 @@ class StopFeed(Marker):
 
     def __repr__(self) -> str:  # pragma: no cover - trivial
         return "<StopFeed>"
+
+
+class TaggedChunk:
+    """A chunk of rows tagged with the feeding task's identity.
+
+    Not in the reference: its inference path pulled results off ONE shared
+    ``output`` queue, which interleaves predictions when Spark runs two
+    partition tasks concurrently on an executor (>1 core/slot).  Tagging the
+    input lets ``DataFeed.batch_results`` route each row's result to the
+    per-task queue ``output:<tag>``, making multi-slot executors safe.
+    """
+
+    __slots__ = ("tag", "rows")
+
+    def __init__(self, tag: str, rows: list):
+        self.tag = tag
+        self.rows = rows
+
+    def __repr__(self) -> str:  # pragma: no cover - trivial
+        return f"<TaggedChunk {self.tag} n={len(self.rows)}>"

@@ -34,6 +34,44 @@ def make_classification_forward_fn(module):
     return forward
 
 
+def make_stateful_classification_loss_fn(module):
+    """BatchNorm-style loss: threads mutable collections through the step.
+
+    ``loss(params, collections, batch) -> (scalar, new_collections)``.
+    Under pjit's global view the batch-dim mean/var reductions are global —
+    XLA inserts the cross-replica psum the reference needed
+    ``MultiWorkerMirroredStrategy``/NCCL for (SURVEY.md §2.3).
+    """
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, collections, batch):
+        logits, new_cols = module.apply(
+            {"params": params, **collections}, batch["image"], train=True,
+            mutable=list(collections.keys()),
+        )
+        loss = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), batch["label"]
+            )
+        )
+        return loss, new_cols
+
+    loss_fn.stateful = True
+    return loss_fn
+
+
+def make_stateful_classification_forward_fn(module):
+    """Eval-time forward reading (not updating) the running statistics."""
+
+    def forward(params, collections, batch):
+        return module.apply({"params": params, **collections},
+                            batch["image"], train=False)
+
+    forward.stateful = True
+    return forward
+
+
 def image_example_batch(image_shape, num_classes: int, batch_size: int = 8,
                         seed: int = 0):
     """Synthetic ``{image, label}`` batch; ``image_shape`` excludes batch."""

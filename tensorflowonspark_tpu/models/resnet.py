@@ -8,10 +8,12 @@ data-parallel training; see ``SURVEY.md §1 L6``).  TPU-first choices:
 - bfloat16 compute, float32 params and loss.
 - v1.5 bottleneck (stride in the 3×3, not the 1×1 — matches the variant every
   modern benchmark reports).
-- GroupNorm instead of BatchNorm: per-example normalisation keeps the loss a
-  pure function of ``(params, batch)`` and needs no cross-replica batch-stat
-  ``psum`` over ICI every step (the BiT recipe).  ``Config(norm="batch")``
-  is reserved for a later stats-carrying train state.
+- Norm choice: GroupNorm by default — per-example normalisation keeps the
+  loss a pure function of ``(params, batch)`` (the BiT recipe).
+  ``Config(norm="batch")`` enables classic BatchNorm: running stats ride the
+  train state's ``collections`` (``parallel/train.py::TrainState``), and
+  under pjit's global view the batch mean/var are already cross-replica —
+  XLA inserts the psum the reference's MWMS used NCCL for.
 """
 
 from __future__ import annotations
@@ -28,11 +30,12 @@ class Config:
     image_size: int = 224
     groups: int = 32
     dtype: str = "bfloat16"
+    norm: str = "group"  # "group" (pure) | "batch" (stats in collections)
 
     @classmethod
-    def tiny(cls) -> "Config":
+    def tiny(cls, norm: str = "group") -> "Config":
         return cls(stage_sizes=(1, 1), width=8, num_classes=10, image_size=16,
-                   groups=2, dtype="float32")
+                   groups=2, dtype="float32", norm=norm)
 
     @classmethod
     def resnet101(cls) -> "Config":
@@ -51,7 +54,12 @@ def make_model(config: Config, mesh=None):
         nn.initializers.he_normal(), (None, None, "embed", "mlp")
     )
 
-    def norm(ch):
+    batch_norm = config.norm == "batch"
+
+    def norm(ch, train):
+        if batch_norm:
+            return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                dtype=dtype)
         return nn.GroupNorm(num_groups=min(config.groups, ch), dtype=dtype)
 
     class Bottleneck(nn.Module):
@@ -59,41 +67,41 @@ def make_model(config: Config, mesh=None):
         strides: int = 1
 
         @nn.compact
-        def __call__(self, x):
+        def __call__(self, x, train: bool = False):
             residual = x
             y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=dtype,
                         kernel_init=conv_init)(x)
-            y = norm(self.filters)(y)
+            y = norm(self.filters, train)(y)
             y = nn.relu(y)
             y = nn.Conv(self.filters, (3, 3), strides=(self.strides,) * 2,
                         use_bias=False, dtype=dtype, kernel_init=conv_init)(y)
-            y = norm(self.filters)(y)
+            y = norm(self.filters, train)(y)
             y = nn.relu(y)
             out_ch = self.filters * 4
             y = nn.Conv(out_ch, (1, 1), use_bias=False, dtype=dtype,
                         kernel_init=conv_init)(y)
-            y = norm(out_ch)(y)
+            y = norm(out_ch, train)(y)
             if residual.shape != y.shape:
                 residual = nn.Conv(out_ch, (1, 1), strides=(self.strides,) * 2,
                                    use_bias=False, dtype=dtype,
                                    kernel_init=conv_init)(residual)
-                residual = norm(out_ch)(residual)
+                residual = norm(out_ch, train)(residual)
             return nn.relu(residual + y)
 
     class ResNet(nn.Module):
         @nn.compact
-        def __call__(self, x):
+        def __call__(self, x, train: bool = False):
             x = x.astype(dtype)
             x = nn.Conv(config.width, (7, 7), strides=(2, 2), use_bias=False,
                         dtype=dtype, kernel_init=conv_init)(x)
-            x = norm(config.width)(x)
+            x = norm(config.width, train)(x)
             x = nn.relu(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
             for i, n_blocks in enumerate(config.stage_sizes):
                 filters = config.width * (2 ** i)
                 for j in range(n_blocks):
                     strides = 2 if i > 0 and j == 0 else 1
-                    x = Bottleneck(filters, strides)(x)
+                    x = Bottleneck(filters, strides)(x, train)
             x = x.mean(axis=(1, 2))
             return nn.Dense(
                 config.num_classes,
@@ -107,17 +115,19 @@ def make_model(config: Config, mesh=None):
 
 
 def make_loss_fn(module, config: Config):
-    from tensorflowonspark_tpu.models._common import make_classification_loss_fn
+    from tensorflowonspark_tpu.models import _common
 
-    return make_classification_loss_fn(module)
+    if config.norm == "batch":
+        return _common.make_stateful_classification_loss_fn(module)
+    return _common.make_classification_loss_fn(module)
 
 
 def make_forward_fn(module, config: Config):
-    from tensorflowonspark_tpu.models._common import (
-        make_classification_forward_fn,
-    )
+    from tensorflowonspark_tpu.models import _common
 
-    return make_classification_forward_fn(module)
+    if config.norm == "batch":
+        return _common.make_stateful_classification_forward_fn(module)
+    return _common.make_classification_forward_fn(module)
 
 
 def example_batch(config: Config, batch_size: int = 8, seed: int = 0):

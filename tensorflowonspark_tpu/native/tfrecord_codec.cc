@@ -131,9 +131,15 @@ long tfr_index(const unsigned char* buf, unsigned long long size, int verify,
     }
     if (n == cap) {
       cap *= 2;
-      offs = (uint64_t*)realloc(offs, cap * sizeof(uint64_t));
-      lens = (uint64_t*)realloc(lens, cap * sizeof(uint64_t));
-      if (!offs || !lens) { free(offs); free(lens); return -1; }
+      uint64_t* no = (uint64_t*)realloc(offs, cap * sizeof(uint64_t));
+      uint64_t* nl = (uint64_t*)realloc(lens, cap * sizeof(uint64_t));
+      if (!no || !nl) {  // keep originals freeable on partial failure
+        free(no ? no : offs);
+        free(nl ? nl : lens);
+        return -1;
+      }
+      offs = no;
+      lens = nl;
     }
     offs[n] = pos + 12;
     lens[n] = len;

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import ctypes
 import logging
+import mmap
 import os
 import subprocess
 import threading
@@ -56,7 +57,7 @@ def _load():
                         ctypes.c_char_p, ctypes.c_char_p, u64p, ctypes.c_long]
                     lib.tfr_index.restype = ctypes.c_long
                     lib.tfr_index.argtypes = [
-                        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,
+                        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
                         ctypes.POINTER(u64p), ctypes.POINTER(u64p)]
                     lib.tfr_free.argtypes = [ctypes.c_void_p]
                     lib.tfr_masked_crc.restype = ctypes.c_uint
@@ -94,22 +95,37 @@ def write_records(path: str, records) -> int:
 
 
 def read_records(path: str, verify: bool = True):
-    """Read the file once, index+verify in C, slice payloads in Python."""
+    """mmap the file, index+verify in C, slice payloads in Python.
+
+    MAP_PRIVATE copy-on-write mapping instead of ``f.read()`` so multi-GB
+    part files never materialise fully in executor heap; pages stream
+    through the page cache as the C indexer scans them.
+    """
     lib = _load()
     with open(path, "rb") as f:
-        buf = f.read()
-    u64p = ctypes.POINTER(ctypes.c_uint64)
-    offsets, lengths = u64p(), u64p()
-    n = lib.tfr_index(buf, len(buf), int(verify),
-                      ctypes.byref(offsets), ctypes.byref(lengths))
-    if n == -1:
-        raise IOError(f"{path}: corrupt record crc")
-    if n == -2:
-        raise IOError(f"{path}: truncated record")
+        try:
+            mm = mmap.mmap(f.fileno(), 0, flags=mmap.MAP_PRIVATE,
+                           prot=mmap.PROT_READ | mmap.PROT_WRITE)
+        except ValueError:  # zero-length file: no records
+            return
     try:
-        for i in range(n):
-            off, length = offsets[i], lengths[i]
-            yield buf[off:off + length]
+        size = len(mm)
+        carr = (ctypes.c_char * size).from_buffer(mm)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        offsets, lengths = u64p(), u64p()
+        try:
+            n = lib.tfr_index(ctypes.addressof(carr), size, int(verify),
+                              ctypes.byref(offsets), ctypes.byref(lengths))
+            if n == -1:
+                raise IOError(f"{path}: corrupt record crc")
+            if n == -2:
+                raise IOError(f"{path}: truncated record")
+            for i in range(n):
+                off, length = offsets[i], lengths[i]
+                yield mm[off:off + length]
+        finally:
+            lib.tfr_free(offsets)
+            lib.tfr_free(lengths)
+            del carr  # release the buffer export before mm.close()
     finally:
-        lib.tfr_free(offsets)
-        lib.tfr_free(lengths)
+        mm.close()

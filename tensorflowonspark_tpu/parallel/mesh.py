@@ -171,17 +171,30 @@ DEFAULT_RULES: tuple[tuple[str, Any], ...] = (
 )
 
 
-def logical_sharding(mesh, logical_axes: Sequence[str | None], rules=DEFAULT_RULES):
+def logical_sharding(mesh, logical_axes: Sequence[str | None], rules=DEFAULT_RULES,
+                     shape: Sequence[int] | None = None):
+    """PartitionSpec from flax logical axis names.
+
+    ``shape`` (when known) vetoes assignments the dimension cannot honour:
+    a dim whose size is not divisible by its mesh axes falls back to
+    replication for that dim (e.g. ResNet's 3-channel input conv under
+    fsdp>1).
+    """
     rule_map = dict(rules)
     spec = []
     used: set[str] = set()
-    for name in logical_axes:
+    for i, name in enumerate(logical_axes):
         axes = rule_map.get(name) if name else None
         # drop mesh axes already consumed by an earlier dim, or of size 1
         if isinstance(axes, (tuple, list)):
             axes = tuple(a for a in axes if a not in used and mesh.shape[a] > 1)
         elif axes is not None:
             axes = None if (axes in used or mesh.shape[axes] == 1) else axes
+        if axes and shape is not None and i < len(shape):
+            cand = list(axes) if isinstance(axes, tuple) else [axes]
+            while cand and shape[i] % math.prod(mesh.shape[a] for a in cand):
+                cand.pop()  # shrink until the dim divides evenly
+            axes = tuple(cand) if len(cand) > 1 else (cand[0] if cand else None)
         if not axes:
             spec.append(None)
             continue
@@ -224,7 +237,8 @@ def param_sharding_from_metadata(params, mesh, rules=DEFAULT_RULES):
 
     def _one(leaf):
         if isinstance(leaf, nn.Partitioned):
-            return logical_sharding(mesh, leaf.names, rules)
+            shape = getattr(leaf.value, "shape", None)
+            return logical_sharding(mesh, leaf.names, rules, shape=shape)
         return None  # resolved in the second pass
 
     def _is_leaf(x):

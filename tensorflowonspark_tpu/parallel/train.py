@@ -40,20 +40,24 @@ def unbox(tree):
 
 
 class TrainState:
-    """Minimal pytree train state: ``params``, ``opt_state``, ``step``.
+    """Minimal pytree train state: ``params``, ``opt_state``, ``step``, plus
+    optional non-param variable ``collections`` (e.g. BatchNorm
+    ``batch_stats`` — running mean/var updated inside the step but not by
+    the optimizer).
 
     A hand-rolled pytree (not flax's TrainState) so the apply/optimizer
     functions stay out of the leaves — they'd otherwise be retraced into
     every jit signature and break donation.
     """
 
-    def __init__(self, params, opt_state, step):
+    def __init__(self, params, opt_state, step, collections=None):
         self.params = params
         self.opt_state = opt_state
         self.step = step
+        self.collections = collections if collections is not None else {}
 
     def tree_flatten(self):
-        return (self.params, self.opt_state, self.step), None
+        return (self.params, self.opt_state, self.step, self.collections), None
 
     @classmethod
     def tree_unflatten(cls, _aux, children):
@@ -65,11 +69,12 @@ import jax.tree_util as _jtu  # noqa: E402
 _jtu.register_pytree_node_class(TrainState)
 
 
-def create_train_state(params, optimizer):
+def create_train_state(params, optimizer, collections=None):
     import jax.numpy as jnp
 
     params = unbox(params)
-    return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+    return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32),
+                      unbox(collections) if collections else {})
 
 
 def state_shardings(state: TrainState, param_shardings, mesh):
@@ -126,7 +131,14 @@ def state_shardings(state: TrainState, param_shardings, mesh):
             "(ZeRO memory savings lost for them); shapes: %s",
             len(degraded), degraded[:5],
         )
-    return TrainState(param_shardings, opt_shardings, mesh_lib.replicated(mesh))
+    # non-param collections (batch_stats running averages) replicate: their
+    # batch-dim reductions are global under pjit view, so every device holds
+    # the same per-channel vectors
+    col_shardings = jax.tree_util.tree_map(
+        lambda _: mesh_lib.replicated(mesh), state.collections
+    )
+    return TrainState(param_shardings, opt_shardings,
+                      mesh_lib.replicated(mesh), col_shardings)
 
 
 def apply_zero_sharding(param_shardings, mesh, params, min_size: int = 1 << 16):
@@ -172,10 +184,15 @@ def make_train_step(
 
     ``loss_fn(params, batch) -> scalar loss`` must be pure and
     trace-compatible (static shapes; ``lax`` control flow only —
-    XLA semantics per the TPU design notes).
+    XLA semantics per the TPU design notes).  A *stateful* loss
+    (``loss_fn.stateful`` truthy, signature
+    ``loss_fn(params, collections, batch) -> (loss, new_collections)``)
+    additionally threads non-param variable collections — the BatchNorm
+    path; running stats update inside the same compiled step.
     """
     import jax
 
+    stateful = bool(getattr(loss_fn, "stateful", False))
     shardings = state_shardings(state, param_shardings, mesh)
 
     def _batch_sharding(leaf_path, leaf):
@@ -186,12 +203,18 @@ def make_train_step(
     batch_shardings = jax.tree_util.tree_map_with_path(_batch_sharding, batch_example)
 
     def _step(st: TrainState, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(st.params, batch)
+        if stateful:
+            (loss, new_cols), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                st.params, st.collections, batch
+            )
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(st.params, batch)
+            new_cols = st.collections
         updates, opt_state = optimizer.update(grads, st.opt_state, st.params)
         import optax
 
         params = optax.apply_updates(st.params, updates)
-        return TrainState(params, opt_state, st.step + 1), loss
+        return TrainState(params, opt_state, st.step + 1, new_cols), loss
 
     return jax.jit(
         _step,
@@ -202,8 +225,14 @@ def make_train_step(
 
 
 def make_eval_step(forward_fn, mesh, param_shardings, batch_example,
-                   sequence_axes: dict[str, int] | None = None):
-    """Compile a sharded ``params, batch -> outputs`` inference step."""
+                   sequence_axes: dict[str, int] | None = None,
+                   collections=None):
+    """Compile a sharded ``params, batch -> outputs`` inference step.
+
+    A stateful forward (``forward_fn.stateful`` truthy) has signature
+    ``forward_fn(params, collections, batch)`` — BatchNorm running stats are
+    read (not updated) at eval time.
+    """
     import jax
 
     def _batch_sharding(leaf_path, leaf):
@@ -212,6 +241,14 @@ def make_eval_step(forward_fn, mesh, param_shardings, batch_example,
         return mesh_lib.batch_sharding(mesh, getattr(leaf, "ndim", 0), sa)
 
     batch_shardings = jax.tree_util.tree_map_with_path(_batch_sharding, batch_example)
+    if getattr(forward_fn, "stateful", False):
+        col_shardings = jax.tree_util.tree_map(
+            lambda _: mesh_lib.replicated(mesh), collections or {}
+        )
+        return jax.jit(
+            forward_fn,
+            in_shardings=(param_shardings, col_shardings, batch_shardings),
+        )
     return jax.jit(
         forward_fn,
         in_shardings=(param_shardings, batch_shardings),

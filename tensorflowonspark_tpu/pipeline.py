@@ -225,7 +225,10 @@ class TFEstimator(TFParams, HasBatchSize, HasEpochs, HasSteps, HasClusterSize,
 
         sc = _spark_context_of(df)
         args = self.merge_args()
-        input_mode = self.getOrDefault("input_mode") or TFCluster.InputMode.SPARK
+        input_mode = self.getOrDefault("input_mode")
+        # None test, not falsy-or: legacy int InputMode.TENSORFLOW is 0
+        input_mode = (TFCluster.InputMode.SPARK if input_mode is None
+                      else TFCluster.InputMode(input_mode))
 
         logger.info("TFEstimator.fit: cluster_size=%d input_mode=%s",
                     self.getOrDefault("cluster_size"), input_mode)
@@ -279,13 +282,9 @@ class TFModel(TFParams, HasBatchSize, HasInputMapping, HasOutputMapping,
         return self._transform(df)
 
     def _transform(self, df):
-        from tensorflowonspark_tpu.sparkapi.sql import (
-            DataFrame,
-            StructField,
-            StructType,
-            infer_schema,
-        )
+        from tensorflowonspark_tpu import sql_compat
 
+        backend = sql_compat.backend_of(df)
         export_dir = self.getOrDefault("export_dir") or self.getOrDefault(
             "model_dir")
         if not export_dir:
@@ -298,17 +297,40 @@ class TFModel(TFParams, HasBatchSize, HasInputMapping, HasOutputMapping,
             input_mapping=self.getOrDefault("input_mapping"),
             output_mapping=self.getOrDefault("output_mapping"),
             columns=df.columns,
+            backend=backend,
         )
-        # materialize once: the local substrate has no RDD cache, and a lazy
-        # first()-for-schema would re-run partition 0's inference on every
-        # downstream action
-        rows = df.rdd.mapPartitions(run_model).collect()
-        if not rows:
-            out_names = list((self.getOrDefault("output_mapping") or
-                              {"prediction": "prediction"}).values())
-            empty = StructType([StructField(n, "double") for n in out_names])
-            return DataFrame(_rdd_of(df, []), empty)
-        return DataFrame(_rdd_of(df, rows), infer_schema(rows[0]))
+        session = sql_compat.session_of(df)
+        out_names = list((self.getOrDefault("output_mapping") or
+                          {"prediction": "prediction"}).values())
+        # Lazy distributed transform (reference keeps it a mapPartitions —
+        # no driver collect).  The exact output schema comes from scoring ONE
+        # sampled row on the driver; the per-process model cache means the
+        # driver pays a single small-batch load+jit.  If the driver cannot
+        # load the export (e.g. path only readable from executors), fall
+        # back to a declared schema from output_mapping — the reference's
+        # own approach.
+        sample = df.rdd.take(1)
+        if not sample:
+            fields = [(n, "double") for n in out_names]
+            return sql_compat.create_dataframe(
+                _rdd_of(df, []), fields, backend, session)
+        try:
+            first_out = next(iter(run_model(iter(sample))))
+        except Exception as e:
+            # driver cannot load/run the export (e.g. export_dir readable
+            # only from executors): score ONE row on the cluster instead —
+            # take(1) computes a single partition, not the whole dataset
+            logger.info(
+                "driver-side schema sampling unavailable (%s); sampling on "
+                "an executor", e)
+            first_out = df.rdd.mapPartitions(run_model).take(1)[0]
+        fields = sql_compat.infer_fields(first_out)
+        out_rdd = df.rdd.mapPartitions(run_model)
+        if backend == sql_compat.SPARKAPI:
+            # the local substrate has no storage manager; cache so repeated
+            # actions don't re-run inference (real pyspark: user's choice)
+            out_rdd = out_rdd.cache()
+        return sql_compat.create_dataframe(out_rdd, fields, backend, session)
 
 
 class _RunModel:
@@ -320,7 +342,7 @@ class _RunModel:
     """
 
     def __init__(self, export_dir, model_name, predict_fn, batch_size,
-                 input_mapping, output_mapping, columns):
+                 input_mapping, output_mapping, columns, backend="sparkapi"):
         self.export_dir = export_dir
         self.model_name = model_name
         self.predict_fn = predict_fn
@@ -328,6 +350,7 @@ class _RunModel:
         self.input_mapping = input_mapping
         self.output_mapping = output_mapping
         self.columns = list(columns)
+        self.backend = backend
 
     # -- executor-side ------------------------------------------------------
 
@@ -353,18 +376,30 @@ class _RunModel:
 
         state = ckpt.load_pytree(path)
         params = state.get("params", state) if isinstance(state, dict) else state
+        collections = state.get("collections") if isinstance(state, dict) else None
 
         if self.predict_fn is not None:
             fn = self.predict_fn
         elif self.model_name:
+            import dataclasses
+
             import jax
 
             from tensorflowonspark_tpu import models as model_zoo
 
             lib = model_zoo.get_model(self.model_name)
             config = lib.Config.tiny() if _is_tiny(params, lib) else lib.Config()
+            if collections and "norm" in {
+                f.name for f in dataclasses.fields(config)
+            }:
+                config = dataclasses.replace(config, norm="batch")
             module = lib.make_model(config)
-            fn = jax.jit(lib.make_forward_fn(module, config))
+            forward = lib.make_forward_fn(module, config)
+            if getattr(forward, "stateful", False):
+                cols = collections or {}
+                fn = jax.jit(lambda p, b: forward(p, cols, b))
+            else:
+                fn = jax.jit(forward)
         else:
             raise ValueError("TFModel needs model_name or predict_fn")
         logger.info("executor loaded model from %s", self.export_dir)
@@ -374,7 +409,7 @@ class _RunModel:
     def __call__(self, iterator):
         import numpy as np
 
-        from tensorflowonspark_tpu.sparkapi.sql import Row
+        from tensorflowonspark_tpu import sql_compat
 
         fn, params = self._load()
         in_map = self.input_mapping or {c: c for c in self.columns}
@@ -390,8 +425,8 @@ class _RunModel:
             cols = list(named.keys())
             arrays = [np.asarray(named[c]) for c in cols]
             for i in range(len(rows)):
-                yield Row.from_fields(
-                    cols, [_pyval(a[i]) for a in arrays]
+                yield sql_compat.make_row(
+                    cols, [_pyval(a[i]) for a in arrays], self.backend
                 )
 
         rows: list[Any] = []

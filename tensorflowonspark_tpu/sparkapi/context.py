@@ -180,12 +180,16 @@ class LocalSparkContext:
         chain: Sequence[Callable],
         action: Callable,
         timeout: float | None = None,
+        base_index: int = 0,
     ) -> list[Any]:
         """Run ``action(pindex, chain(...iter(partition)))`` per partition.
 
         Returns per-partition results in partition order.  Any task failure
         raises immediately with the executor traceback (maxFailures=1 — no
         retry, matching the reference's required Spark setting for SPMD).
+        ``base_index`` offsets the partition index seen by indexed chains —
+        used by ``RDD.take`` to run a partition-subset job whose tasks still
+        observe their original indices.
         """
         import cloudpickle
 
@@ -202,7 +206,7 @@ class LocalSparkContext:
             for pindex, part in enumerate(partitions):
                 data_blob = cloudpickle.dumps(part)
                 self._task_queues[pindex % len(self._task_queues)].put(
-                    (job_id, pindex, pindex, data_blob, chain_blob)
+                    (job_id, pindex, base_index + pindex, data_blob, chain_blob)
                 )
             results: dict[int, Any] = {}
             deadline = None if timeout is None else time.monotonic() + timeout

@@ -44,6 +44,7 @@ class RDD:
         self._sc = sc
         self._partitions = partitions
         self._chain = chain or []
+        self._cached = False
 
     # -- transformations (lazy) -------------------------------------------
 
@@ -81,11 +82,23 @@ class RDD:
     def coalesce(self, numPartitions: int, shuffle: bool = False) -> "RDD":
         return self.repartition(numPartitions)
 
-    def cache(self) -> "RDD":  # no storage levels in the local substrate
+    def cache(self) -> "RDD":
+        """Materialize on first action, then reuse (single storage level)."""
+        self._cached = True
         return self
 
     def persist(self, *_a, **_kw) -> "RDD":
-        return self
+        return self.cache()
+
+    def _resolved(self) -> tuple[list, list]:
+        """(partitions, chain), collapsing the chain once if cache() was
+        requested — later actions reuse the computed partitions."""
+        if self._cached and self._chain:
+            self._partitions = self._sc.run_job(
+                self._partitions, self._chain, _collect_action
+            )
+            self._chain = []
+        return self._partitions, self._chain
 
     def zipWithIndex(self) -> "RDD":
         items = self.collect()
@@ -99,14 +112,26 @@ class RDD:
         return len(self._partitions)
 
     def collect(self) -> list:
-        parts = self._sc.run_job(self._partitions, self._chain, _collect_action)
+        partitions, chain = self._resolved()
+        parts = self._sc.run_job(partitions, chain, _collect_action)
         return [x for part in parts for x in part]
 
     def count(self) -> int:
-        return sum(self._sc.run_job(self._partitions, self._chain, _count_action))
+        partitions, chain = self._resolved()
+        return sum(self._sc.run_job(partitions, chain, _count_action))
 
     def take(self, n: int) -> list:
-        return self.collect()[:n]
+        """Compute partitions incrementally until ``n`` items are collected
+        (pyspark semantics — a 1-row sample does not run the whole job)."""
+        partitions, chain = self._resolved()
+        out: list = []
+        for i, part in enumerate(partitions):
+            if len(out) >= n:
+                break
+            res = self._sc.run_job([part], chain, _collect_action,
+                                   base_index=i)
+            out.extend(res[0])
+        return out[:n]
 
     def first(self) -> Any:
         out = self.take(1)
@@ -115,7 +140,8 @@ class RDD:
         return out[0]
 
     def foreachPartition(self, f: Callable[[Iterator], Any]) -> None:
-        self._sc.run_job(self._partitions, self._chain, _Foreach(f))
+        partitions, chain = self._resolved()
+        self._sc.run_job(partitions, chain, _Foreach(f))
 
     def foreach(self, f: Callable[[Any], Any]) -> None:
         self.foreachPartition(_ForeachEach(f))

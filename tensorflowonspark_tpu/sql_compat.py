@@ -1,0 +1,110 @@
+"""Backend-neutral SQL helpers: real pyspark OR the bundled local substrate.
+
+The portable layers (``pipeline.py``, ``dfutil.py``) must not hard-import
+:mod:`tensorflowonspark_tpu.sparkapi` — under real pyspark they have to
+produce genuine pyspark ``Row``/``DataFrame`` objects (SURVEY.md §2.2 row 4:
+"py4j / Spark JVM kept as-is").  Every helper here dispatches on the backend
+of the object actually flowing through (`type(obj).__module__`), so the same
+closure works executor-side on either substrate.
+
+Types cross the boundary as Spark *simpleString* names (``"bigint"``,
+``"array<double>"`` …) — the one schema vocabulary both backends share.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+SPARKAPI = "sparkapi"
+PYSPARK = "pyspark"
+
+
+def backend_of(obj: Any) -> str:
+    """Which SQL backend does this DataFrame/RDD/Row/SparkContext belong to?"""
+    mod = type(obj).__module__ or ""
+    return PYSPARK if mod.startswith("pyspark") else SPARKAPI
+
+
+def make_row(names: Sequence[str], values: Sequence[Any], backend: str):
+    """A Row with ordered named fields on the given backend."""
+    if backend == PYSPARK:
+        from pyspark.sql import Row
+
+        return Row(*names)(*values)  # Row factory: field order preserved
+    from tensorflowonspark_tpu.sparkapi.sql import Row
+
+    return Row.from_fields(list(names), list(values))
+
+
+def row_fields(row: Any) -> tuple[list[str], list[Any]]:
+    """(names, values) of a Row from either backend (or a dict)."""
+    if isinstance(row, dict):
+        return list(row.keys()), list(row.values())
+    fields = getattr(row, "__fields__", None)
+    if fields is not None:  # pyspark attribute / sparkapi method
+        names = list(fields() if callable(fields) else fields)
+        return names, [row[n] for n in names]
+    raise TypeError(f"cannot extract fields from row {type(row)!r}")
+
+
+def infer_fields(row: Any) -> list[tuple[str, str]]:
+    """[(name, simpleString type)] inferred from one row's python values."""
+    from tensorflowonspark_tpu.sparkapi.sql import infer_type
+
+    names, values = row_fields(row)
+    return [(n, infer_type(v)) for n, v in zip(names, values)]
+
+
+def _pyspark_type(simple: str):
+    from pyspark.sql import types as T
+
+    if simple.startswith("array<") and simple.endswith(">"):
+        return T.ArrayType(_pyspark_type(simple[6:-1]))
+    atomic = {
+        "tinyint": T.ByteType, "smallint": T.ShortType, "int": T.IntegerType,
+        "integer": T.IntegerType, "bigint": T.LongType, "long": T.LongType,
+        "float": T.FloatType, "double": T.DoubleType, "string": T.StringType,
+        "binary": T.BinaryType, "boolean": T.BooleanType,
+    }
+    if simple in atomic:
+        return atomic[simple]()
+    if simple.startswith("decimal"):
+        return T.DoubleType()
+    raise TypeError(f"unsupported simpleString type {simple!r}")
+
+
+def struct_type(fields: Sequence[tuple[str, str]], backend: str):
+    """A StructType from [(name, simpleString)] on the given backend."""
+    if backend == PYSPARK:
+        from pyspark.sql import types as T
+
+        return T.StructType(
+            [T.StructField(n, _pyspark_type(dt), True) for n, dt in fields]
+        )
+    from tensorflowonspark_tpu.sparkapi.sql import StructField, StructType
+
+    return StructType([StructField(n, dt) for n, dt in fields])
+
+
+def create_dataframe(rdd, fields: Sequence[tuple[str, str]], backend: str,
+                     session: Any = None):
+    """A DataFrame over ``rdd`` with the given schema, lazily evaluated."""
+    schema = struct_type(fields, backend)
+    if backend == PYSPARK:
+        if session is None:
+            from pyspark.sql import SparkSession
+
+            session = SparkSession.builder.getOrCreate()
+        return session.createDataFrame(rdd, schema)
+    from tensorflowonspark_tpu.sparkapi.sql import DataFrame
+
+    return DataFrame(rdd, schema)
+
+
+def session_of(df: Any):
+    """The SparkSession a DataFrame belongs to (None on the substrate)."""
+    s = getattr(df, "sparkSession", None)
+    if s is not None:
+        return s
+    ctx = getattr(df, "sql_ctx", None)
+    return getattr(ctx, "sparkSession", None)

@@ -78,10 +78,13 @@ class Trainer:
                                                 batch_size=2 * data_world)
         init_args = _model_inputs(example)
 
-        # abstract init → shardings from flax partitioning metadata
-        boxed_shapes = jax.eval_shape(
+        # abstract init → shardings from flax partitioning metadata.
+        # Non-"params" collections (BatchNorm batch_stats) replicate.
+        all_shapes = jax.eval_shape(
             lambda: self.model.init(jax.random.PRNGKey(seed), *init_args)
-        )["params"]
+        )
+        boxed_shapes = all_shapes["params"]
+        col_shapes = {k: v for k, v in all_shapes.items() if k != "params"}
         self.param_shardings = param_sharding_from_metadata(
             boxed_shapes, self.mesh
         )
@@ -91,15 +94,22 @@ class Trainer:
             self.param_shardings = apply_zero_sharding(
                 self.param_shardings, self.mesh, unbox(boxed_shapes)
             )
+        col_shardings = jax.tree_util.tree_map(
+            lambda _: mesh_lib.replicated(self.mesh), unbox(col_shapes)
+        )
 
         # sharded init: params materialise already laid out across the mesh
         def _init():
-            return unbox(self.model.init(jax.random.PRNGKey(seed), *init_args))[
-                "params"
-            ]
+            variables = unbox(
+                self.model.init(jax.random.PRNGKey(seed), *init_args)
+            )
+            return (variables["params"],
+                    {k: v for k, v in variables.items() if k != "params"})
 
-        params = jax.jit(_init, out_shardings=self.param_shardings)()
-        self.state = create_train_state(params, self.optimizer)
+        params, collections = jax.jit(
+            _init, out_shardings=(self.param_shardings, col_shardings)
+        )()
+        self.state = create_train_state(params, self.optimizer, collections)
 
         self.train_step = make_train_step(
             self.loss_fn, self.optimizer, self.mesh, self.param_shardings,
@@ -108,6 +118,7 @@ class Trainer:
         self.eval_step = make_eval_step(
             self.forward_fn, self.mesh, self.param_shardings,
             example, sequence_axes=self.sequence_axes,
+            collections=self.state.collections,
         )
 
     # -- stepping ------------------------------------------------------------
@@ -121,6 +132,9 @@ class Trainer:
         return loss
 
     def predict(self, batch):
+        if getattr(self.forward_fn, "stateful", False):
+            return self.eval_step(self.state.params, self.state.collections,
+                                  self.shard(batch))
         return self.eval_step(self.state.params, self.shard(batch))
 
     @property
@@ -132,18 +146,25 @@ class Trainer:
     def save(self, path: str) -> None:
         from tensorflowonspark_tpu import ckpt
 
-        ckpt.save_pytree({"params": self.state.params,
-                          "opt_state": self.state.opt_state,
-                          "step": self.state.step}, path)
+        tree = {"params": self.state.params,
+                "opt_state": self.state.opt_state,
+                "step": self.state.step}
+        if self.state.collections:
+            tree["collections"] = self.state.collections
+        ckpt.save_pytree(tree, path)
 
     def restore(self, path: str) -> None:
         from tensorflowonspark_tpu import ckpt
 
-        restored = ckpt.load_pytree(path, {"params": self.state.params,
-                                           "opt_state": self.state.opt_state,
-                                           "step": self.state.step})
+        template = {"params": self.state.params,
+                    "opt_state": self.state.opt_state,
+                    "step": self.state.step}
+        if self.state.collections:
+            template["collections"] = self.state.collections
+        restored = ckpt.load_pytree(path, template)
         self.state = TrainState(restored["params"], restored["opt_state"],
-                                restored["step"])
+                                restored["step"],
+                                restored.get("collections", {}))
 
 
 def _model_inputs(batch: dict) -> tuple:

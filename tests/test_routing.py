@@ -1,0 +1,228 @@
+"""Result routing for multi-slot executors + sql_compat backend dispatch."""
+
+import queue
+import sys
+import types
+
+import pytest
+
+from tensorflowonspark_tpu import marker, sql_compat
+from tensorflowonspark_tpu.TFNode import DataFeed
+
+
+class FakeMgr:
+    """get_queue creates on demand, like the real TFManager server."""
+
+    def __init__(self):
+        self._queues = {}
+        self._kv = {}
+
+    def get_queue(self, name):
+        return self._queues.setdefault(name, queue.Queue())
+
+    def get(self, k, default=None):
+        return self._kv.get(k, default)
+
+    def set(self, k, v):
+        self._kv[k] = v
+
+
+def test_tagged_results_route_to_per_task_queues():
+    """Two interleaved feeders must each get exactly their own results."""
+    mgr = FakeMgr()
+    qin = mgr.get_queue("input")
+    # task A and task B interleave chunks, as two Spark task slots would
+    qin.put(marker.TaggedChunk("aaa", [(1,), (2,)]))
+    qin.put(marker.TaggedChunk("bbb", [(10,), (11,), (12,)]))
+    qin.put(marker.TaggedChunk("aaa", [(3,)]))
+    qin.put(marker.EndPartition())
+
+    feed = DataFeed(mgr, train_mode=False, input_mapping=["v"])
+    batch = feed.next_batch(6)
+    # one result per input row (the inference contract)
+    feed.batch_results([v * 100 for v in batch["v"].tolist()])
+
+    out_a = mgr.get_queue("output:aaa")
+    out_b = mgr.get_queue("output:bbb")
+    got_a = []
+    while not out_a.empty():
+        got_a.extend(out_a.get())
+    got_b = []
+    while not out_b.empty():
+        got_b.extend(out_b.get())
+    assert got_a == [100, 200, 300]
+    assert got_b == [1000, 1100, 1200]
+    assert mgr.get_queue("output").empty()  # nothing leaked to the shared q
+
+
+def test_tagged_results_split_across_batches():
+    """Routing survives batch boundaries that split a task's chunk."""
+    mgr = FakeMgr()
+    qin = mgr.get_queue("input")
+    qin.put(marker.TaggedChunk("t1", [(i,) for i in range(5)]))
+    qin.put(marker.EndPartition())
+    feed = DataFeed(mgr, train_mode=False, input_mapping=["v"])
+
+    b1 = feed.next_batch(3)
+    feed.batch_results([-v for v in b1["v"].tolist()])
+    b2 = feed.next_batch(3)
+    feed.batch_results([-v for v in b2["v"].tolist()])
+
+    out = mgr.get_queue("output:t1")
+    got = []
+    while not out.empty():
+        got.extend(out.get())
+    assert got == [0, -1, -2, -3, -4]
+
+
+def test_untagged_results_use_default_queue():
+    mgr = FakeMgr()
+    qin = mgr.get_queue("input")
+    qin.put([(7,), (8,)])  # plain chunk (train path / TFParallel)
+    qin.put(marker.EndPartition())
+    feed = DataFeed(mgr, input_mapping=["v"])
+    batch = feed.next_batch(4)
+    feed.batch_results(batch["v"].tolist())
+    assert mgr.get_queue("output").get() == [7, 8]
+
+
+def test_train_mode_bookkeeping_stays_bounded():
+    """Untagged consumption must coalesce to O(1) route entries."""
+    mgr = FakeMgr()
+    qin = mgr.get_queue("input")
+    for i in range(50):
+        qin.put([(i,), (i,)])
+    qin.put(marker.EndPartition())
+    feed = DataFeed(mgr, input_mapping=["v"])
+    for _ in range(25):
+        feed.next_batch(4)
+    assert len(feed._out_route) == 1  # single merged [None, 100] run
+
+
+# -- sql_compat backend dispatch --------------------------------------------
+
+
+def _install_fake_pyspark(monkeypatch):
+    """Minimal pyspark.sql stub proving dispatch avoids sparkapi entirely."""
+    pyspark = types.ModuleType("pyspark")
+    sql = types.ModuleType("pyspark.sql")
+    t = types.ModuleType("pyspark.sql.types")
+
+    class _Type:
+        def __init__(self, *a):
+            self.args = a
+
+        def __eq__(self, other):
+            return type(self) is type(other) and self.args == other.args
+
+    names = ["ByteType", "ShortType", "IntegerType", "LongType", "FloatType",
+             "DoubleType", "StringType", "BinaryType", "BooleanType"]
+    for n in names:
+        setattr(t, n, type(n, (_Type,), {}))
+    t.ArrayType = type("ArrayType", (_Type,), {})
+    t.StructField = type("StructField", (_Type,), {})
+    t.StructType = type("StructType", (_Type,), {})
+
+    class FakeRowFactory:
+        def __init__(self, *names):
+            self.names = names
+
+        def __call__(self, *values):
+            return ("pyspark-row", dict(zip(self.names, values)))
+
+    sql.Row = FakeRowFactory
+    sql.types = t
+    pyspark.sql = sql
+    monkeypatch.setitem(sys.modules, "pyspark", pyspark)
+    monkeypatch.setitem(sys.modules, "pyspark.sql", sql)
+    monkeypatch.setitem(sys.modules, "pyspark.sql.types", t)
+    return t
+
+
+def test_backend_of_detects_substrate():
+    from tensorflowonspark_tpu.sparkapi.sql import Row
+
+    assert sql_compat.backend_of(Row(a=1)) == sql_compat.SPARKAPI
+
+
+def test_make_row_pyspark_path(monkeypatch):
+    _install_fake_pyspark(monkeypatch)
+    row = sql_compat.make_row(["x", "y"], [1, 2], sql_compat.PYSPARK)
+    assert row == ("pyspark-row", {"x": 1, "y": 2})
+
+
+def test_struct_type_pyspark_path(monkeypatch):
+    t = _install_fake_pyspark(monkeypatch)
+    st = sql_compat.struct_type(
+        [("a", "bigint"), ("b", "array<double>")], sql_compat.PYSPARK)
+    assert isinstance(st, t.StructType)
+    fields = st.args[0]
+    assert isinstance(fields[0], t.StructField)
+    assert isinstance(fields[0].args[1], t.LongType)
+    assert isinstance(fields[1].args[1], t.ArrayType)
+    assert isinstance(fields[1].args[1].args[0], t.DoubleType)
+
+
+def test_struct_type_sparkapi_path():
+    st = sql_compat.struct_type([("a", "bigint")], sql_compat.SPARKAPI)
+    from tensorflowonspark_tpu.sparkapi.sql import StructType
+
+    assert isinstance(st, StructType)
+    assert st.fields[0].dataType == "bigint"
+
+
+def test_transform_is_lazy_no_driver_collect(tmp_path):
+    """TFModel.transform must NOT materialize the dataset on the driver:
+    executing it runs inference lazily when an action is taken."""
+    from tensorflowonspark_tpu import ckpt, pipeline
+    from tensorflowonspark_tpu.sparkapi import LocalSparkContext
+    from tensorflowonspark_tpu.sparkapi.sql import DataFrame, Row, infer_schema
+
+    sc = LocalSparkContext("local[2]", "routing-test")
+    rows = [Row(x=float(i)) for i in range(6)]
+    df = DataFrame(sc.parallelize(rows, 2), infer_schema(rows[0]))
+
+    export = tmp_path / "export"
+    ckpt.save_pytree({"params": {"w": 3.0}}, str(export))
+
+    def predict(params, inputs):
+        return {"pred": inputs["x"] * params["w"]}
+
+    model = pipeline.TFModel(predict_fn=predict)
+    model._set("export_dir", str(export))
+    model._set("input_mapping", {"x": "x"})
+    model._set("batch_size", 4)
+
+    out = model.transform(df)
+    # laziness: the returned DataFrame wraps a not-yet-computed RDD chain
+    # (the substrate computes at action time); the schema is already exact
+    assert out.schema.names == ["pred"]
+    vals = sorted(r["pred"] for r in out.rdd.collect())
+    assert vals == [0.0, 3.0, 6.0, 9.0, 12.0, 15.0]
+
+
+def test_out_queue_proxies_pruned_after_tag_drains():
+    mgr = FakeMgr()
+    qin = mgr.get_queue("input")
+    for t in ("t1", "t2", "t3"):
+        qin.put(marker.TaggedChunk(t, [(1,), (2,)]))
+    qin.put(marker.EndPartition())
+    feed = DataFeed(mgr, train_mode=False, input_mapping=["v"])
+    batch = feed.next_batch(6)
+    feed.batch_results([0] * 6)
+    # all three tags answered → only the default (None) entry remains
+    assert set(feed._out_queues) == {None}
+
+
+def test_plain_queue_typo_fails_fast():
+    import pytest
+    from tensorflowonspark_tpu import TFManager as tfm
+
+    tfm._queues.clear()
+    tfm._setup(["input", "output"], 8)
+    assert tfm._get_queue("input") is not None
+    with pytest.raises(KeyError):
+        tfm._get_queue("inputs")  # typo: no silent auto-create
+    assert tfm._get_queue("output:abc123") is not None  # dynamic: created
+    assert tfm._del_queue("output:abc123") is True
+    tfm._queues.clear()
