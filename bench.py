@@ -145,28 +145,52 @@ def measure(args) -> dict:
     if flops_per_step is None:
         flops_per_step = _analytic_flops(args.model, config, batch_size)
 
+    def timed_loop(state, sync_each_step):
+        loss = None
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, loss = step_fn(state, device_batch)
+            if sync_each_step:
+                float(jax.device_get(loss))  # hard host round-trip per step
+        # fetch the actual bytes, not just block_until_ready: the final loss
+        # data-depends on every step, and a remote backend can ack readiness
+        # without finishing, but it cannot hand back a value it hasn't
+        # computed
+        float(jax.device_get(loss))
+        return state, loss, time.perf_counter() - t0
+
     state = trainer.state
     loss = None
     for _ in range(args.warmup):
         state, loss = step_fn(state, device_batch)
     if loss is not None:
-        jax.block_until_ready(loss)
+        float(jax.device_get(loss))
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, loss = step_fn(state, device_batch)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    state, loss, dt = timed_loop(state, sync_each_step=False)
 
-    steps_per_sec = steps / dt
-    examples_per_sec = steps_per_sec * batch_size
     unit, target = TARGETS[args.model]
-    value = steps_per_sec if unit == "steps/sec" else examples_per_sec / n_chips
-
     peak = _peak_flops(jax.devices()[0].device_kind) if on_accel else None
-    mfu = None
-    if peak and flops_per_step:
-        mfu = flops_per_step * steps_per_sec / (peak * n_chips)
+
+    def derive(dt):
+        steps_per_sec = steps / dt
+        value = (steps_per_sec if unit == "steps/sec"
+                 else steps_per_sec * batch_size / n_chips)
+        mfu = (flops_per_step * steps_per_sec / (peak * n_chips)
+               if peak and flops_per_step else None)
+        return steps_per_sec, value, mfu
+
+    steps_per_sec, value, mfu = derive(dt)
+    synced = False
+    if mfu is not None and mfu > 1.0:
+        # >100% of peak is physically impossible: the backend acked the
+        # dispatches without finishing them (block_until_ready lied — seen on
+        # remote-tunnel backends).  Re-time forcing a host round-trip of the
+        # loss each step so every step provably completed.
+        print(f"bench: async timing gave impossible MFU {mfu:.2f}; "
+              "re-timing with per-step host sync", file=sys.stderr)
+        state, loss, dt = timed_loop(state, sync_each_step=True)
+        steps_per_sec, value, mfu = derive(dt)
+        synced = True
 
     result = {
         "metric": f"{args.model}_{unit.replace('/', '_per_').replace('.', '')}",
@@ -180,6 +204,10 @@ def measure(args) -> dict:
     }
     if mfu is not None:
         result["mfu"] = round(mfu, 4)
+        if mfu > 1.0:
+            result["timing_suspect"] = True  # impossible even after sync
+    if synced:
+        result["synced_timing"] = True
     if flops_per_step is not None:
         result["flops_per_step"] = flops_per_step
     return result

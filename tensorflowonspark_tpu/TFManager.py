@@ -64,6 +64,49 @@ def _del_queue(qname: str) -> bool:
     return _queues.pop(qname, None) is not None
 
 
+class _Router:
+    """Server-side delivery to per-task result queues.
+
+    Exposed as a proxied object (method calls on a proxy return plain
+    pickled values — a registered *callable*'s return would be AutoProxy-
+    wrapped, turning ``False`` into a truthy proxy).
+    """
+
+    def put(self, qname: str, item: Any, timeout: float = 300.0) -> bool:
+        """Put onto a per-task result queue ONLY if it still exists.
+
+        The trainer routes results through this instead of ``get_queue`` so
+        a task that timed out and deleted its queue gets its late results
+        dropped (returns False) — ``get_queue`` would silently re-create an
+        orphan queue nobody reads, leaking in the server and eventually
+        wedging the trainer on a full queue.  Existence is re-checked every
+        second while blocked so a deletion mid-put also unblocks.  Raises
+        ``queue.Full`` if the queue still exists but stayed full past
+        ``timeout`` (callers back-pressuring a live consumer should retry).
+        """
+        import time
+
+        deadline = time.monotonic() + timeout
+        while True:
+            q = _queues.get(qname)
+            if q is None:
+                return False
+            try:
+                q.put(item,
+                      timeout=min(1.0, max(0.01, deadline - time.monotonic())))
+                return True
+            except _queue_mod.Full:
+                if time.monotonic() >= deadline:
+                    raise
+
+
+_router = _Router()
+
+
+def _get_router() -> _Router:
+    return _router
+
+
 class _TFManagerBase(BaseManager):
     pass
 
@@ -71,6 +114,7 @@ class _TFManagerBase(BaseManager):
 _TFManagerBase.register("get_queue", callable=_get_queue)
 _TFManagerBase.register("get_kv", callable=_get_kv)
 _TFManagerBase.register("del_queue", callable=_del_queue)
+_TFManagerBase.register("get_router", callable=_get_router)
 
 
 class TFManager:
@@ -80,6 +124,7 @@ class TFManager:
         self._manager = manager
         self._owns_server = owns_server
         self._kv_proxy = None
+        self._router_proxy = None
 
     # -- reference API -----------------------------------------------------
 
@@ -98,6 +143,16 @@ class TFManager:
     def del_queue(self, qname: str) -> None:
         """Remove a dynamically-created queue from the server."""
         self._manager.del_queue(qname)
+
+    def put_route(self, qname: str, item: Any, timeout: float = 300.0) -> bool:
+        """Deliver ``item`` to a per-task result queue if it still exists.
+
+        Returns False (item dropped) when the queue was deleted — the
+        feeding task timed out and is gone.
+        """
+        if self._router_proxy is None:
+            self._router_proxy = self._manager.get_router()
+        return bool(self._router_proxy.put(qname, item, timeout))
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -16,6 +16,7 @@ instead of O(batch) pickled gets feeding a ``feed_dict``.
 from __future__ import annotations
 
 import logging
+import queue as _std_queue
 from typing import Any, Iterable, Sequence
 
 import numpy as np
@@ -60,7 +61,6 @@ class DataFeed:
         # (multi-slot executors; see marker.TaggedChunk)
         self._buffer_tags: list[list] = []
         self._out_route: list[list] = []
-        self._out_queues: dict[Any, Any] = {None: self._queue_out}
 
     # -- input -------------------------------------------------------------
 
@@ -119,11 +119,31 @@ class DataFeed:
         while i < len(results) and self._out_route:
             tag, count = self._out_route[0]
             n = min(count, len(results) - i)
-            self._route_queue(tag).put(results[i:i + n])
+            if tag is None:
+                self._queue_out.put(results[i:i + n])
+            else:
+                # server-side conditional put: if the feeding task timed out
+                # and deleted its queue, its late results are dropped instead
+                # of re-creating an orphan queue nobody reads.  A live-but-
+                # slow task's full queue raises Full per put_route timeout —
+                # keep back-pressuring (the pre-routing behavior), because
+                # only queue *deletion* means the consumer is gone.
+                while True:
+                    try:
+                        delivered = self.mgr.put_route(
+                            f"{self.qname_out}:{tag}", results[i:i + n],
+                            timeout=60.0,
+                        )
+                        break
+                    except _std_queue.Full:
+                        continue
+                if not delivered:
+                    logger.warning(
+                        "dropping %d late results for departed task %s", n, tag
+                    )
             i += n
             if n == count:
                 self._out_route.pop(0)
-                self._forget_tag(tag)
             else:
                 self._out_route[0][1] = count - n
         if i < len(results):  # surplus (no matching inputs): default queue
@@ -170,25 +190,6 @@ class DataFeed:
                 self._buffer_tags.pop(0)
             else:
                 self._buffer_tags[0][1] = c - n
-
-    def _route_queue(self, tag):
-        q = self._out_queues.get(tag)
-        if q is None:
-            q = self.mgr.get_queue(f"{self.qname_out}:{tag}")
-            self._out_queues[tag] = q
-        return q
-
-    def _forget_tag(self, tag) -> None:
-        """Drop a finished task's cached queue proxy (tags are per-task
-        uuids; a long-lived worker would otherwise accumulate one proxy per
-        partition task forever)."""
-        if tag is None:
-            return
-        if any(t == tag for t, _ in self._out_route):
-            return
-        if any(t == tag for t, _ in self._buffer_tags):
-            return
-        self._out_queues.pop(tag, None)
 
     def _columnarize(self, rows: list[Any], device_put: bool):
         if not rows:

@@ -160,6 +160,13 @@ def _background_main(fn_blob: bytes, args_blob: bytes, ctx: TFNodeContext) -> No
     mgr.set("trainer_pid", os.getpid())
     mgr.set("state", "running")
     try:
+        # Form the multi-host JAX runtime BEFORE user code runs (reference:
+        # TF_CONFIG was exported by the node runtime, not by map_fun) — a
+        # map_fun that forgets the call must not silently train per-host
+        # islands.  No-op on single-node clusters / chip-less "auto" mode.
+        from tensorflowonspark_tpu.parallel import distributed
+
+        distributed.maybe_initialize(ctx)
         fn = cloudpickle.loads(fn_blob)
         tf_args = cloudpickle.loads(args_blob)
         fn(tf_args, ctx)
@@ -297,6 +304,9 @@ class _MapFn:
             fn = cloudpickle.loads(self.fn_blob)
             tf_args = cloudpickle.loads(self.args_blob)
             try:
+                from tensorflowonspark_tpu.parallel import distributed
+
+                distributed.maybe_initialize(ctx)
                 fn(tf_args, ctx)
                 mgr.set("state", "finished")
             except BaseException:

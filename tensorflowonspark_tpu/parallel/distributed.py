@@ -66,7 +66,18 @@ def maybe_initialize(ctx) -> bool:
     util.ensure_jax_platform()
     import jax
 
+    if chip_info.get_num_host_chips() == 0:
+        # Forced multi-process on chip-less hosts (tests, CPU clusters): the
+        # CPU backend needs an explicit cross-process collectives impl before
+        # backend init, or every process sees only its own local devices.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # older jaxlib without gloo: proceed, islands only
+            logger.warning("CPU gloo collectives unavailable; "
+                           "cross-process collectives will not work")
+
     addr = coordinator_address(ctx.cluster_info)
+    timeout_s = int(os.environ.get("TFOS_JAX_DISTRIBUTED_TIMEOUT", "300"))
     logger.info(
         "jax.distributed.initialize(coordinator=%s, num_processes=%d, "
         "process_id=%d)", addr, num_nodes, ctx.executor_id,
@@ -75,6 +86,7 @@ def maybe_initialize(ctx) -> bool:
         coordinator_address=addr,
         num_processes=num_nodes,
         process_id=ctx.executor_id,
+        initialization_timeout=timeout_s,
     )
     _initialized = True
     return True

@@ -12,6 +12,19 @@ from __future__ import annotations
 from typing import Any, Callable, Iterable, Iterator
 
 
+def _fresh_copy(rows: list) -> list:
+    """Deep copies via a pickle round-trip — the same copies executor IPC
+    would have produced, minus the process hop."""
+    import pickle
+
+    try:
+        return pickle.loads(pickle.dumps(rows))
+    except Exception:  # exotic row types: cloudpickle, like run_job does
+        import cloudpickle
+
+        return pickle.loads(cloudpickle.dumps(rows))
+
+
 def _collect_action(_pindex: int, it: Iterator) -> list:
     return list(it)
 
@@ -113,11 +126,19 @@ class RDD:
 
     def collect(self) -> list:
         partitions, chain = self._resolved()
+        if not chain:
+            # already-materialized (cached / parallelized) data: no point
+            # round-tripping it through worker IPC for an identity job.
+            # Copies keep pyspark semantics (caller mutations must not
+            # corrupt the stored partitions).
+            return _fresh_copy([x for part in partitions for x in part])
         parts = self._sc.run_job(partitions, chain, _collect_action)
         return [x for part in parts for x in part]
 
     def count(self) -> int:
         partitions, chain = self._resolved()
+        if not chain:
+            return sum(len(part) for part in partitions)
         return sum(self._sc.run_job(partitions, chain, _count_action))
 
     def take(self, n: int) -> list:
@@ -128,6 +149,9 @@ class RDD:
         for i, part in enumerate(partitions):
             if len(out) >= n:
                 break
+            if not chain:
+                out.extend(_fresh_copy(list(part)))
+                continue
             res = self._sc.run_job([part], chain, _collect_action,
                                    base_index=i)
             out.extend(res[0])

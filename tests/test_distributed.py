@@ -1,0 +1,81 @@
+"""Multi-host ``jax.distributed`` formation through the real cluster path.
+
+VERDICT round-1 item 3: prove coordinator publication, process-id assignment,
+and a cross-process collective actually work.  Two separate executor
+processes each spawn a trainer; the node runtime (``TFSparkNode``) calls
+``distributed.maybe_initialize`` before user code, forming one global JAX
+runtime over both processes (CPU backend + gloo collectives — SURVEY.md §4's
+no-cluster trick).  The map_fun then runs a ``psum`` across the global device
+mesh and the test asserts the value crossed the process boundary.
+"""
+
+import sys
+
+import cloudpickle
+import pytest
+
+from tensorflowonspark_tpu import TFCluster, TFManager
+from tensorflowonspark_tpu.sparkapi import LocalSparkContext
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+def psum_fun(args, ctx):
+    """Runs in each spawned trainer AFTER the node runtime initialised
+    jax.distributed: a psum over the global mesh must see both processes."""
+    import numpy as np
+
+    from tensorflowonspark_tpu import util
+
+    util.ensure_jax_platform()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tensorflowonspark_tpu.parallel.ring_attention import _shard_map
+
+    devs = jax.devices()
+    local = jax.local_devices()
+    mesh = Mesh(devs, ("dp",))
+    fn = jax.jit(
+        _shard_map(
+            lambda x: jax.lax.psum(x, "dp"),
+            mesh, in_specs=P("dp"), out_specs=P(),
+        )
+    )
+    sharding = NamedSharding(mesh, P("dp"))
+    # every local device contributes (executor_id + 1): global psum must be
+    # n_local * (1 + 2) for a 2-node cluster — provably cross-process
+    shards = [
+        jax.device_put(jnp.full((1,), float(ctx.executor_id + 1)), d)
+        for d in local
+    ]
+    x = jax.make_array_from_single_device_arrays((len(devs),), sharding, shards)
+    out = fn(x)
+    val = float(np.asarray(out.addressable_shards[0].data)[0])
+    ctx.mgr.set("n_global", len(devs))
+    ctx.mgr.set("n_local", len(local))
+    ctx.mgr.set("psum", val)
+
+
+def test_cross_process_psum_through_cluster(monkeypatch):
+    monkeypatch.setenv("TFOS_JAX_DISTRIBUTED", "1")
+    monkeypatch.setenv("TFOS_JAX_DISTRIBUTED_TIMEOUT", "120")
+    # keep the global topology small: 1 virtual device per trainer process
+    monkeypatch.setenv("TFOS_HOST_DEVICE_COUNT", "1")
+    sc = LocalSparkContext("local-cluster[2,1,1024]", "distributed-test")
+    try:
+        cluster = TFCluster.run(sc, psum_fun, tf_args=None, num_executors=2,
+                                input_mode=TFCluster.InputMode.SPARK)
+        cluster.shutdown(grace_secs=180)
+        authkey = bytes.fromhex(cluster.cluster_meta["authkey_hex"])
+        for meta in cluster.cluster_info:
+            mgr = TFManager.connect(tuple(meta["addr"]), authkey)
+            assert mgr.get("state") == "finished"
+            n_local = mgr.get("n_local")
+            assert mgr.get("n_global") == 2 * n_local, (
+                "jax.distributed did not span both trainer processes"
+            )
+            assert mgr.get("psum") == pytest.approx(3.0 * n_local)
+    finally:
+        sc.stop()

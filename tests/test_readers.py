@@ -1,0 +1,95 @@
+"""InputMode.TENSORFLOW reader pipeline: sharding, interleave, shuffle,
+prefetch overlap (VERDICT round-1 item 5b)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import readers, tfrecord
+
+
+def _write_part(path: str, values: list[int]) -> None:
+    tfrecord.write_records(
+        path,
+        (tfrecord.encode_example({"v": (tfrecord.INT64_LIST, [v])})
+         for v in values),
+    )
+
+
+@pytest.fixture()
+def parts(tmp_path):
+    """4 part files, 8 records each, values encode (file, index)."""
+    paths = []
+    for f in range(4):
+        p = str(tmp_path / f"part-{f:05d}")
+        _write_part(p, [f * 100 + i for i in range(8)])
+        paths.append(p)
+    return paths
+
+
+def test_shard_files_strided_and_disjoint(parts, tmp_path):
+    s0 = readers.shard_files(str(tmp_path / "part-*"), 0, 2)
+    s1 = readers.shard_files(str(tmp_path / "part-*"), 1, 2)
+    assert sorted(s0 + s1) == sorted(parts)
+    assert not set(s0) & set(s1)
+
+
+def test_batches_cover_all_records_once(parts):
+    got = []
+    for batch in readers.tfrecord_batches(parts, 5, prefetch=2, readers=2):
+        got.extend(int(v[0]) for v in batch["v"])
+    expected = sorted(f * 100 + i for f in range(4) for i in range(8))
+    assert sorted(got) == expected
+
+
+def test_multiple_epochs_and_drop_remainder(parts):
+    batches = list(readers.tfrecord_batches(parts, 5, num_epochs=2,
+                                            drop_remainder=True, prefetch=0))
+    # 64 records over 2 epochs → 12 full batches of 5 per epoch
+    assert len(batches) == 12
+    assert all(len(b["v"]) == 5 for b in batches)
+
+
+def test_shuffle_changes_order_but_not_content(parts):
+    plain = [int(v[0]) for b in readers.tfrecord_batches(parts, 64, prefetch=0)
+             for v in b["v"]]
+    shuffled = [int(v[0]) for b in readers.tfrecord_batches(
+        parts, 64, shuffle_buffer=32, shuffle_files=True, seed=7, prefetch=0)
+        for v in b["v"]]
+    assert sorted(shuffled) == sorted(plain)
+    assert shuffled != plain
+
+
+def test_reader_error_surfaces(tmp_path, parts):
+    bad = str(tmp_path / "part-bad")
+    with open(bad, "wb") as f:
+        f.write(b"\x12\x34garbage-not-a-tfrecord")
+    with pytest.raises(Exception):
+        list(readers.tfrecord_batches(parts + [bad], 4, prefetch=2))
+
+
+def test_prefetch_overlaps_feed_and_compute(parts, tmp_path):
+    """With prefetch, wall time ≈ max(feed, compute), not their sum."""
+    n_batches = 8
+    work_s = 0.03
+    big = str(tmp_path / "part-big")
+    _write_part(big, list(range(n_batches * 4)))
+
+    def slow_parse(payload):
+        time.sleep(work_s / 4)  # 4 records per batch → work_s per batch
+        return readers.default_parse(payload)
+
+    def consume(prefetch):
+        t0 = time.perf_counter()
+        for batch in readers.tfrecord_batches([big], 4, parse_fn=slow_parse,
+                                              prefetch=prefetch):
+            time.sleep(work_s)  # simulated train step
+        return time.perf_counter() - t0
+
+    serial = consume(prefetch=0)
+    overlapped = consume(prefetch=2)
+    # serial ≈ n*(feed+compute); overlapped ≈ n*max(feed,compute) (+ramp).
+    # Assert a conservative 25% improvement to stay robust on loaded CI.
+    assert overlapped < serial * 0.75, (serial, overlapped)

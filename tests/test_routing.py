@@ -20,6 +20,13 @@ class FakeMgr:
     def get_queue(self, name):
         return self._queues.setdefault(name, queue.Queue())
 
+    def put_route(self, name, item, timeout=300.0):
+        q = self._queues.get(name)
+        if q is None:
+            return False
+        q.put(item)
+        return True
+
     def get(self, k, default=None):
         return self._kv.get(k, default)
 
@@ -36,14 +43,15 @@ def test_tagged_results_route_to_per_task_queues():
     qin.put(marker.TaggedChunk("bbb", [(10,), (11,), (12,)]))
     qin.put(marker.TaggedChunk("aaa", [(3,)]))
     qin.put(marker.EndPartition())
+    # the feeding tasks create their result queues up front (_InferenceFn)
+    out_a = mgr.get_queue("output:aaa")
+    out_b = mgr.get_queue("output:bbb")
 
     feed = DataFeed(mgr, train_mode=False, input_mapping=["v"])
     batch = feed.next_batch(6)
     # one result per input row (the inference contract)
     feed.batch_results([v * 100 for v in batch["v"].tolist()])
 
-    out_a = mgr.get_queue("output:aaa")
-    out_b = mgr.get_queue("output:bbb")
     got_a = []
     while not out_a.empty():
         got_a.extend(out_a.get())
@@ -61,6 +69,7 @@ def test_tagged_results_split_across_batches():
     qin = mgr.get_queue("input")
     qin.put(marker.TaggedChunk("t1", [(i,) for i in range(5)]))
     qin.put(marker.EndPartition())
+    out = mgr.get_queue("output:t1")
     feed = DataFeed(mgr, train_mode=False, input_mapping=["v"])
 
     b1 = feed.next_batch(3)
@@ -68,7 +77,6 @@ def test_tagged_results_split_across_batches():
     b2 = feed.next_batch(3)
     feed.batch_results([-v for v in b2["v"].tolist()])
 
-    out = mgr.get_queue("output:t1")
     got = []
     while not out.empty():
         got.extend(out.get())
@@ -201,17 +209,19 @@ def test_transform_is_lazy_no_driver_collect(tmp_path):
     assert vals == [0.0, 3.0, 6.0, 9.0, 12.0, 15.0]
 
 
-def test_out_queue_proxies_pruned_after_tag_drains():
+def test_late_results_for_departed_task_dropped():
+    """A task that timed out and deleted its result queue must have its late
+    results dropped — not delivered into a recreated orphan queue."""
     mgr = FakeMgr()
     qin = mgr.get_queue("input")
-    for t in ("t1", "t2", "t3"):
-        qin.put(marker.TaggedChunk(t, [(1,), (2,)]))
+    qin.put(marker.TaggedChunk("gone", [(1,), (2,)]))
     qin.put(marker.EndPartition())
     feed = DataFeed(mgr, train_mode=False, input_mapping=["v"])
-    batch = feed.next_batch(6)
-    feed.batch_results([0] * 6)
-    # all three tags answered → only the default (None) entry remains
-    assert set(feed._out_queues) == {None}
+    feed.next_batch(4)
+    # the task's queue was never created / already deleted (task departed)
+    feed.batch_results([9, 9])
+    assert "output:gone" not in mgr._queues
+    assert mgr.get_queue("output").empty()
 
 
 def test_plain_queue_typo_fails_fast():
