@@ -27,15 +27,20 @@ if _repo_root not in sys.path:
 
 
 def map_fun(args, ctx):
-    """TENSORFLOW-mode trainer: read own TFRecord shard, train, export."""
+    """TENSORFLOW-mode trainer: read own TFRecord shard, train, export.
+
+    The input pipeline is :mod:`tensorflowonspark_tpu.readers` — sharded
+    part files, ``args.readers`` parallel reader threads, a shuffle
+    reservoir, and a prefetch thread that stages the next batch onto the
+    mesh (``device_put`` with the trainer's shardings) while the current
+    one trains.
+    """
     from tensorflowonspark_tpu import util
 
     util.ensure_jax_platform()
-    import glob as g
-
     import numpy as np
 
-    from tensorflowonspark_tpu import tfrecord
+    from tensorflowonspark_tpu import metrics, readers, tfrecord
     from tensorflowonspark_tpu.models import cifar
     from tensorflowonspark_tpu.parallel import distributed
     from tensorflowonspark_tpu.trainer import Trainer
@@ -43,33 +48,43 @@ def map_fun(args, ctx):
     distributed.maybe_initialize(ctx)
     config = cifar.Config.tiny() if args.tiny else cifar.Config()
     trainer = Trainer("cifar10_cnn", config=config, learning_rate=args.lr)
-
-    # file-level sharding: every node takes a strided slice of part files
-    files = sorted(g.glob(os.path.join(args.data_dir, "part-*")))
-    shard = files[ctx.task_index::ctx.num_workers]
+    reporter = metrics.MetricsReporter(ctx, interval=5)
+    trainer.add_step_callback(reporter)
     side = config.image_size
 
-    def batches():
-        for epoch in range(args.epochs):
-            images, labels = [], []
-            for path in shard:
-                for payload in tfrecord.read_records(path):
-                    ex = tfrecord.decode_example(payload)
-                    images.append(np.asarray(ex["image"][1], np.float32)
-                                  .reshape(side, side, 3))
-                    labels.append(ex["label"][1][0])
-                    if len(images) == args.batch_size:
-                        yield {"image": np.stack(images) / 255.0,
-                               "label": np.asarray(labels, np.int32)}
-                        images, labels = [], []
+    def parse(payload):
+        ex = tfrecord.decode_example(payload)
+        return {
+            "image": np.asarray(ex["image"][1], np.float32)
+            .reshape(side, side, 3) / 255.0,
+            "label": np.int32(ex["label"][1][0]),
+        }
 
+    # file-level sharding: every node takes a strided slice of part files
+    shard = readers.shard_files(os.path.join(args.data_dir, "part-*"),
+                                ctx.task_index, ctx.num_workers)
     loss, steps = None, 0
-    for batch in batches():
+    for batch in readers.tfrecord_batches(
+        shard,
+        args.batch_size,
+        parse_fn=parse,
+        num_epochs=args.epochs,
+        readers=args.readers,
+        shuffle_buffer=args.shuffle_buffer,
+        shuffle_files=True,
+        seed=ctx.task_index,
+        drop_remainder=True,
+        prefetch=2,
+        device_put=trainer.shard,  # stage onto the mesh in the pipeline thread
+    ):
         loss = trainer.step(batch)
         steps += 1
-    ctx.mgr.set("final_loss", float(loss) if loss is not None else None)
+    snap = reporter.publish()
+    ctx.mgr.set("final_loss",
+                float(np.asarray(loss).mean()) if loss is not None else None)
     ctx.mgr.set("steps", steps)
     ctx.mgr.set("shard_files", [os.path.basename(f) for f in shard])
+    ctx.mgr.set("examples_per_sec", snap["examples_per_sec"])
     if args.model_dir and ctx.executor_id == 0:
         from tensorflowonspark_tpu import compat
 
@@ -102,6 +117,9 @@ def main(argv=None):
     p.add_argument("--batch_size", type=int, default=64)
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--num_samples", type=int, default=2048)
+    p.add_argument("--readers", type=int, default=2,
+                   help="parallel reader threads per node (HasReaders parity)")
+    p.add_argument("--shuffle_buffer", type=int, default=512)
     p.add_argument("--data_dir", default="/tmp/cifar10_tfr")
     p.add_argument("--model_dir", default=None)
     p.add_argument("--tiny", action="store_true",
@@ -136,6 +154,9 @@ def main(argv=None):
         print(f"node {meta['job_name']}:{meta['task_index']} "
               f"loss={mgr.get('final_loss'):.4f} steps={mgr.get('steps')} "
               f"shard={mgr.get('shard_files')}")
+    agg = cluster.metrics()
+    print(f"cluster: {agg['total_examples_per_sec']} examples/sec "
+          f"({agg['num_reporting']} nodes reporting)")
     sc.stop()
 
 

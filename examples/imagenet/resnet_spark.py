@@ -6,7 +6,18 @@ executors; ``SURVEY.md §1 L6``).  Each executor hosts one slice-local mesh
 shards over dp, gradients ``psum`` over ICI — the reference's
 near-linear-scaling claim is the scenario this reproduces on TPU.
 
-Reports per-node step throughput, the headline ``BASELINE.json`` metric.
+Two input paths:
+
+- default: TFRecords under ``--data_dir`` (synthesised on first run), read
+  through :mod:`tensorflowonspark_tpu.readers` — sharded part files,
+  ``--readers`` parallel reader threads, shuffle, and prefetch staging the
+  next batch onto the mesh while the current one trains;
+- ``--synthetic``: a device-resident batch, measuring the pure compute
+  ceiling (what ``bench.py`` reports).
+
+Throughput is reported through the step-metrics hook
+(``metrics.MetricsReporter`` → ``TFCluster.metrics()``), the headline
+``BASELINE.json`` metric.
 
     python examples/imagenet/resnet_spark.py --cluster_size 2 --tiny
 """
@@ -26,10 +37,9 @@ def map_fun(args, ctx):
     from tensorflowonspark_tpu import util
 
     util.ensure_jax_platform()
-    import time
+    import numpy as np
 
-    import jax
-
+    from tensorflowonspark_tpu import metrics, readers
     from tensorflowonspark_tpu.models import resnet
     from tensorflowonspark_tpu.parallel import distributed
     from tensorflowonspark_tpu.trainer import Trainer
@@ -37,28 +47,44 @@ def map_fun(args, ctx):
     distributed.maybe_initialize(ctx)
     config = resnet.Config.tiny() if args.tiny else resnet.Config()
     trainer = Trainer("resnet50", config=config, learning_rate=args.lr)
+    reporter = metrics.MetricsReporter(ctx, interval=5)
+    trainer.add_step_callback(reporter)
+    side = config.image_size
 
-    # synthetic ImageNet-shaped shard (TFRecord/imagenet readers plug in via
-    # --data_dir once real data is mounted; the compute path is identical)
-    batch = resnet.example_batch(config, batch_size=args.batch_size,
-                                 seed=ctx.task_index)
-    device_batch = trainer.shard(batch)
+    loss = None
+    if args.synthetic:
+        # pure-compute ceiling: one device-resident batch, no input pipeline
+        batch = resnet.example_batch(config, batch_size=args.batch_size,
+                                     seed=ctx.task_index)
+        device_batch = trainer.shard(batch)
+        state = trainer.state
+        for _ in range(args.warmup):
+            state, loss = trainer.train_step(state, device_batch)
+        trainer.state = state
+        for _ in range(args.steps):
+            loss = trainer.step(device_batch)
+    else:
+        shard = readers.shard_files(os.path.join(args.data_dir, "part-*"),
+                                    ctx.task_index, ctx.num_workers)
+        for batch in readers.tfrecord_batches(
+            shard,
+            args.batch_size,
+            parse_fn=resnet.tfrecord_parse_fn(side),
+            num_epochs=args.epochs,
+            readers=args.readers,
+            shuffle_buffer=args.shuffle_buffer,
+            shuffle_files=True,
+            seed=ctx.task_index,
+            drop_remainder=True,
+            prefetch=2,
+            device_put=trainer.shard,  # stage onto the mesh while training
+        ):
+            loss = trainer.step(batch)
 
-    state, loss = trainer.state, None
-    for _ in range(args.warmup):
-        state, loss = trainer.train_step(state, device_batch)
-    if loss is not None:
-        jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        state, loss = trainer.train_step(state, device_batch)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-    trainer.state = state
-
-    ips = args.steps * args.batch_size / dt
-    ctx.mgr.set("images_per_sec", round(ips, 2))
-    ctx.mgr.set("final_loss", float(loss))
+    snap = reporter.publish()
+    ctx.mgr.set("images_per_sec", snap["examples_per_sec"])
+    ctx.mgr.set("final_loss",
+                float(np.asarray(loss).mean()) if loss is not None else None)
     if args.model_dir and ctx.executor_id == 0:
         from tensorflowonspark_tpu import compat
 
@@ -66,20 +92,47 @@ def map_fun(args, ctx):
             {"params": trainer.params}, ctx.absolute_path(args.model_dir))
 
 
+def prep_tfrecords(data_dir: str, n: int, parts: int, side: int,
+                   seed: int = 0) -> None:
+    """Synthesise ImageNet-shaped TFRecords (shared schema helper)."""
+    from tensorflowonspark_tpu.models import resnet
+
+    resnet.write_synthetic_tfrecords(data_dir, n, parts, side, seed)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--cluster_size", type=int, default=2)
     p.add_argument("--batch_size", type=int, default=32)
-    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--steps", type=int, default=10,
+                   help="steps for --synthetic mode")
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--num_samples", type=int, default=512)
+    p.add_argument("--readers", type=int, default=2,
+                   help="parallel reader threads per node (HasReaders parity)")
+    p.add_argument("--shuffle_buffer", type=int, default=256)
+    p.add_argument("--data_dir", default="/tmp/imagenet_tfr",
+                   help="TFRecord dir (synthesised on first run)")
+    p.add_argument("--synthetic", action="store_true",
+                   help="skip the input pipeline; device-resident batch")
     p.add_argument("--model_dir", default=None)
     p.add_argument("--tiny", action="store_true")
     p.add_argument("--master", default=None)
     args = p.parse_args(argv)
 
-    from tensorflowonspark_tpu import TFCluster, TFManager
+    from tensorflowonspark_tpu import TFCluster
+    from tensorflowonspark_tpu.models import resnet
     from tensorflowonspark_tpu.sparkapi import get_spark_context
+
+    if not args.synthetic:
+        import glob
+
+        side = (resnet.Config.tiny() if args.tiny else resnet.Config()).image_size
+        if not glob.glob(os.path.join(args.data_dir, "part-*")):
+            prep_tfrecords(args.data_dir, args.num_samples,
+                           args.cluster_size * 2, side)
 
     sc = get_spark_context(
         args.master or f"local-cluster[{args.cluster_size},1,1024]",
@@ -90,15 +143,13 @@ def main(argv=None):
     )
     cluster.shutdown(grace_secs=600)
 
-    authkey = bytes.fromhex(cluster.cluster_meta["authkey_hex"])
-    total = 0.0
-    for meta in cluster.cluster_info:
-        mgr = TFManager.connect(tuple(meta["addr"]), authkey)
-        ips = mgr.get("images_per_sec")
-        total += ips
-        print(f"node {meta['job_name']}:{meta['task_index']} "
-              f"{ips} images/sec (loss {mgr.get('final_loss'):.3f})")
-    print(f"cluster total: {total:.2f} images/sec")
+    agg = cluster.metrics()
+    for name, snap in agg["nodes"].items():
+        loss = snap["loss"]
+        print(f"node {name}: {snap['examples_per_sec']} images/sec "
+              f"(loss {loss:.3f} @ step {snap['step']})" if loss is not None
+              else f"node {name}: no steps ran (empty shard?)")
+    print(f"cluster total: {agg['total_examples_per_sec']} images/sec")
     sc.stop()
 
 

@@ -72,6 +72,24 @@ class TFCluster:
             )
             self._check_bootstrap_error()
 
+    def train_stream(self, dstream, feed_timeout: float = 600.0,
+                     qname: str = "input") -> None:
+        """Feed a Spark Streaming DStream through the cluster.
+
+        Reference anchor: ``TFCluster.py::TFCluster.train`` accepts a DStream
+        in streaming jobs — every micro-batch RDD's partitions are pushed
+        into the same per-executor queues as :meth:`train`.  Works with any
+        object exposing ``foreachRDD`` (a pyspark ``DStream``); pair with
+        ``shutdown(ssc=...)`` which drains the queues before stopping the
+        streaming context.
+        """
+        if self.input_mode is not InputMode.SPARK:
+            raise RuntimeError("train_stream(dstream) requires InputMode.SPARK")
+        self._check_bootstrap_error()
+        feed_fn = TFSparkNode.train(self.cluster_info, self.cluster_meta,
+                                    feed_timeout, qname)
+        dstream.foreachRDD(lambda rdd: rdd.foreachPartition(feed_fn))
+
     def inference(self, dataRDD, qname_in: str = "input",
                   qname_out: str = "output", timeout: float = 600.0):
         """Run distributed inference; returns an RDD of predictions.
@@ -96,8 +114,14 @@ class TFCluster:
         mode, sends a stop marker to every node's feed queue and waits up to
         ``grace_secs`` for each trainer to finish; in TENSORFLOW mode waits
         for the (blocking) bootstrap job to complete.
+
+        ``ssc`` (streaming jobs): the reference waits for the input queues to
+        drain, then stops the StreamingContext gracefully without stopping
+        the SparkContext — same here.  Pass the context whose DStream was fed
+        via :meth:`train_stream`.
         """
-        del ssc  # streaming contexts are not supported by the local substrate
+        if ssc is not None:
+            self._drain_and_stop_streaming(ssc, timeout, qname)
         try:
             if self.input_mode is InputMode.SPARK:
                 n = self.num_executors
@@ -113,6 +137,62 @@ class TFCluster:
             self._check_bootstrap_error()
         finally:
             self.server.stop()
+
+    def _drain_and_stop_streaming(self, ssc, timeout: float, qname: str) -> None:
+        """Wait until every node's feed queue is empty, then stop ``ssc``
+        gracefully (keeping the SparkContext alive, reference semantics)."""
+        import time as _time
+
+        from tensorflowonspark_tpu import TFManager
+
+        authkey = bytes.fromhex(self.cluster_meta["authkey_hex"])
+        try:
+            queues = [
+                TFManager.connect(tuple(m["addr"]), authkey).get_queue(qname)
+                for m in self.cluster_info
+            ]
+        except Exception:
+            queues = []  # nodes already gone; nothing left to drain
+        deadline = _time.monotonic() + timeout
+        while queues and _time.monotonic() < deadline:
+            try:
+                pending = sum(q.qsize() for q in queues)
+            except Exception:
+                break
+            if pending == 0:
+                break
+            _time.sleep(0.25)
+        else:
+            logger.warning("streaming queues not drained after %ss", timeout)
+        try:
+            ssc.stop(stopSparkContext=False, stopGraceFully=True)
+        except TypeError:  # older pyspark: positional-only
+            ssc.stop(False, True)
+
+    def metrics(self, key: str = "metrics") -> dict:
+        """Collect per-node step metrics and the cluster rollup.
+
+        Nodes publish snapshots via :class:`metrics.MetricsReporter` (a
+        ``Trainer`` step callback writing to the node kv blackboard); this
+        gathers them and sums throughput.  Returns ``metrics.aggregate``'s
+        shape: ``{"nodes": {...}, "total_examples_per_sec": N, ...}``.
+        Replaces the reference-era ad-hoc per-example kv entries.
+        """
+        from tensorflowonspark_tpu import TFManager, metrics as metrics_lib
+
+        authkey = bytes.fromhex(self.cluster_meta["authkey_hex"])
+        per_node: dict[str, dict] = {}
+        for meta in self.cluster_info:
+            name = f"{meta['job_name']}:{meta['task_index']}"
+            try:
+                mgr = TFManager.connect(tuple(meta["addr"]), authkey)
+                snap = mgr.get(key)
+            except Exception as e:
+                logger.warning("metrics: node %s unreachable: %s", name, e)
+                snap = None
+            if snap:
+                per_node[name] = snap
+        return metrics_lib.aggregate(per_node)
 
     def tensorboard_url(self, timeout: float = 0.0) -> str | None:
         """URL of the cluster's TensorBoard, if one was started.

@@ -35,6 +35,14 @@ class DataFeed:
     ``["image", "label"]``; ``next_batch`` then returns ``{"image": ndarray,
     "label": ndarray}``.  Without it, batches are returned as a list of
     per-column arrays.
+
+    ``prefetch > 0`` double-buffers the feed: a pipeline thread assembles,
+    columnarizes, and (with ``device_put``) stages batch N+1 into HBM while
+    the caller trains on batch N, so step time approaches
+    ``max(compute, feed)`` instead of their sum (``SURVEY.md §3.2`` hard
+    part (b)).  Marker semantics and inference-result routing are identical
+    to the synchronous path: row provenance is recorded when a batch is
+    *handed out*, not when it is staged.
     """
 
     def __init__(
@@ -44,12 +52,14 @@ class DataFeed:
         qname_in: str = "input",
         qname_out: str = "output",
         input_mapping: Sequence[str] | None = None,
+        prefetch: int = 0,
     ):
         self.mgr = mgr
         self.train_mode = train_mode
         self.qname_in = qname_in
         self.qname_out = qname_out
         self.input_mapping = list(input_mapping) if input_mapping else None
+        self.prefetch = int(prefetch)
         self.done_feeding = False
         self._queue_in = mgr.get_queue(qname_in)
         self._queue_out = mgr.get_queue(qname_out)
@@ -61,6 +71,9 @@ class DataFeed:
         # (multi-slot executors; see marker.TaggedChunk)
         self._buffer_tags: list[list] = []
         self._out_route: list[list] = []
+        self._stop_seen = False  # StopFeed consumed by the assembling side
+        self._pf_thread = None
+        self._pf_out: _std_queue.Queue | None = None
 
     # -- input -------------------------------------------------------------
 
@@ -70,25 +83,41 @@ class DataFeed:
         Blocks until a full batch accumulated, a partition/stop marker is
         seen (short batch — possibly empty), or the feed terminates.  With
         ``device_put=True`` the arrays are transferred to the default JAX
-        device before returning (host→HBM once per batch).
+        device before returning (host→HBM once per batch); ``device_put``
+        may also be a callable applied to the columnar batch (e.g.
+        ``Trainer.shard`` to stage with mesh shardings).
 
         Reference anchor: ``TFNode.py::DataFeed.next_batch`` — same marker
         semantics (``Marker``/``EndPartition`` end a batch early), different
         payload shape (chunked columnar, not row-at-a-time).
         """
-        while len(self._buffer) < batch_size and not self.done_feeding:
+        if self.prefetch > 0:
+            return self._next_batch_prefetched(batch_size, device_put)
+        rows, runs, stopped = self._assemble(batch_size)
+        if stopped:
+            self.done_feeding = True
+        for tag, count in runs:
+            self._note_rows(self._out_route, tag, count)
+        return self._columnarize(rows, device_put)
+
+    def _assemble(self, batch_size: int):
+        """Pull queue items until ``batch_size`` rows are buffered, a marker
+        ends the batch early, or the stop marker arrives.  Returns
+        ``(rows, provenance_runs, stop_seen)``; does NOT touch
+        ``_out_route`` — the caller does, at hand-out time."""
+        while len(self._buffer) < batch_size and not self._stop_seen:
             item = self._queue_in.get()
             if isinstance(item, marker.StopFeed):
-                self.done_feeding = True
-            elif isinstance(item, marker.Marker):
-                # EndPartition / generic marker: release what we have (the
-                # feeder's partition ended); empty buffer yields empty batch
-                break
+                self._stop_seen = True
             elif isinstance(item, marker.TaggedChunk):
                 self._buffer.extend(item.rows)
                 self._note_rows(self._buffer_tags, item.tag, len(item.rows))
                 if len(self._buffer) >= batch_size:
                     break
+            elif isinstance(item, marker.Marker):
+                # EndPartition / generic marker: release what we have (the
+                # feeder's partition ended); empty buffer yields empty batch
+                break
             else:
                 rows = item if isinstance(item, list) else [item]
                 self._buffer.extend(rows)
@@ -97,8 +126,45 @@ class DataFeed:
                     break
         rows = self._buffer[:batch_size]
         self._buffer = self._buffer[batch_size:]
-        self._consume_tags(len(rows))
-        return self._columnarize(rows, device_put)
+        runs = self._take_tags(len(rows))
+        return rows, runs, self._stop_seen
+
+    def _next_batch_prefetched(self, batch_size: int, device_put):
+        """Double-buffered path: batches staged by a pipeline thread."""
+        if self.done_feeding:  # pump already drained; mirror sync behavior
+            return self._columnarize([], device_put)
+        if self._pf_thread is None:
+            self._start_prefetch(batch_size, device_put)
+        item = self._pf_out.get()
+        if isinstance(item, BaseException):
+            raise item
+        batch, runs, stopped = item
+        if stopped:
+            self.done_feeding = True
+        for tag, count in runs:
+            self._note_rows(self._out_route, tag, count)
+        return batch
+
+    def _start_prefetch(self, batch_size: int, device_put) -> None:
+        import threading
+
+        self._pf_out = _std_queue.Queue(maxsize=self.prefetch)
+
+        def pump() -> None:
+            try:
+                while True:
+                    rows, runs, stopped = self._assemble(batch_size)
+                    batch = self._columnarize(rows, device_put)
+                    self._pf_out.put((batch, runs, stopped))
+                    if stopped:
+                        return
+            except BaseException as e:  # re-raised in next_batch
+                self._pf_out.put(e)
+
+        self._pf_thread = threading.Thread(
+            target=pump, daemon=True, name="tfos-datafeed-prefetch"
+        )
+        self._pf_thread.start()
 
     def should_stop(self) -> bool:
         """True once the stop marker has been consumed (end of feeding)."""
@@ -152,16 +218,23 @@ class DataFeed:
     def terminate(self) -> None:
         """Drain remaining input so blocked feeder tasks can finish.
 
-        Reference anchor: ``TFNode.py::DataFeed.terminate``.
+        Reference anchor: ``TFNode.py::DataFeed.terminate``.  With an active
+        prefetch thread the staged batches are discarded too; the (daemon)
+        pipeline thread exits with the trainer process.
         """
         logger.info("DataFeed terminating: draining input queue")
         self.done_feeding = True
-        import queue as q
-
+        self._stop_seen = True
+        if self._pf_out is not None:
+            while True:  # discard staged batches so the pump can finish
+                try:
+                    self._pf_out.get_nowait()
+                except _std_queue.Empty:
+                    break
         while True:
             try:
                 self._queue_in.get(timeout=1.0)
-            except q.Empty:
+            except _std_queue.Empty:
                 return
             except (EOFError, BrokenPipeError):
                 return
@@ -179,19 +252,21 @@ class DataFeed:
         else:
             runs.append([tag, count])
 
-    def _consume_tags(self, count: int) -> None:
-        """Move ``count`` rows' provenance from buffered to handed-out."""
+    def _take_tags(self, count: int) -> list[list]:
+        """Detach ``count`` rows' provenance runs from the buffered side."""
+        runs: list[list] = []
         while count > 0 and self._buffer_tags:
             tag, c = self._buffer_tags[0]
             n = min(c, count)
-            self._note_rows(self._out_route, tag, n)
+            self._note_rows(runs, tag, n)
             count -= n
             if n == c:
                 self._buffer_tags.pop(0)
             else:
                 self._buffer_tags[0][1] = c - n
+        return runs
 
-    def _columnarize(self, rows: list[Any], device_put: bool):
+    def _columnarize(self, rows: list[Any], device_put):
         if not rows:
             return {} if self.input_mapping else []
         first = rows[0]
@@ -200,16 +275,21 @@ class DataFeed:
             cols = [np.asarray([r[c] for r in rows]) for c in range(ncols)]
         else:
             cols = [np.asarray(rows)]
+        if self.input_mapping and len(self.input_mapping) != len(cols):
+            raise ValueError(
+                f"input_mapping has {len(self.input_mapping)} names but rows "
+                f"have {len(cols)} columns"
+            )
+        if callable(device_put):
+            return device_put(
+                dict(zip(self.input_mapping, cols)) if self.input_mapping
+                else cols
+            )
         if device_put:
             import jax
 
             cols = [jax.device_put(c) for c in cols]
         if self.input_mapping:
-            if len(self.input_mapping) != len(cols):
-                raise ValueError(
-                    f"input_mapping has {len(self.input_mapping)} names but rows "
-                    f"have {len(cols)} columns"
-                )
             return dict(zip(self.input_mapping, cols))
         return cols
 

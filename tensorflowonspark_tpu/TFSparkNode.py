@@ -96,11 +96,13 @@ class TFNodeContext:
         qname_in: str = "input",
         qname_out: str = "output",
         input_mapping=None,
+        prefetch: int = 0,
     ):
         """Build a :class:`tensorflowonspark_tpu.TFNode.DataFeed` for this node."""
         from tensorflowonspark_tpu.TFNode import DataFeed
 
-        return DataFeed(self.mgr, train_mode, qname_in, qname_out, input_mapping)
+        return DataFeed(self.mgr, train_mode, qname_in, qname_out, input_mapping,
+                        prefetch=prefetch)
 
     def absolute_path(self, path: str) -> str:
         """Reference anchor: ``TFNode.py::hdfs_path`` (ctx method form)."""

@@ -8,9 +8,11 @@ A brand-new framework with the capabilities of TensorFlowOnSpark
 - Spark (or the bundled process-per-executor local substrate in
   ``tensorflowonspark_tpu.sparkapi``) remains the resource manager and data
   substrate;
-- RDD/DataFrame partitions are batched columnar and double-buffered into
-  HBM-resident device arrays instead of being fed row-at-a-time through
-  pickled queues.
+- RDD/DataFrame partitions are fed as chunked columnar batches instead of
+  row-at-a-time pickled queues; ``DataFeed(..., prefetch=N)`` double-buffers
+  them into HBM-resident device arrays (a pipeline thread stages batch N+1
+  while N trains), and :mod:`tensorflowonspark_tpu.readers` does the same
+  for file-based (``InputMode.TENSORFLOW``) input.
 
 Public surface mirrors the reference package:
 

@@ -24,7 +24,6 @@ Everything is pull-based and bounded; no unbounded buffering.
 
 from __future__ import annotations
 
-import glob as _glob
 import logging
 import queue as _queue_mod
 import threading
@@ -32,7 +31,7 @@ from typing import Any, Callable, Iterator, Sequence
 
 import numpy as np
 
-from tensorflowonspark_tpu import tfrecord
+from tensorflowonspark_tpu import fs, tfrecord
 
 logger = logging.getLogger(__name__)
 
@@ -44,12 +43,14 @@ def shard_files(
 ) -> list[str]:
     """Deterministic ``task_index``-strided file shard for one node.
 
-    ``files`` may be a list or a glob pattern.  Sorting before striding makes
-    every node's view consistent without coordination (same trick the
-    reference's examples used with ``tf.data`` auto-shard by file).
+    ``files`` may be a list or a glob pattern (scheme paths like
+    ``hdfs://…/part-*`` resolve through :mod:`tensorflowonspark_tpu.fs`).
+    Sorting before striding makes every node's view consistent without
+    coordination (same trick the reference's examples used with ``tf.data``
+    auto-shard by file).
     """
     if isinstance(files, str):
-        files = _glob.glob(files)
+        files = fs.glob(files)
     ordered = sorted(files)
     if num_shards <= 1:
         return ordered
@@ -78,6 +79,10 @@ class _ReaderPool:
         self.records: _queue_mod.Queue = _queue_mod.Queue(maxsize=capacity)
         self._n = max(1, readers)
         self._stop = threading.Event()
+        # reader exceptions land here; _record_stream re-raises after all
+        # producers finish so a corrupt file fails the dataset instead of
+        # silently truncating it
+        self.errors: list[BaseException] = []
         self._threads = [
             threading.Thread(target=self._read_loop, daemon=True,
                              name=f"tfos-reader-{i}")
@@ -85,6 +90,17 @@ class _ReaderPool:
         ]
         for t in self._threads:
             t.start()
+
+    def _put(self, item) -> bool:
+        """Blocking put that gives up once the pool is stopped (so producers
+        never wedge on a full queue after the consumer has gone away)."""
+        while not self._stop.is_set():
+            try:
+                self.records.put(item, timeout=0.1)
+                return True
+            except _queue_mod.Full:
+                continue
+        return False
 
     def _read_loop(self) -> None:
         try:
@@ -94,14 +110,14 @@ class _ReaderPool:
                 except _queue_mod.Empty:
                     break
                 for payload in tfrecord.read_records(path):
-                    if self._stop.is_set():
+                    if not self._put(payload):
                         return
-                    self.records.put(payload)
-        except Exception:
+        except BaseException as e:
             logger.exception("reader thread failed")
-            raise
+            self.errors.append(e)
         finally:
-            self.records.put(_END)
+            # after stop() nobody counts sentinels, so dropping it is fine
+            self._put(_END)
 
     @property
     def n_producers(self) -> int:
@@ -109,12 +125,8 @@ class _ReaderPool:
 
     def stop(self) -> None:
         self._stop.set()
-        # unblock producers stuck on a full queue
-        while True:
-            try:
-                self.records.get_nowait()
-            except _queue_mod.Empty:
-                break
+        for t in self._threads:
+            t.join(timeout=5.0)
 
 
 def _record_stream(files: list[str], readers: int,
@@ -142,6 +154,8 @@ def _record_stream(files: list[str], readers: int,
                     yield buf.pop()
             else:
                 yield item
+        if pool.errors:  # a reader died: fail, don't silently truncate
+            raise pool.errors[0]
         if shuffle_buffer > 0:
             rng.shuffle(buf)
             yield from buf
@@ -161,7 +175,7 @@ def tfrecord_batches(
     seed: int = 0,
     drop_remainder: bool = False,
     prefetch: int = 2,
-    device_put: bool = False,
+    device_put: bool | Callable[[dict[str, Any]], dict[str, Any]] = False,
 ) -> Iterator[dict[str, Any]]:
     """Yield columnar batches from TFRecord files.
 
@@ -169,10 +183,12 @@ def tfrecord_batches(
     ``readers`` maps the reference's ``HasReaders`` param; ``prefetch`` is
     the number of ready batches staged ahead (0 = fully synchronous);
     ``device_put=True`` stages each batch onto the default JAX device from
-    the pipeline thread — the double-buffered host→HBM path.
+    the pipeline thread — the double-buffered host→HBM path.  ``device_put``
+    may also be a callable applied to each columnar batch (e.g.
+    ``Trainer.shard`` to stage with mesh shardings).
     """
     if isinstance(files, str):
-        files = sorted(_glob.glob(files))
+        files = fs.glob(files)
     files = list(files)
     if not files:
         return
@@ -195,6 +211,11 @@ def tfrecord_batches(
                 yield _stage(_columnarize(rows))
 
     def _stage(batch: dict[str, Any]) -> dict[str, Any]:
+        if callable(device_put):
+            # custom staging (e.g. Trainer.shard: device_put with the mesh
+            # shardings) runs in the pipeline thread, overlapping H2D with
+            # compute
+            return device_put(batch)
         if device_put:
             import jax
 
@@ -207,23 +228,50 @@ def tfrecord_batches(
 
     out: _queue_mod.Queue = _queue_mod.Queue(maxsize=prefetch)
     err: list[BaseException] = []
+    abandoned = threading.Event()  # consumer gave up (break / GeneratorExit)
 
     def pump() -> None:
+        gen = batch_gen()
         try:
-            for b in batch_gen():
-                out.put(b)
+            for b in gen:
+                while not abandoned.is_set():
+                    try:
+                        out.put(b, timeout=0.1)
+                        break
+                    except _queue_mod.Full:
+                        continue
+                if abandoned.is_set():
+                    return
         except BaseException as e:  # surfaced on the consumer side
             err.append(e)
         finally:
-            out.put(_END)
+            gen.close()  # runs _record_stream's finally → pool.stop()
+            # The sentinel MUST reach a live consumer even when the queue is
+            # momentarily full of staged batches; dropping it is only safe
+            # once the consumer has abandoned the iterator.
+            while True:
+                try:
+                    out.put(_END, timeout=0.1)
+                    break
+                except _queue_mod.Full:
+                    if abandoned.is_set():
+                        break
 
     t = threading.Thread(target=pump, daemon=True, name="tfos-prefetch")
     t.start()
-    while True:
-        item = out.get()
-        if item is _END:
-            break
-        yield item
-    t.join()
+    try:
+        while True:
+            item = out.get()
+            if item is _END:
+                break
+            yield item
+    finally:
+        abandoned.set()
+        while True:  # drain so a blocked timed put wakes promptly
+            try:
+                out.get_nowait()
+            except _queue_mod.Empty:
+                break
+        t.join(timeout=10.0)
     if err:
         raise err[0]

@@ -20,6 +20,7 @@ TPU-first details:
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any
 
 import numpy as np
@@ -110,6 +111,8 @@ class Trainer:
             _init, out_shardings=(self.param_shardings, col_shardings)
         )()
         self.state = create_train_state(params, self.optimizer, collections)
+        self._step_callbacks: list = []
+        self._last_step_t: float | None = None
 
         self.train_step = make_train_step(
             self.loss_fn, self.optimizer, self.mesh, self.param_shardings,
@@ -126,9 +129,26 @@ class Trainer:
     def shard(self, batch):
         return shard_batch(self.mesh, batch, self.sequence_axes)
 
+    def add_step_callback(self, fn) -> None:
+        """Register ``fn(loss, examples, dt)`` to run after every step.
+
+        ``loss`` is the (possibly lazy) device value — callbacks should only
+        force it at publish time (see :class:`metrics.MetricsReporter`);
+        ``dt`` is the wall time since the previous ``step`` call, so long-run
+        examples/sec is exact without breaking async dispatch.
+        """
+        self._step_callbacks.append(fn)
+
     def step(self, batch) -> float:
         """One sharded optimizer step; returns the (replicated) loss."""
         self.state, loss = self.train_step(self.state, self.shard(batch))
+        if self._step_callbacks:
+            now = time.perf_counter()
+            dt = now - self._last_step_t if self._last_step_t else 0.0
+            self._last_step_t = now
+            n = _batch_examples(batch)
+            for cb in self._step_callbacks:
+                cb(loss, n, dt)
         return loss
 
     def predict(self, batch):
@@ -171,3 +191,14 @@ def _model_inputs(batch: dict) -> tuple:
     """Positional model inputs from an example batch (labels stripped)."""
     label_keys = {"label", "start_positions", "end_positions"}
     return tuple(v for k, v in batch.items() if k not in label_keys)
+
+
+def _batch_examples(batch) -> int:
+    """Leading-dim size of the first array leaf (examples in the batch)."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(batch):
+        shape = getattr(leaf, "shape", None)
+        if shape:
+            return int(shape[0])
+    return 0

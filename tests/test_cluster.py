@@ -156,6 +156,45 @@ def test_num_executors_mismatch_rejected(sc):
         TFCluster.run(sc, tf_mode_fun, tf_args=None, num_executors=5)
 
 
+class FakeDStream:
+    """Minimal DStream: replays pre-built RDDs through foreachRDD."""
+
+    def __init__(self, rdds):
+        self.rdds = rdds
+
+    def foreachRDD(self, fn):
+        for rdd in self.rdds:
+            fn(rdd)
+
+
+class FakeSSC:
+    def __init__(self):
+        self.stopped_with = None
+
+    def stop(self, stopSparkContext=True, stopGraceFully=False):
+        self.stopped_with = (stopSparkContext, stopGraceFully)
+
+
+def test_streaming_feed_and_graceful_ssc_stop(sc):
+    """train_stream feeds micro-batch RDDs through the node queues;
+    shutdown(ssc=...) drains them and stops the streaming context
+    gracefully (reference TFCluster.shutdown(ssc) semantics)."""
+    data = _make_regression_data(n=256)
+    cluster = TFCluster.run(sc, linear_train_fun, tf_args=None, num_executors=2,
+                            input_mode=TFCluster.InputMode.SPARK)
+    micro_batches = [sc.parallelize(data[i::4], 2) for i in range(4)] * 4
+    cluster.train_stream(FakeDStream(micro_batches), feed_timeout=120)
+    ssc = FakeSSC()
+    cluster.shutdown(ssc=ssc, grace_secs=30)
+    assert ssc.stopped_with == (False, True)
+
+    authkey = bytes.fromhex(cluster.cluster_meta["authkey_hex"])
+    for meta in cluster.cluster_info:
+        mgr = TFManager.connect(tuple(meta["addr"]), authkey)
+        assert mgr.get("state") == "finished"
+        assert mgr.get("final_loss") < 1.0
+
+
 def test_train_requires_spark_mode(sc):
     cluster = TFCluster.run(sc, tf_mode_fun, tf_args=None, num_executors=2,
                             input_mode=TFCluster.InputMode.TENSORFLOW)

@@ -75,6 +75,102 @@ def test_mapping_arity_mismatch_raises():
         feed.next_batch(1)
 
 
+def test_prefetch_same_batches_and_stop_semantics():
+    """prefetch>0 must be a drop-in: same batches, same marker semantics."""
+    mgr = FakeMgr()
+    q = mgr.get_queue("input")
+    for i in range(4):
+        q.put([(float(i * 2 + j), i) for j in range(2)])
+    q.put(marker.EndPartition())
+    q.put(marker.StopFeed())
+    feed = DataFeed(mgr, input_mapping=["x", "y"], prefetch=2)
+    seen_x = []
+    while not feed.should_stop():
+        batch = feed.next_batch(3)
+        if batch:
+            seen_x.extend(batch["x"].tolist())
+    np.testing.assert_array_equal(seen_x, [float(v) for v in range(8)])
+    assert feed.next_batch(3) == {}  # drained, mirrors sync path
+
+
+def test_prefetch_overlaps_feed_and_compute():
+    """Wall time ≈ max(feed, compute), not their sum (VERDICT r2 task 1b)."""
+    import threading
+    import time
+
+    n_batches, rows_per_batch, work_s = 6, 4, 0.03
+
+    def run(prefetch):
+        mgr = FakeMgr()
+        q = mgr.get_queue("input")
+
+        class SlowQueue:
+            def get(self, *a, **kw):
+                time.sleep(work_s / rows_per_batch)  # feed cost per chunk
+                return q.get(*a, **kw)
+
+            def put(self, item):
+                q.put(item)
+
+        mgr._queues["input_slow"] = SlowQueue()
+        for i in range(n_batches * rows_per_batch):
+            mgr._queues["input_slow"].put([(float(i),)])
+        mgr._queues["input_slow"].put(marker.StopFeed())
+        feed = DataFeed(mgr, input_mapping=["x"], qname_in="input_slow",
+                        prefetch=prefetch)
+        t0 = time.perf_counter()
+        n = 0
+        while not feed.should_stop():
+            batch = feed.next_batch(rows_per_batch)
+            if batch and len(batch["x"]):
+                n += 1
+                time.sleep(work_s)  # simulated train step
+        assert n == n_batches
+        return time.perf_counter() - t0
+
+    serial = run(prefetch=0)
+    overlapped = run(prefetch=2)
+    # serial ≈ n*(feed+compute); overlapped ≈ n*max(feed,compute) (+ramp).
+    assert overlapped < serial * 0.8, (serial, overlapped)
+
+
+def test_prefetch_routes_inference_results_in_order():
+    """Provenance lands on _out_route at hand-out time, so tagged results
+    still go to the right per-task queue under prefetch."""
+    rmgr = FakeMgr()
+    rmgr._queues["output:tA"] = queue.Queue()
+
+    def put_route(name, results, timeout=None):
+        rmgr._queues[name].put(results)
+        return True
+
+    rmgr.put_route = put_route
+    q = rmgr.get_queue("input")
+    q.put(marker.TaggedChunk("tA", [(1.0,), (2.0,)]))
+    q.put([(3.0,)])  # untagged feeder
+    q.put(marker.StopFeed())
+    feed = DataFeed(rmgr, input_mapping=["x"], prefetch=2)
+    b1 = feed.next_batch(2)
+    assert len(b1["x"]) == 2
+    feed.batch_results([11, 12])
+    assert rmgr._queues["output:tA"].get_nowait() == [11, 12]
+    b2 = feed.next_batch(2)
+    assert len(b2["x"]) == 1
+    feed.batch_results([13])
+    assert rmgr.get_queue("output").get_nowait() == [13]
+
+
+def test_callable_device_put_stages_batch():
+    """device_put may be a staging callable (e.g. Trainer.shard)."""
+    mgr = FakeMgr()
+    mgr.get_queue("input").put([(np.ones(2), 0)])
+    mgr.get_queue("input").put(marker.EndPartition())
+    feed = DataFeed(mgr, input_mapping=["x", "y"])
+    staged = feed.next_batch(
+        4, device_put=lambda b: {k: v * 10 for k, v in b.items()})
+    np.testing.assert_array_equal(staged["x"], np.full((1, 2), 10.0))
+
+
 def test_batch_results_chunked():
     mgr = FakeMgr()
     feed = DataFeed(mgr)

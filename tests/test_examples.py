@@ -36,9 +36,11 @@ def test_cifar10_tfrecord_example(tmp_path, capsys):
     mod = _load("cifar10", "cifar10_spark")
     mod.main(["--cluster_size", "2", "--epochs", "1", "--tiny",
               "--num_samples", "256", "--batch_size", "32",
+              "--readers", "2", "--shuffle_buffer", "64",
               "--data_dir", str(tmp_path / "tfr")])
     out = capsys.readouterr().out
     assert "steps=" in out and "shard=" in out
+    assert "examples/sec" in out  # metrics hook aggregated on the driver
 
 
 def test_criteo_pipeline_example(tmp_path, capsys):
@@ -59,9 +61,20 @@ def test_bert_squad_example(capsys):
     assert "mesh={'dp': 2" in out
 
 
-def test_resnet_spark_example(capsys):
+def test_resnet_spark_example_synthetic(capsys):
     mod = _load("imagenet", "resnet_spark")
     mod.main(["--cluster_size", "2", "--tiny", "--steps", "3",
-              "--warmup", "1", "--batch_size", "16"])
+              "--warmup", "1", "--batch_size", "16", "--synthetic"])
+    out = capsys.readouterr().out
+    assert "cluster total:" in out and "images/sec" in out
+
+
+def test_resnet_spark_example_tfrecord_pipeline(tmp_path, capsys):
+    """The --data_dir path: readers pipeline feeding the sharded step."""
+    mod = _load("imagenet", "resnet_spark")
+    mod.main(["--cluster_size", "2", "--tiny", "--epochs", "1",
+              "--num_samples", "96", "--batch_size", "16",
+              "--readers", "2", "--shuffle_buffer", "32",
+              "--data_dir", str(tmp_path / "imagenet_tfr")])
     out = capsys.readouterr().out
     assert "cluster total:" in out and "images/sec" in out

@@ -1,0 +1,82 @@
+"""Step-metrics hook: StepMetrics, MetricsReporter, cluster aggregation
+(VERDICT r2 task 6 / SURVEY §5 metrics plan)."""
+
+import numpy as np
+
+from tensorflowonspark_tpu import metrics
+
+
+class FakeMgr:
+    def __init__(self):
+        self.kv = {}
+
+    def set(self, k, v):
+        self.kv[k] = v
+
+    def get(self, k, default=None):
+        return self.kv.get(k, default)
+
+
+def test_step_metrics_windowed_throughput():
+    m = metrics.StepMetrics(window=4)
+    for _ in range(10):
+        m.record(loss=np.float32(0.5), examples=32, dt=0.1)
+    snap = m.snapshot()
+    assert snap["step"] == 10
+    assert snap["total_examples"] == 320
+    assert abs(snap["examples_per_sec"] - 320.0) < 1.0  # 4*32 / 4*0.1
+    assert snap["loss"] == 0.5
+
+
+def test_reporter_publishes_every_interval():
+    mgr = FakeMgr()
+    rep = metrics.MetricsReporter(mgr=mgr, interval=3)
+    for i in range(7):
+        rep(loss=np.float32(i), examples=8, dt=0.05)
+    snap = mgr.kv["metrics"]
+    assert snap["step"] == 6  # published at steps 3 and 6
+    assert snap["loss"] == 5.0
+    rep.publish()
+    assert mgr.kv["metrics"]["step"] == 7
+
+
+def test_reporter_survives_broken_mgr():
+    class Broken:
+        def set(self, k, v):
+            raise ConnectionError("gone")
+
+    rep = metrics.MetricsReporter(mgr=Broken(), interval=1)
+    rep(loss=1.0, examples=4, dt=0.01)  # must not raise
+
+
+def test_aggregate_sums_throughput():
+    agg = metrics.aggregate({
+        "chief:0": {"step": 10, "loss": 1.0, "examples_per_sec": 100.0},
+        "worker:0": {"step": 10, "loss": 3.0, "examples_per_sec": 120.0},
+    })
+    assert agg["total_examples_per_sec"] == 220.0
+    assert agg["mean_loss"] == 2.0
+    assert agg["num_reporting"] == 2
+
+
+def test_aggregate_empty():
+    agg = metrics.aggregate({})
+    assert agg["total_examples_per_sec"] is None
+    assert agg["num_reporting"] == 0
+
+
+def test_trainer_step_callback_fires():
+    from tensorflowonspark_tpu import models as model_zoo
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    lib = model_zoo.get_model("mnist_mlp")
+    trainer = Trainer("mnist_mlp", config=lib.Config.tiny())
+    seen = []
+    trainer.add_step_callback(lambda loss, n, dt: seen.append((n, dt)))
+    batch = lib.example_batch(trainer.config, batch_size=8)
+    trainer.step(batch)
+    trainer.step(batch)
+    assert len(seen) == 2
+    assert seen[0][0] == 8
+    assert seen[0][1] == 0.0  # first step has no predecessor
+    assert seen[1][1] > 0.0

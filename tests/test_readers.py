@@ -62,12 +62,43 @@ def test_shuffle_changes_order_but_not_content(parts):
     assert shuffled != plain
 
 
-def test_reader_error_surfaces(tmp_path, parts):
+@pytest.mark.parametrize("n_readers", [1, 2])
+def test_reader_error_surfaces(tmp_path, parts, n_readers):
     bad = str(tmp_path / "part-bad")
     with open(bad, "wb") as f:
         f.write(b"\x12\x34garbage-not-a-tfrecord")
     with pytest.raises(Exception):
-        list(readers.tfrecord_batches(parts + [bad], 4, prefetch=2))
+        list(readers.tfrecord_batches(parts + [bad], 4, prefetch=2,
+                                      readers=n_readers))
+
+
+def test_slow_consumer_still_gets_end_sentinel(parts):
+    """A consumer slower than the pump must still see the end of the
+    dataset when the prefetch queue is full at pump completion
+    (regression: put_nowait dropped the sentinel → consumer hung)."""
+    got = []
+    for batch in readers.tfrecord_batches(parts, 4, prefetch=1, readers=2):
+        time.sleep(0.05)  # pump finishes + fills the queue long before us
+        got.extend(int(v[0]) for v in batch["v"])
+    assert len(got) == 32
+
+
+def test_abandoned_iterator_stops_threads(parts):
+    """Breaking out of the batch iterator must not leak pump/reader threads."""
+    import threading
+
+    before = {t.name for t in threading.enumerate()}
+    it = readers.tfrecord_batches(parts, 4, prefetch=2, readers=2)
+    next(it)
+    it.close()  # GeneratorExit at the yield → finally → stop + join
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        leaked = {t.name for t in threading.enumerate()} - before
+        leaked = {n for n in leaked if n.startswith("tfos-")}
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not leaked, leaked
 
 
 def test_prefetch_overlaps_feed_and_compute(parts, tmp_path):
