@@ -15,7 +15,7 @@ import logging
 import os
 from typing import Any, Iterable
 
-from tensorflowonspark_tpu import tfrecord
+from tensorflowonspark_tpu import fs, tfrecord
 
 logger = logging.getLogger(__name__)
 
@@ -143,8 +143,15 @@ def saveAsTFRecords(df, output_dir: str) -> None:
 
     Reference anchor: ``dfutil.py::saveAsTFRecords`` (via
     ``saveAsNewAPIHadoopFile``; same directory layout, no JVM here).
+
+    ``output_dir`` may carry a scheme (``hdfs://``, ``gs://``, …).  Like the
+    reference's Hadoop output format, the directory must be a **shared**
+    filesystem visible to every executor: each partition's part file is
+    written from the executor that holds it.  A plain local path on a
+    multi-host cluster would scatter part files across hosts' local disks —
+    use a scheme-qualified shared path there.
     """
-    os.makedirs(output_dir, exist_ok=True)
+    fs.makedirs(output_dir)
     dtypes = df.dtypes
     df.rdd.mapPartitionsWithIndex(
         _SavePartition(output_dir, dtypes)
@@ -158,7 +165,7 @@ class _SavePartition:
         self.dtypes = dtypes
 
     def __call__(self, pindex: int, iterator):
-        path = os.path.join(self.output_dir, f"part-r-{pindex:05d}")
+        path = fs.join(self.output_dir, f"part-r-{pindex:05d}")
         n = tfrecord.write_records(
             path, _ToTFExample(self.dtypes)(iterator)
         )
@@ -176,8 +183,8 @@ def loadTFRecords(sc, input_dir: str,
 
     backend = sql_compat.backend_of(sc)
     files = sorted(
-        os.path.join(input_dir, f)
-        for f in os.listdir(input_dir)
+        fs.join(input_dir, f)
+        for f in fs.listdir(input_dir)
         if f.startswith("part-") or f.endswith(".tfrecord")
     )
     if not files:

@@ -25,6 +25,8 @@ from typing import Any, Iterable, Iterator
 
 import google_crc32c
 
+from tensorflowonspark_tpu import fs
+
 _MASK_DELTA = 0xA282EAD8
 
 
@@ -39,12 +41,18 @@ def _masked_crc(data: bytes) -> int:
 
 
 def write_records(path: str, records: Iterable[bytes]) -> int:
-    """Write ``records`` to ``path`` in TFRecord framing; returns count."""
+    """Write ``records`` to ``path`` in TFRecord framing; returns count.
+
+    ``path`` may carry a filesystem scheme (``hdfs://``, ``gs://``, …) —
+    resolved through :mod:`tensorflowonspark_tpu.fs`.  The native C++ codec
+    is used for plain local paths.
+    """
+    local = fs.local_path(path)
     native = _native()
-    if native is not None:
-        return native.write_records(path, records)
+    if native is not None and local is not None:
+        return native.write_records(local, records)
     n = 0
-    with open(path, "wb") as f:
+    with fs.open(path, "wb") as f:
         for rec in records:
             f.write(encode_record(rec))
             n += 1
@@ -62,12 +70,14 @@ def encode_record(payload: bytes) -> bytes:
 
 
 def read_records(path: str, verify: bool = True) -> Iterator[bytes]:
-    """Yield record payloads from a TFRecord file."""
+    """Yield record payloads from a TFRecord file (scheme paths supported;
+    the mmap'd native codec serves plain local paths)."""
+    local = fs.local_path(path)
     native = _native()
-    if native is not None:
-        yield from native.read_records(path, verify)
+    if native is not None and local is not None:
+        yield from native.read_records(local, verify)
         return
-    with open(path, "rb") as f:
+    with fs.open(path, "rb") as f:
         while True:
             header = f.read(12)
             if not header:

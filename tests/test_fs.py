@@ -1,0 +1,130 @@
+"""Remote-filesystem abstraction: record I/O through registered schemes
+(VERDICT r2 task 3 / SURVEY §3.5 — the Hadoop-FileSystem-API seam)."""
+
+import io
+import os
+
+import pytest
+
+from tensorflowonspark_tpu import fs, readers, tfrecord
+
+
+class MemFS(fs.FileSystem):
+    """In-memory filesystem for a mock scheme (``mock://…``)."""
+
+    def __init__(self):
+        self.files: dict[str, bytes] = {}
+        self.dirs: set[str] = set()
+
+    def open(self, path, mode="rb"):
+        if "w" in mode:
+            buf = io.BytesIO()
+            outer = self
+
+            class W(io.BytesIO):
+                def close(self_inner):
+                    outer.files[path] = self_inner.getvalue()
+                    super().close()
+
+            return W()
+        if path not in self.files:
+            raise FileNotFoundError(path)
+        return io.BytesIO(self.files[path])
+
+    def listdir(self, path):
+        prefix = path.rstrip("/") + "/"
+        return sorted({p[len(prefix):].split("/")[0]
+                       for p in self.files if p.startswith(prefix)})
+
+    def exists(self, path):
+        return path in self.files or path in self.dirs
+
+    def makedirs(self, path):
+        self.dirs.add(path)
+
+    def glob(self, pattern):
+        import fnmatch
+
+        return sorted(p for p in self.files if fnmatch.fnmatch(p, pattern))
+
+
+@pytest.fixture()
+def memfs():
+    m = MemFS()
+    fs.register("mock", m)
+    yield m
+    fs.unregister("mock")
+
+
+def test_local_glob_and_file_scheme(tmp_path):
+    p = tmp_path / "part-00000"
+    p.write_bytes(b"x")
+    assert fs.glob(str(tmp_path / "part-*")) == [str(p)]
+    got = fs.glob(f"file://{tmp_path}/part-*")
+    assert got == [f"file://{p}"]
+    with fs.open(f"file://{p}") as f:
+        assert f.read() == b"x"
+    assert fs.exists(f"file://{p}")
+
+
+def test_join_preserves_scheme():
+    assert fs.join("hdfs://nn:8020/data", "part-0") == "hdfs://nn:8020/data/part-0"
+    assert fs.join("/tmp/x", "y") == os.path.join("/tmp/x", "y")
+
+
+def test_unknown_scheme_clear_error():
+    with pytest.raises(OSError, match="register"):
+        fs.get_fs("zzzz://bucket/x").open("zzzz://bucket/x")
+
+
+def test_tfrecord_roundtrip_through_mock_scheme(memfs):
+    path = "mock://bucket/data/part-r-00000"
+    payloads = [b"alpha", b"beta", b"gamma"]
+    n = tfrecord.write_records(path, iter(payloads))
+    assert n == 3
+    assert list(tfrecord.read_records(path)) == payloads
+
+
+def test_readers_pipeline_through_mock_scheme(memfs):
+    for part in range(2):
+        tfrecord.write_records(
+            f"mock://bucket/data/part-{part:05d}",
+            (tfrecord.encode_example({"v": (tfrecord.INT64_LIST, [part * 10 + i])})
+             for i in range(4)),
+        )
+    shard = readers.shard_files("mock://bucket/data/part-*", 0, 1)
+    assert len(shard) == 2
+    got = []
+    for batch in readers.tfrecord_batches(shard, 3, prefetch=2):
+        got.extend(int(v[0]) for v in batch["v"])
+    assert sorted(got) == [0, 1, 2, 3, 10, 11, 12, 13]
+
+
+def test_dfutil_roundtrip_file_scheme(tmp_path):
+    """Scheme-qualified dirs flow through the real save/load job path."""
+    from tensorflowonspark_tpu import dfutil
+    from tensorflowonspark_tpu.sparkapi import get_spark_context
+    from tensorflowonspark_tpu.sparkapi.sql import LocalSparkSession
+
+    sc = get_spark_context("local[2]", "fs-roundtrip")
+    try:
+        spark = LocalSparkSession(sc)
+        df = spark.createDataFrame(
+            [(i, float(i) / 2, f"s{i}") for i in range(6)],
+            ["a", "b", "c"],
+        ).repartition(2)
+        out = f"file://{tmp_path}/tfr"
+        dfutil.saveAsTFRecords(df, out)
+        assert (tmp_path / "tfr" / "part-r-00000").exists()
+        back = dfutil.loadTFRecords(sc, out)
+        rows = sorted(back.collect(), key=lambda r: r["a"])
+        assert len(rows) == 6
+        assert rows[3]["c"] == "s3"
+    finally:
+        sc.stop()
+
+
+def test_local_path_helper():
+    assert fs.local_path("/tmp/x") == "/tmp/x"
+    assert fs.local_path("file:///tmp/x") == "/tmp/x"
+    assert fs.local_path("gs://bucket/x") is None
