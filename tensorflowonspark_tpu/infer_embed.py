@@ -1,0 +1,132 @@
+"""In-process inference endpoint for the C-ABI / JNI shim.
+
+Reference anchor: the reference ships a Scala inference API
+(``src/main/scala/com/yahoo/tensorflowonspark/`` + ``pom.xml``,
+``SURVEY.md §2.2`` row 1) so JVM Spark jobs can score models without a
+Python driver.  The TPU rebuild's equivalent is ``libtfos_infer.so``
+(``native/tfos_infer.cc``): a C shared library that embeds a CPython
+interpreter and calls the functions below.  A JVM loads the library through
+the JNI wrapper (``native/tfos_infer_jni.cc``) — no Python *process*
+anywhere, just libpython linked into the JVM's address space, the same
+pattern TF-Java used with libtensorflow.
+
+The call protocol mirrors TF-Java's ``Session.Runner``: ``load`` →
+``set_input``×N → ``run`` → ``get_output``.  All state lives in an integer
+handle registry so the C side never holds Python object pointers.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+from typing import Any
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_HANDLES: dict[int, dict[str, Any]] = {}
+_NEXT = itertools.count(1)
+_LOCK = threading.Lock()
+
+#: dtype codes of the C ABI (tfos_infer.h)
+_DTYPES = {0: np.float32, 1: np.int32, 2: np.int64}
+
+
+def load(export_dir: str, model_name: str = "") -> int:
+    """Load an Orbax export + model-zoo forward fn; returns a handle."""
+    from tensorflowonspark_tpu import util
+
+    util.ensure_jax_platform()
+    import os
+
+    import jax
+
+    from tensorflowonspark_tpu import ckpt
+    from tensorflowonspark_tpu import models as model_zoo
+    from tensorflowonspark_tpu.pipeline import _is_tiny
+
+    path = export_dir
+    model_sub = os.path.join(path, "model")
+    if "://" not in path and os.path.isdir(model_sub):
+        path = model_sub  # layout written by compat.export_saved_model
+    state = ckpt.load_pytree(path)
+    params = state.get("params", state) if isinstance(state, dict) else state
+    collections = state.get("collections") if isinstance(state, dict) else None
+
+    lib = model_zoo.get_model(model_name)
+    config = lib.Config.tiny() if _is_tiny(params, lib) else lib.Config()
+    module = lib.make_model(config)
+    forward = lib.make_forward_fn(module, config)
+    if getattr(forward, "stateful", False):
+        cols = collections or {}
+        fn = jax.jit(lambda p, b: forward(p, cols, b))
+    else:
+        fn = jax.jit(forward)
+
+    # input names come from the zoo's example batch (labels stripped)
+    example = lib.example_batch(config, batch_size=1)
+    label_keys = {"label", "start_positions", "end_positions"}
+    input_names = [k for k in example if k not in label_keys]
+
+    with _LOCK:
+        h = next(_NEXT)
+        _HANDLES[h] = {
+            "fn": fn,
+            "params": params,
+            "input_names": input_names,
+            "inputs": {},
+            "output": None,
+        }
+    logger.info("infer_embed: loaded %s as handle %d (inputs %s)",
+                export_dir, h, input_names)
+    return h
+
+
+def input_names(handle: int) -> str:
+    """Comma-joined input tensor names (C side exposes for discovery)."""
+    return ",".join(_HANDLES[handle]["input_names"])
+
+
+def set_input(handle: int, name: str, data: bytes, shape: tuple,
+              dtype_code: int) -> None:
+    arr = np.frombuffer(data, _DTYPES[dtype_code]).reshape(shape)
+    st = _HANDLES[handle]
+    if name == "" and len(st["input_names"]) == 1:
+        name = st["input_names"][0]  # single-input convenience
+    if name not in st["input_names"]:
+        raise KeyError(
+            f"unknown input {name!r}; model inputs are {st['input_names']}")
+    st["inputs"][name] = arr
+
+
+def run(handle: int) -> None:
+    st = _HANDLES[handle]
+    missing = [n for n in st["input_names"] if n not in st["inputs"]]
+    if missing:
+        raise ValueError(f"inputs not set before run: {missing}")
+    out = st["fn"](st["params"], dict(st["inputs"]))
+    if isinstance(out, dict):  # multi-output models: first output
+        out = next(iter(out.values()))
+    st["output"] = np.asarray(out, dtype=np.float32)
+    st["inputs"] = {}
+
+
+def output_shape(handle: int) -> tuple:
+    out = _HANDLES[handle]["output"]
+    if out is None:
+        raise ValueError("run() has not produced an output")
+    return tuple(out.shape)
+
+
+def get_output(handle: int) -> bytes:
+    out = _HANDLES[handle]["output"]
+    if out is None:
+        raise ValueError("run() has not produced an output")
+    return np.ascontiguousarray(out, dtype=np.float32).tobytes()
+
+
+def close(handle: int) -> None:
+    with _LOCK:
+        _HANDLES.pop(handle, None)

@@ -1,0 +1,198 @@
+"""Builder + ctypes binding for the C-ABI/JNI inference shim.
+
+Builds three artifacts from :mod:`tensorflowonspark_tpu.native` sources:
+
+- ``libtfos_infer.so``      — the C-ABI shim (embeds CPython; tfos_infer.cc)
+- ``libtfos_infer_jni.so``  — JNI wrapper for JVM Spark jobs
+  (tfos_infer_jni.cc, also carrying the TFRecord-codec JNI binding)
+- ``tfos_infer_demo``       — a C driver proving batched inference with NO
+  Python driver process (used by the smoke test)
+
+The :class:`Session` ctypes wrapper drives the exact call sequence the JNI
+wrapper makes (load → set_input → run → output_shape → get_output → close),
+so the tests exercise the same ABI surface a JVM would.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import sysconfig
+import threading
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "tfos_infer.cc")
+_SRC_JNI = os.path.join(_DIR, "tfos_infer_jni.cc")
+_SRC_CODEC = os.path.join(_DIR, "tfrecord_codec.cc")
+_SRC_DEMO = os.path.join(_DIR, "tfos_infer_main.c")
+_LIB = os.path.join(_DIR, "libtfos_infer.so")
+_LIB_JNI = os.path.join(_DIR, "libtfos_infer_jni.so")
+_DEMO = os.path.join(_DIR, "tfos_infer_demo")
+
+_lock = threading.Lock()
+_lib_state: list = []  # [CDLL or None] once probed
+
+_DTYPE_CODES = {np.dtype(np.float32): 0, np.dtype(np.int32): 1,
+                np.dtype(np.int64): 2}
+
+
+def _python_flags() -> tuple[list[str], list[str]]:
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+    ver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var(
+        "VERSION")
+    return [f"-I{inc}"], [f"-L{libdir}", f"-lpython{ver}",
+                          f"-Wl,-rpath,{libdir}"]
+
+
+def _run(cmd: list[str]) -> bool:
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        out = getattr(e, "stderr", b"") or b""
+        logger.info("native build failed: %s\n%s", e, out.decode()[-2000:])
+        return False
+
+
+def build(force: bool = False) -> bool:
+    """Build all three artifacts; returns True when the C-ABI lib exists."""
+    inc, link = _python_flags()
+    common = ["-O2", "-fPIC", "-std=c++17"]
+    newer = (os.path.exists(_LIB)
+             and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC))
+    if force or not newer:
+        if not _run(["g++", *common, "-shared", *inc, _SRC, "-o", _LIB, *link]):
+            return False
+    # JNI wrapper: links the C-ABI lib; codec compiled in directly
+    if force or not os.path.exists(_LIB_JNI) or \
+            os.path.getmtime(_LIB_JNI) < max(os.path.getmtime(_SRC_JNI),
+                                             os.path.getmtime(_SRC_CODEC)):
+        _run(["g++", *common, "-shared", _SRC_JNI, _SRC_CODEC, "-o", _LIB_JNI,
+              f"-L{_DIR}", "-ltfos_infer", f"-Wl,-rpath,{_DIR}", *link])
+    # no-Python-process demo driver
+    if force or not os.path.exists(_DEMO) or \
+            os.path.getmtime(_DEMO) < os.path.getmtime(_SRC_DEMO):
+        _run(["g++", "-O2", _SRC_DEMO, "-o", _DEMO,
+              f"-L{_DIR}", "-ltfos_infer", f"-Wl,-rpath,{_DIR}", *link])
+    return os.path.exists(_LIB)
+
+
+def _load():
+    if _lib_state:
+        return _lib_state[0]
+    with _lock:
+        if _lib_state:
+            return _lib_state[0]
+        lib = None
+        if os.environ.get("TFOS_DISABLE_NATIVE") != "1" and build():
+            try:
+                lib = ctypes.CDLL(_LIB)
+                i64 = ctypes.c_int64
+                i64p = ctypes.POINTER(i64)
+                lib.tfos_infer_last_error.restype = ctypes.c_char_p
+                lib.tfos_infer_init.restype = ctypes.c_int
+                lib.tfos_infer_load.restype = i64
+                lib.tfos_infer_load.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+                lib.tfos_infer_set_input.restype = ctypes.c_int
+                lib.tfos_infer_set_input.argtypes = [
+                    i64, ctypes.c_char_p, ctypes.c_void_p, i64p,
+                    ctypes.c_int, ctypes.c_int]
+                lib.tfos_infer_run.restype = ctypes.c_int
+                lib.tfos_infer_run.argtypes = [i64]
+                lib.tfos_infer_output_rank.restype = ctypes.c_int
+                lib.tfos_infer_output_rank.argtypes = [i64]
+                lib.tfos_infer_output_shape.restype = ctypes.c_int
+                lib.tfos_infer_output_shape.argtypes = [i64, i64p]
+                lib.tfos_infer_get_output.restype = i64
+                lib.tfos_infer_get_output.argtypes = [
+                    i64, ctypes.POINTER(ctypes.c_float), i64]
+                lib.tfos_infer_close.restype = ctypes.c_int
+                lib.tfos_infer_close.argtypes = [i64]
+            except OSError as e:
+                logger.info("could not load %s: %s", _LIB, e)
+                lib = None
+        _lib_state.append(lib)
+        return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def demo_binary() -> str | None:
+    """Path of the compiled no-Python-driver demo, if built."""
+    build()
+    return _DEMO if os.path.exists(_DEMO) else None
+
+
+def jni_library() -> str | None:
+    """Path of the JNI-loadable wrapper, if built."""
+    build()
+    return _LIB_JNI if os.path.exists(_LIB_JNI) else None
+
+
+class Session:
+    """ctypes driver mirroring the JNI wrapper's call sequence exactly."""
+
+    def __init__(self, export_dir: str, model_name: str = ""):
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError("libtfos_infer.so unavailable")
+        if self._lib.tfos_infer_init() != 0:
+            raise RuntimeError(self._err())
+        self._h = self._lib.tfos_infer_load(
+            export_dir.encode(), model_name.encode())
+        if self._h < 0:
+            raise RuntimeError(self._err())
+
+    def _err(self) -> str:
+        return (self._lib.tfos_infer_last_error() or b"").decode()
+
+    def set_input(self, name: str, array: np.ndarray) -> None:
+        arr = np.ascontiguousarray(array)
+        code = _DTYPE_CODES.get(arr.dtype)
+        if code is None:
+            raise TypeError(f"unsupported dtype {arr.dtype}")
+        shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+        rc = self._lib.tfos_infer_set_input(
+            self._h, name.encode(), arr.ctypes.data_as(ctypes.c_void_p),
+            shape, arr.ndim, code)
+        if rc != 0:
+            raise RuntimeError(self._err())
+
+    def run(self) -> None:
+        if self._lib.tfos_infer_run(self._h) != 0:
+            raise RuntimeError(self._err())
+
+    def output(self) -> np.ndarray:
+        rank = self._lib.tfos_infer_output_rank(self._h)
+        if rank < 0:
+            raise RuntimeError(self._err())
+        shape = (ctypes.c_int64 * max(rank, 1))()
+        if self._lib.tfos_infer_output_shape(self._h, shape) != 0:
+            raise RuntimeError(self._err())
+        dims = tuple(shape[i] for i in range(rank))
+        n = int(np.prod(dims)) if dims else 1
+        buf = (ctypes.c_float * n)()
+        got = self._lib.tfos_infer_get_output(self._h, buf, n)
+        if got < 0:
+            raise RuntimeError(self._err())
+        return np.ctypeslib.as_array(buf).reshape(dims).copy()
+
+    def predict(self, array: np.ndarray, name: str = "") -> np.ndarray:
+        """Single-input convenience: set_input → run → output."""
+        self.set_input(name, array)
+        self.run()
+        return self.output()
+
+    def close(self) -> None:
+        if self._h >= 0:
+            self._lib.tfos_infer_close(self._h)
+            self._h = -1

@@ -1,0 +1,33 @@
+package com.tensorflowonspark.tpu;
+
+/**
+ * JVM binding for the native TFRecord codec (the TPU rebuild's equivalent
+ * of the reference's tensorflow-hadoop connector jar, SURVEY.md §2.2 row 2).
+ *
+ * <p>Native backing: {@code libtfos_infer_jni.so} (the codec is compiled
+ * into the same JNI library). Byte-compatible with files written by
+ * TensorFlow / the Hadoop connector (masked crc32c framing).
+ */
+public final class TFRecordCodec {
+  static {
+    System.loadLibrary("tfos_infer_jni");
+  }
+
+  private TFRecordCodec() {}
+
+  /**
+   * Append records to a TFRecord file.
+   *
+   * @param concat  all record payloads concatenated
+   * @param lengths per-record payload lengths (sums to concat.length)
+   * @return the number of records written
+   */
+  public static native long writeRecords(String path, byte[] concat, long[] lengths);
+
+  /**
+   * Index a TFRecord file held in memory: validates framing (and CRCs when
+   * {@code verify}) and returns {@code [offset0, length0, offset1, ...]}
+   * payload positions into {@code fileBytes}.
+   */
+  public static native long[] indexRecords(byte[] fileBytes, boolean verify);
+}

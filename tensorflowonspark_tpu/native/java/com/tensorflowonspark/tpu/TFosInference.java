@@ -1,0 +1,56 @@
+package com.tensorflowonspark.tpu;
+
+/**
+ * JVM-side batched inference over models exported by tensorflowonspark_tpu
+ * (the TPU rebuild's equivalent of the reference's Scala inference API,
+ * SURVEY.md §2.2 row 1).
+ *
+ * <p>Native backing: {@code libtfos_infer_jni.so} → {@code libtfos_infer.so}
+ * (embeds CPython; runs the JAX/XLA-compiled forward — no Python process).
+ *
+ * <p>Setup: put the framework on {@code PYTHONPATH}, the native dir on
+ * {@code java.library.path} / {@code LD_LIBRARY_PATH}, then:
+ *
+ * <pre>{@code
+ * long h = TFosInference.load("/models/mnist_export", "mnist_mlp");
+ * TFosInference.setInput(h, "", pixels, new long[]{batch, 784});
+ * TFosInference.run(h);
+ * float[] probs = TFosInference.getOutput(h);   // shape via outputShape(h)
+ * TFosInference.close(h);
+ * }</pre>
+ *
+ * <p>Call it from {@code DataFrame.mapPartitions} for the reference's
+ * Scala-Spark scoring pattern; the per-partition handle caches the loaded
+ * model exactly like the reference cached its SavedModel per executor.
+ */
+public final class TFosInference {
+  static {
+    System.loadLibrary("tfos_infer_jni");
+  }
+
+  private TFosInference() {}
+
+  /** Load an export; returns an opaque handle. */
+  public static native long load(String exportDir, String modelName);
+
+  /** Stage a float32 input tensor ("" = the model's single input). */
+  public static native void setInput(long h, String name, float[] data, long[] shape);
+
+  /** Stage an int32 input tensor (e.g. categorical ids). */
+  public static native void setInputInts(long h, String name, int[] data, long[] shape);
+
+  /** Stage an int64 input tensor. */
+  public static native void setInputLongs(long h, String name, long[] data, long[] shape);
+
+  /** Execute the compiled forward on all staged inputs. */
+  public static native void run(long h);
+
+  /** Shape of the float32 output produced by the last run. */
+  public static native long[] outputShape(long h);
+
+  /** The output tensor, flattened row-major. */
+  public static native float[] getOutput(long h);
+
+  /** Release the handle's model state. */
+  public static native void close(long h);
+}

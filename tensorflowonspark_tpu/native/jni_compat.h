@@ -1,0 +1,346 @@
+// Minimal JNI declarations for building the wrapper without a JDK.
+//
+// This image has no JDK, so the JNI wrapper compiles against this vendored
+// subset of the JNI 1.6 ABI.  The JNINativeInterface function table below
+// lists EVERY slot in the canonical jni.h order (layout == order for a
+// struct of pointers); only the functions the wrapper calls are typed, the
+// rest are void* placeholders with their spec names kept so the ordering is
+// auditable against any real jni.h.  When a JDK is present, define
+// TFOS_HAVE_REAL_JNI and include <jni.h> instead (see tfos_infer_jni.cc).
+
+#ifndef TFOS_JNI_COMPAT_H_
+#define TFOS_JNI_COMPAT_H_
+
+#include <cstdarg>
+#include <cstdint>
+
+extern "C" {
+
+typedef uint8_t jboolean;
+typedef int8_t jbyte;
+typedef uint16_t jchar;
+typedef int16_t jshort;
+typedef int32_t jint;
+typedef int64_t jlong;
+typedef float jfloat;
+typedef double jdouble;
+typedef jint jsize;
+
+class _jobject {};
+typedef _jobject *jobject;
+typedef jobject jclass;
+typedef jobject jstring;
+typedef jobject jarray;
+typedef jarray jbooleanArray;
+typedef jarray jbyteArray;
+typedef jarray jcharArray;
+typedef jarray jshortArray;
+typedef jarray jintArray;
+typedef jarray jlongArray;
+typedef jarray jfloatArray;
+typedef jarray jdoubleArray;
+typedef jarray jobjectArray;
+typedef jobject jthrowable;
+
+struct JNIEnv_;
+typedef JNIEnv_ JNIEnv;
+
+#define JNIEXPORT __attribute__((visibility("default")))
+#define JNICALL
+
+struct JNINativeInterface_ {
+  void *reserved0;
+  void *reserved1;
+  void *reserved2;
+  void *reserved3;
+  void *GetVersion;
+  void *DefineClass;
+  jclass (*FindClass)(JNIEnv *, const char *);
+  void *FromReflectedMethod;
+  void *FromReflectedField;
+  void *ToReflectedMethod;
+  void *GetSuperclass;
+  void *IsAssignableFrom;
+  void *ToReflectedField;
+  void *Throw;
+  jint (*ThrowNew)(JNIEnv *, jclass, const char *);
+  void *ExceptionOccurred;
+  void *ExceptionDescribe;
+  void *ExceptionClear;
+  void *FatalError;
+  void *PushLocalFrame;
+  void *PopLocalFrame;
+  void *NewGlobalRef;
+  void *DeleteGlobalRef;
+  void *DeleteLocalRef;
+  void *IsSameObject;
+  void *NewLocalRef;
+  void *EnsureLocalCapacity;
+  void *AllocObject;
+  void *NewObject;
+  void *NewObjectV;
+  void *NewObjectA;
+  void *GetObjectClass;
+  void *IsInstanceOf;
+  void *GetMethodID;
+  void *CallObjectMethod;
+  void *CallObjectMethodV;
+  void *CallObjectMethodA;
+  void *CallBooleanMethod;
+  void *CallBooleanMethodV;
+  void *CallBooleanMethodA;
+  void *CallByteMethod;
+  void *CallByteMethodV;
+  void *CallByteMethodA;
+  void *CallCharMethod;
+  void *CallCharMethodV;
+  void *CallCharMethodA;
+  void *CallShortMethod;
+  void *CallShortMethodV;
+  void *CallShortMethodA;
+  void *CallIntMethod;
+  void *CallIntMethodV;
+  void *CallIntMethodA;
+  void *CallLongMethod;
+  void *CallLongMethodV;
+  void *CallLongMethodA;
+  void *CallFloatMethod;
+  void *CallFloatMethodV;
+  void *CallFloatMethodA;
+  void *CallDoubleMethod;
+  void *CallDoubleMethodV;
+  void *CallDoubleMethodA;
+  void *CallVoidMethod;
+  void *CallVoidMethodV;
+  void *CallVoidMethodA;
+  void *CallNonvirtualObjectMethod;
+  void *CallNonvirtualObjectMethodV;
+  void *CallNonvirtualObjectMethodA;
+  void *CallNonvirtualBooleanMethod;
+  void *CallNonvirtualBooleanMethodV;
+  void *CallNonvirtualBooleanMethodA;
+  void *CallNonvirtualByteMethod;
+  void *CallNonvirtualByteMethodV;
+  void *CallNonvirtualByteMethodA;
+  void *CallNonvirtualCharMethod;
+  void *CallNonvirtualCharMethodV;
+  void *CallNonvirtualCharMethodA;
+  void *CallNonvirtualShortMethod;
+  void *CallNonvirtualShortMethodV;
+  void *CallNonvirtualShortMethodA;
+  void *CallNonvirtualIntMethod;
+  void *CallNonvirtualIntMethodV;
+  void *CallNonvirtualIntMethodA;
+  void *CallNonvirtualLongMethod;
+  void *CallNonvirtualLongMethodV;
+  void *CallNonvirtualLongMethodA;
+  void *CallNonvirtualFloatMethod;
+  void *CallNonvirtualFloatMethodV;
+  void *CallNonvirtualFloatMethodA;
+  void *CallNonvirtualDoubleMethod;
+  void *CallNonvirtualDoubleMethodV;
+  void *CallNonvirtualDoubleMethodA;
+  void *CallNonvirtualVoidMethod;
+  void *CallNonvirtualVoidMethodV;
+  void *CallNonvirtualVoidMethodA;
+  void *GetFieldID;
+  void *GetObjectField;
+  void *GetBooleanField;
+  void *GetByteField;
+  void *GetCharField;
+  void *GetShortField;
+  void *GetIntField;
+  void *GetLongField;
+  void *GetFloatField;
+  void *GetDoubleField;
+  void *SetObjectField;
+  void *SetBooleanField;
+  void *SetByteField;
+  void *SetCharField;
+  void *SetShortField;
+  void *SetIntField;
+  void *SetLongField;
+  void *SetFloatField;
+  void *SetDoubleField;
+  void *GetStaticMethodID;
+  void *CallStaticObjectMethod;
+  void *CallStaticObjectMethodV;
+  void *CallStaticObjectMethodA;
+  void *CallStaticBooleanMethod;
+  void *CallStaticBooleanMethodV;
+  void *CallStaticBooleanMethodA;
+  void *CallStaticByteMethod;
+  void *CallStaticByteMethodV;
+  void *CallStaticByteMethodA;
+  void *CallStaticCharMethod;
+  void *CallStaticCharMethodV;
+  void *CallStaticCharMethodA;
+  void *CallStaticShortMethod;
+  void *CallStaticShortMethodV;
+  void *CallStaticShortMethodA;
+  void *CallStaticIntMethod;
+  void *CallStaticIntMethodV;
+  void *CallStaticIntMethodA;
+  void *CallStaticLongMethod;
+  void *CallStaticLongMethodV;
+  void *CallStaticLongMethodA;
+  void *CallStaticFloatMethod;
+  void *CallStaticFloatMethodV;
+  void *CallStaticFloatMethodA;
+  void *CallStaticDoubleMethod;
+  void *CallStaticDoubleMethodV;
+  void *CallStaticDoubleMethodA;
+  void *CallStaticVoidMethod;
+  void *CallStaticVoidMethodV;
+  void *CallStaticVoidMethodA;
+  void *GetStaticFieldID;
+  void *GetStaticObjectField;
+  void *GetStaticBooleanField;
+  void *GetStaticByteField;
+  void *GetStaticCharField;
+  void *GetStaticShortField;
+  void *GetStaticIntField;
+  void *GetStaticLongField;
+  void *GetStaticFloatField;
+  void *GetStaticDoubleField;
+  void *SetStaticObjectField;
+  void *SetStaticBooleanField;
+  void *SetStaticByteField;
+  void *SetStaticCharField;
+  void *SetStaticShortField;
+  void *SetStaticIntField;
+  void *SetStaticLongField;
+  void *SetStaticFloatField;
+  void *SetStaticDoubleField;
+  void *NewString;
+  void *GetStringLength;
+  void *GetStringChars;
+  void *ReleaseStringChars;
+  jstring (*NewStringUTF)(JNIEnv *, const char *);
+  void *GetStringUTFLength;
+  const char *(*GetStringUTFChars)(JNIEnv *, jstring, jboolean *);
+  void (*ReleaseStringUTFChars)(JNIEnv *, jstring, const char *);
+  jsize (*GetArrayLength)(JNIEnv *, jarray);
+  void *NewObjectArray;
+  void *GetObjectArrayElement;
+  void *SetObjectArrayElement;
+  void *NewBooleanArray;
+  void *NewByteArray;
+  void *NewCharArray;
+  void *NewShortArray;
+  void *NewIntArray;
+  jlongArray (*NewLongArray)(JNIEnv *, jsize);
+  jfloatArray (*NewFloatArray)(JNIEnv *, jsize);
+  void *NewDoubleArray;
+  void *GetBooleanArrayElements;
+  jbyte *(*GetByteArrayElements)(JNIEnv *, jbyteArray, jboolean *);
+  void *GetCharArrayElements;
+  void *GetShortArrayElements;
+  jint *(*GetIntArrayElements)(JNIEnv *, jintArray, jboolean *);
+  jlong *(*GetLongArrayElements)(JNIEnv *, jlongArray, jboolean *);
+  jfloat *(*GetFloatArrayElements)(JNIEnv *, jfloatArray, jboolean *);
+  void *GetDoubleArrayElements;
+  void *ReleaseBooleanArrayElements;
+  void (*ReleaseByteArrayElements)(JNIEnv *, jbyteArray, jbyte *, jint);
+  void *ReleaseCharArrayElements;
+  void *ReleaseShortArrayElements;
+  void (*ReleaseIntArrayElements)(JNIEnv *, jintArray, jint *, jint);
+  void (*ReleaseLongArrayElements)(JNIEnv *, jlongArray, jlong *, jint);
+  void (*ReleaseFloatArrayElements)(JNIEnv *, jfloatArray, jfloat *, jint);
+  void *ReleaseDoubleArrayElements;
+  void *GetBooleanArrayRegion;
+  void *GetByteArrayRegion;
+  void *GetCharArrayRegion;
+  void *GetShortArrayRegion;
+  void *GetIntArrayRegion;
+  void *GetLongArrayRegion;
+  void *GetFloatArrayRegion;
+  void *GetDoubleArrayRegion;
+  void *SetBooleanArrayRegion;
+  void *SetByteArrayRegion;
+  void *SetCharArrayRegion;
+  void *SetShortArrayRegion;
+  void *SetIntArrayRegion;
+  void (*SetLongArrayRegion)(JNIEnv *, jlongArray, jsize, jsize,
+                             const jlong *);
+  void (*SetFloatArrayRegion)(JNIEnv *, jfloatArray, jsize, jsize,
+                              const jfloat *);
+  void *SetDoubleArrayRegion;
+  void *RegisterNatives;
+  void *UnregisterNatives;
+  void *MonitorEnter;
+  void *MonitorExit;
+  void *GetJavaVM;
+  void *GetStringRegion;
+  void *GetStringUTFRegion;
+  void *GetPrimitiveArrayCritical;
+  void *ReleasePrimitiveArrayCritical;
+  void *GetStringCritical;
+  void *ReleaseStringCritical;
+  void *NewWeakGlobalRef;
+  void *DeleteWeakGlobalRef;
+  void *ExceptionCheck;
+  void *NewDirectByteBuffer;
+  void *GetDirectBufferAddress;
+  void *GetDirectBufferCapacity;
+  void *GetObjectRefType;
+};
+
+struct JNIEnv_ {
+  const JNINativeInterface_ *functions;
+
+  jclass FindClass(const char *name) { return functions->FindClass(this, name); }
+  jint ThrowNew(jclass cls, const char *msg) {
+    return functions->ThrowNew(this, cls, msg);
+  }
+  jstring NewStringUTF(const char *s) {
+    return functions->NewStringUTF(this, s);
+  }
+  const char *GetStringUTFChars(jstring s, jboolean *copy) {
+    return functions->GetStringUTFChars(this, s, copy);
+  }
+  void ReleaseStringUTFChars(jstring s, const char *c) {
+    functions->ReleaseStringUTFChars(this, s, c);
+  }
+  jsize GetArrayLength(jarray a) { return functions->GetArrayLength(this, a); }
+  jbyte *GetByteArrayElements(jbyteArray a, jboolean *copy) {
+    return functions->GetByteArrayElements(this, a, copy);
+  }
+  void ReleaseByteArrayElements(jbyteArray a, jbyte *p, jint mode) {
+    functions->ReleaseByteArrayElements(this, a, p, mode);
+  }
+  jlongArray NewLongArray(jsize n) { return functions->NewLongArray(this, n); }
+  jfloatArray NewFloatArray(jsize n) {
+    return functions->NewFloatArray(this, n);
+  }
+  jint *GetIntArrayElements(jintArray a, jboolean *copy) {
+    return functions->GetIntArrayElements(this, a, copy);
+  }
+  jlong *GetLongArrayElements(jlongArray a, jboolean *copy) {
+    return functions->GetLongArrayElements(this, a, copy);
+  }
+  jfloat *GetFloatArrayElements(jfloatArray a, jboolean *copy) {
+    return functions->GetFloatArrayElements(this, a, copy);
+  }
+  void ReleaseIntArrayElements(jintArray a, jint *p, jint mode) {
+    functions->ReleaseIntArrayElements(this, a, p, mode);
+  }
+  void ReleaseLongArrayElements(jlongArray a, jlong *p, jint mode) {
+    functions->ReleaseLongArrayElements(this, a, p, mode);
+  }
+  void ReleaseFloatArrayElements(jfloatArray a, jfloat *p, jint mode) {
+    functions->ReleaseFloatArrayElements(this, a, p, mode);
+  }
+  void SetLongArrayRegion(jlongArray a, jsize start, jsize len,
+                          const jlong *buf) {
+    functions->SetLongArrayRegion(this, a, start, len, buf);
+  }
+  void SetFloatArrayRegion(jfloatArray a, jsize start, jsize len,
+                           const jfloat *buf) {
+    functions->SetFloatArrayRegion(this, a, start, len, buf);
+  }
+};
+
+}  // extern "C"
+
+#endif  // TFOS_JNI_COMPAT_H_

@@ -1,0 +1,246 @@
+// libtfos_infer.so — C-ABI batched inference over exported models.
+//
+// Reference anchor: the reference's Scala inference API
+// (src/main/scala/com/yahoo/tensorflowonspark + pom.xml; SURVEY.md §2.2 row
+// 1) let JVM Spark jobs run SavedModel inference without Python.  The TPU
+// rebuild's equivalent embeds a CPython interpreter in-process (the same
+// pattern TF-Java used with libtensorflow's C core) and drives the JAX/XLA
+// compiled forward through tensorflowonspark_tpu.infer_embed.  A JVM (or
+// any C caller) loads this library and never spawns a Python process.
+//
+// Call protocol (mirrors TF-Java's Session.Runner):
+//   tfos_infer_init()                       — idempotent; embeds Python
+//   h = tfos_infer_load(export_dir, model)  — Orbax export + zoo forward fn
+//   tfos_infer_set_input(h, name, data, shape, ndim, dtype)   (per input)
+//   tfos_infer_run(h)
+//   rank = tfos_infer_output_rank(h); tfos_infer_output_shape(h, shape)
+//   n = tfos_infer_get_output(h, buf, capacity)
+//   tfos_infer_close(h)
+//
+// All functions return 0 / a handle / a count on success and -1 on failure;
+// tfos_infer_last_error() returns the failing Python exception as text.
+//
+// Threading: safe from any thread.  If the interpreter already exists (e.g.
+// the smoke test drives this library from ctypes inside Python) the GIL is
+// acquired per call via PyGILState_Ensure; if this library initialised the
+// interpreter (the JVM case) the init thread releases the GIL immediately
+// so every subsequent call can take it the same way.
+//
+// Environment: the embedded interpreter honours PYTHONPATH — the caller
+// must put the framework on it (the JNI wrapper documents this).
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace {
+
+thread_local std::string g_err;
+PyThreadState *g_saved_state = nullptr;
+
+void set_err(const char *msg) { g_err = msg ? msg : "unknown error"; }
+
+void set_err_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_err = "python error";
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c) g_err = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// RAII GIL acquisition (works for both embedded and pre-existing interpreters)
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+PyObject *endpoint() {  // borrowed-module pattern: import once per process
+  static PyObject *mod = nullptr;
+  if (mod == nullptr) {
+    mod = PyImport_ImportModule("tensorflowonspark_tpu.infer_embed");
+  }
+  return mod;
+}
+
+int64_t elems(const int64_t *shape, int ndim) {
+  int64_t n = 1;
+  for (int i = 0; i < ndim; ++i) n *= shape[i];
+  return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *tfos_infer_last_error() { return g_err.c_str(); }
+
+int tfos_infer_init() {
+  if (Py_IsInitialized()) return 0;
+  Py_InitializeEx(0);  // no signal handlers: we are a guest in the process
+  if (!Py_IsInitialized()) {
+    set_err("Py_InitializeEx failed");
+    return -1;
+  }
+  // release the GIL so any thread (JVM worker pools) can PyGILState_Ensure
+  g_saved_state = PyEval_SaveThread();
+  return 0;
+}
+
+int64_t tfos_infer_load(const char *export_dir, const char *model_name) {
+  if (tfos_infer_init() != 0) return -1;
+  Gil gil;
+  PyObject *mod = endpoint();
+  if (!mod) {
+    set_err_from_python();
+    return -1;
+  }
+  PyObject *h = PyObject_CallMethod(mod, "load", "ss", export_dir,
+                                    model_name ? model_name : "");
+  if (!h) {
+    set_err_from_python();
+    return -1;
+  }
+  int64_t handle = PyLong_AsLongLong(h);
+  Py_DECREF(h);
+  return handle;
+}
+
+// dtype: 0 = float32, 1 = int32, 2 = int64 (matches infer_embed._DTYPES)
+int tfos_infer_set_input(int64_t handle, const char *name, const void *data,
+                         const int64_t *shape, int ndim, int dtype) {
+  if (tfos_infer_init() != 0) return -1;
+  Gil gil;
+  PyObject *mod = endpoint();
+  if (!mod) {
+    set_err_from_python();
+    return -1;
+  }
+  const int64_t esize = (dtype == 2) ? 8 : 4;
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      static_cast<const char *>(data), elems(shape, ndim) * esize);
+  PyObject *shape_t = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shape_t, i, PyLong_FromLongLong(shape[i]));
+  PyObject *r = PyObject_CallMethod(mod, "set_input", "LsOOi",
+                                    (long long)handle, name ? name : "",
+                                    bytes, shape_t, dtype);
+  Py_DECREF(bytes);
+  Py_DECREF(shape_t);
+  if (!r) {
+    set_err_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int tfos_infer_run(int64_t handle) {
+  Gil gil;
+  PyObject *mod = endpoint();
+  if (!mod) {
+    set_err_from_python();
+    return -1;
+  }
+  PyObject *r = PyObject_CallMethod(mod, "run", "L", (long long)handle);
+  if (!r) {
+    set_err_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int tfos_infer_output_rank(int64_t handle) {
+  Gil gil;
+  PyObject *mod = endpoint();
+  if (!mod) {
+    set_err_from_python();
+    return -1;
+  }
+  PyObject *s = PyObject_CallMethod(mod, "output_shape", "L",
+                                    (long long)handle);
+  if (!s) {
+    set_err_from_python();
+    return -1;
+  }
+  int rank = (int)PyTuple_Size(s);
+  Py_DECREF(s);
+  return rank;
+}
+
+int tfos_infer_output_shape(int64_t handle, int64_t *shape_out) {
+  Gil gil;
+  PyObject *mod = endpoint();
+  if (!mod) {
+    set_err_from_python();
+    return -1;
+  }
+  PyObject *s = PyObject_CallMethod(mod, "output_shape", "L",
+                                    (long long)handle);
+  if (!s) {
+    set_err_from_python();
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < PyTuple_Size(s); ++i)
+    shape_out[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(s, i));
+  Py_DECREF(s);
+  return 0;
+}
+
+// Copies the float32 output into buf; returns the element count, or -1
+// (including when capacity_floats is too small).
+int64_t tfos_infer_get_output(int64_t handle, float *buf,
+                              int64_t capacity_floats) {
+  Gil gil;
+  PyObject *mod = endpoint();
+  if (!mod) {
+    set_err_from_python();
+    return -1;
+  }
+  PyObject *b = PyObject_CallMethod(mod, "get_output", "L",
+                                    (long long)handle);
+  if (!b) {
+    set_err_from_python();
+    return -1;
+  }
+  const int64_t n = (int64_t)(PyBytes_Size(b) / sizeof(float));
+  if (n > capacity_floats) {
+    Py_DECREF(b);
+    set_err("output buffer too small");
+    return -1;
+  }
+  std::memcpy(buf, PyBytes_AsString(b), n * sizeof(float));
+  Py_DECREF(b);
+  return n;
+}
+
+int tfos_infer_close(int64_t handle) {
+  Gil gil;
+  PyObject *mod = endpoint();
+  if (!mod) {
+    set_err_from_python();
+    return -1;
+  }
+  PyObject *r = PyObject_CallMethod(mod, "close", "L", (long long)handle);
+  if (!r) {
+    set_err_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // extern "C"

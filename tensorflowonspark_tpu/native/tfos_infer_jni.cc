@@ -1,0 +1,237 @@
+// JNI wrapper over the C-ABI inference shim + TFRecord codec.
+//
+// Reference anchor: SURVEY.md §2.2 rows 1-2 — the reference's Scala
+// inference API and tensorflow-hadoop connector jar give JVM Spark jobs
+// model scoring and TFRecord I/O without Python.  This file is the
+// JNI-loadable equivalent: the Java classes below call straight into
+// libtfos_infer.so / libtfrecord.so.
+//
+//   package com.tensorflowonspark.tpu;
+//   public final class TFosInference {
+//     public static native long  load(String exportDir, String modelName);
+//     public static native void  setInput (long h, String name, float[] d, long[] shape);
+//     public static native void  setInputInts (long h, String name, int[] d, long[] shape);
+//     public static native void  setInputLongs(long h, String name, long[] d, long[] shape);
+//     public static native void  run(long h);
+//     public static native long[]  outputShape(long h);
+//     public static native float[] getOutput(long h);
+//     public static native void  close(long h);
+//   }
+//   public final class TFRecordCodec {
+//     public static native long   writeRecords(String path, byte[] concat, long[] lengths);
+//     public static native long[] indexRecords(byte[] fileBytes, boolean verify);
+//         // returns [off0, len0, off1, len1, ...]
+//   }
+//
+// Deployment: System.loadLibrary("tfos_infer_jni") with PYTHONPATH pointing
+// at the framework (the embedded interpreter imports
+// tensorflowonspark_tpu.infer_embed) and LD_LIBRARY_PATH containing
+// libpython.  Errors surface as java.lang.RuntimeException.
+//
+// Built without a JDK against the vendored jni_compat.h (exact JNI 1.6
+// table layout); with a real JDK present, compile with -DTFOS_HAVE_REAL_JNI
+// -I$JAVA_HOME/include to use the official header instead.
+
+#ifdef TFOS_HAVE_REAL_JNI
+#include <jni.h>
+#else
+#include "jni_compat.h"
+#endif
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+// -- C ABI of libtfos_infer.so ----------------------------------------------
+extern "C" {
+const char *tfos_infer_last_error();
+int tfos_infer_init();
+int64_t tfos_infer_load(const char *, const char *);
+int tfos_infer_set_input(int64_t, const char *, const void *, const int64_t *,
+                         int, int);
+int tfos_infer_run(int64_t);
+int tfos_infer_output_rank(int64_t);
+int tfos_infer_output_shape(int64_t, int64_t *);
+int64_t tfos_infer_get_output(int64_t, float *, int64_t);
+int tfos_infer_close(int64_t);
+// libtfrecord.so
+long tfr_write(const char *, const unsigned char *, const unsigned long long *,
+               long);
+long tfr_index(const unsigned char *, unsigned long long, int, uint64_t **,
+               uint64_t **);
+void tfr_free(void *);
+}
+
+namespace {
+
+void throw_runtime(JNIEnv *env, const char *msg) {
+  jclass cls = env->FindClass("java/lang/RuntimeException");
+  if (cls != nullptr) env->ThrowNew(cls, msg);
+}
+
+void throw_last_error(JNIEnv *env) { throw_runtime(env, tfos_infer_last_error()); }
+
+struct Utf {  // RAII UTF chars
+  JNIEnv *env;
+  jstring s;
+  const char *c;
+  Utf(JNIEnv *e, jstring str) : env(e), s(str) {
+    c = s ? env->GetStringUTFChars(s, nullptr) : "";
+  }
+  ~Utf() {
+    if (s) env->ReleaseStringUTFChars(s, c);
+  }
+};
+
+std::vector<int64_t> shape_of(JNIEnv *env, jlongArray shape) {
+  jsize n = env->GetArrayLength(shape);
+  jlong *p = env->GetLongArrayElements(shape, nullptr);
+  std::vector<int64_t> out(p, p + n);
+  env->ReleaseLongArrayElements(shape, p, 0 /* copy back + free */);
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+// -- com.tensorflowonspark.tpu.TFosInference --------------------------------
+
+JNIEXPORT jlong JNICALL Java_com_tensorflowonspark_tpu_TFosInference_load(
+    JNIEnv *env, jclass, jstring export_dir, jstring model_name) {
+  Utf dir(env, export_dir), name(env, model_name);
+  int64_t h = tfos_infer_load(dir.c, name.c);
+  if (h < 0) throw_last_error(env);
+  return (jlong)h;
+}
+
+JNIEXPORT void JNICALL Java_com_tensorflowonspark_tpu_TFosInference_setInput(
+    JNIEnv *env, jclass, jlong h, jstring name, jfloatArray data,
+    jlongArray shape) {
+  Utf n(env, name);
+  std::vector<int64_t> dims = shape_of(env, shape);
+  jfloat *p = env->GetFloatArrayElements(data, nullptr);
+  int rc = tfos_infer_set_input(h, n.c, p, dims.data(), (int)dims.size(), 0);
+  env->ReleaseFloatArrayElements(data, p, 2 /* JNI_ABORT: read-only */);
+  if (rc != 0) throw_last_error(env);
+}
+
+JNIEXPORT void JNICALL
+Java_com_tensorflowonspark_tpu_TFosInference_setInputInts(
+    JNIEnv *env, jclass, jlong h, jstring name, jintArray data,
+    jlongArray shape) {
+  Utf n(env, name);
+  std::vector<int64_t> dims = shape_of(env, shape);
+  jint *p = env->GetIntArrayElements(data, nullptr);
+  int rc = tfos_infer_set_input(h, n.c, p, dims.data(), (int)dims.size(), 1);
+  env->ReleaseIntArrayElements(data, p, 2);
+  if (rc != 0) throw_last_error(env);
+}
+
+JNIEXPORT void JNICALL
+Java_com_tensorflowonspark_tpu_TFosInference_setInputLongs(
+    JNIEnv *env, jclass, jlong h, jstring name, jlongArray data,
+    jlongArray shape) {
+  Utf n(env, name);
+  std::vector<int64_t> dims = shape_of(env, shape);
+  jlong *p = env->GetLongArrayElements(data, nullptr);
+  int rc = tfos_infer_set_input(h, n.c, p, dims.data(), (int)dims.size(), 2);
+  env->ReleaseLongArrayElements(data, p, 2);
+  if (rc != 0) throw_last_error(env);
+}
+
+JNIEXPORT void JNICALL Java_com_tensorflowonspark_tpu_TFosInference_run(
+    JNIEnv *env, jclass, jlong h) {
+  if (tfos_infer_run(h) != 0) throw_last_error(env);
+}
+
+JNIEXPORT jlongArray JNICALL
+Java_com_tensorflowonspark_tpu_TFosInference_outputShape(JNIEnv *env, jclass,
+                                                         jlong h) {
+  int rank = tfos_infer_output_rank(h);
+  if (rank < 0) {
+    throw_last_error(env);
+    return nullptr;
+  }
+  std::vector<int64_t> dims(rank);
+  if (tfos_infer_output_shape(h, dims.data()) != 0) {
+    throw_last_error(env);
+    return nullptr;
+  }
+  jlongArray out = env->NewLongArray(rank);
+  std::vector<jlong> jdims(dims.begin(), dims.end());
+  env->SetLongArrayRegion(out, 0, rank, jdims.data());
+  return out;
+}
+
+JNIEXPORT jfloatArray JNICALL
+Java_com_tensorflowonspark_tpu_TFosInference_getOutput(JNIEnv *env, jclass,
+                                                       jlong h) {
+  int rank = tfos_infer_output_rank(h);
+  if (rank < 0) {
+    throw_last_error(env);
+    return nullptr;
+  }
+  std::vector<int64_t> dims(rank);
+  tfos_infer_output_shape(h, dims.data());
+  int64_t n = 1;
+  for (int64_t d : dims) n *= d;
+  std::vector<float> buf(n);
+  if (tfos_infer_get_output(h, buf.data(), n) < 0) {
+    throw_last_error(env);
+    return nullptr;
+  }
+  jfloatArray out = env->NewFloatArray((jsize)n);
+  env->SetFloatArrayRegion(out, 0, (jsize)n, buf.data());
+  return out;
+}
+
+JNIEXPORT void JNICALL Java_com_tensorflowonspark_tpu_TFosInference_close(
+    JNIEnv *env, jclass, jlong h) {
+  if (tfos_infer_close(h) != 0) throw_last_error(env);
+}
+
+// -- com.tensorflowonspark.tpu.TFRecordCodec --------------------------------
+
+JNIEXPORT jlong JNICALL
+Java_com_tensorflowonspark_tpu_TFRecordCodec_writeRecords(
+    JNIEnv *env, jclass, jstring path, jbyteArray concat, jlongArray lengths) {
+  Utf p(env, path);
+  jsize nlen = env->GetArrayLength(lengths);
+  jlong *lens = env->GetLongArrayElements(lengths, nullptr);
+  std::vector<unsigned long long> ulens(lens, lens + nlen);
+  env->ReleaseLongArrayElements(lengths, lens, 2);
+  jbyte *data = env->GetByteArrayElements(concat, nullptr);
+  long n = tfr_write(p.c, (const unsigned char *)data, ulens.data(), nlen);
+  env->ReleaseByteArrayElements(concat, data, 2);
+  if (n < 0) throw_runtime(env, "tfr_write failed (I/O error)");
+  return (jlong)n;
+}
+
+JNIEXPORT jlongArray JNICALL
+Java_com_tensorflowonspark_tpu_TFRecordCodec_indexRecords(
+    JNIEnv *env, jclass, jbyteArray file_bytes, jboolean verify) {
+  jsize size = env->GetArrayLength(file_bytes);
+  jbyte *data = env->GetByteArrayElements(file_bytes, nullptr);
+  uint64_t *offs = nullptr, *lens = nullptr;
+  long n = tfr_index((const unsigned char *)data, (unsigned long long)size,
+                     verify ? 1 : 0, &offs, &lens);
+  env->ReleaseByteArrayElements(file_bytes, data, 2);
+  if (n < 0) {
+    throw_runtime(env, n == -1 ? "corrupt TFRecord data"
+                               : "truncated TFRecord data");
+    return nullptr;
+  }
+  std::vector<jlong> inter(2 * n);
+  for (long i = 0; i < n; ++i) {
+    inter[2 * i] = (jlong)offs[i];
+    inter[2 * i + 1] = (jlong)lens[i];
+  }
+  tfr_free(offs);
+  tfr_free(lens);
+  jlongArray out = env->NewLongArray((jsize)(2 * n));
+  env->SetLongArrayRegion(out, 0, (jsize)(2 * n), inter.data());
+  return out;
+}
+
+}  // extern "C"

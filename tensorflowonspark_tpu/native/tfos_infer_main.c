@@ -1,0 +1,88 @@
+/* tfos_infer_demo — batched inference with NO Python driver process.
+ *
+ * Proves the SURVEY.md §2.2 row-1 contract: a plain C program (standing in
+ * for a JVM executor) links libtfos_infer.so, loads an exported model, and
+ * scores a float batch.  The only Python anywhere is libpython embedded in
+ * THIS process by the shim — exactly how the JNI wrapper runs inside a JVM.
+ *
+ * Usage: tfos_infer_demo <export_dir> <model_name> <batch> <feature_dim>
+ * Env:   PYTHONPATH must include the framework repo.
+ * Output line: "OK n=<elems> shape=<d0>x<d1> sum=<sum> first=<v0>"
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+extern const char *tfos_infer_last_error(void);
+extern int tfos_infer_init(void);
+extern int64_t tfos_infer_load(const char *, const char *);
+extern int tfos_infer_set_input(int64_t, const char *, const void *,
+                                const int64_t *, int, int);
+extern int tfos_infer_run(int64_t);
+extern int tfos_infer_output_rank(int64_t);
+extern int tfos_infer_output_shape(int64_t, int64_t *);
+extern int64_t tfos_infer_get_output(int64_t, float *, int64_t);
+extern int tfos_infer_close(int64_t);
+#ifdef __cplusplus
+}
+#endif
+
+int main(int argc, char **argv) {
+  if (argc < 5) {
+    fprintf(stderr,
+            "usage: %s <export_dir> <model_name> <batch> <feature_dim>\n",
+            argv[0]);
+    return 2;
+  }
+  const char *export_dir = argv[1];
+  const char *model_name = argv[2];
+  int64_t batch = atoll(argv[3]);
+  int64_t dim = atoll(argv[4]);
+
+  if (tfos_infer_init() != 0) {
+    fprintf(stderr, "init: %s\n", tfos_infer_last_error());
+    return 1;
+  }
+  int64_t h = tfos_infer_load(export_dir, model_name);
+  if (h < 0) {
+    fprintf(stderr, "load: %s\n", tfos_infer_last_error());
+    return 1;
+  }
+
+  int64_t n_in = batch * dim;
+  float *input = (float *)malloc(n_in * sizeof(float));
+  for (int64_t i = 0; i < n_in; i++) input[i] = (float)(i % 97) * 0.01f;
+  int64_t shape[2] = {batch, dim};
+  /* "" = the model's single input (infer_embed resolves the name) */
+  if (tfos_infer_set_input(h, "", input, shape, 2, 0) != 0 ||
+      tfos_infer_run(h) != 0) {
+    fprintf(stderr, "predict: %s\n", tfos_infer_last_error());
+    return 1;
+  }
+  free(input);
+
+  int rank = tfos_infer_output_rank(h);
+  int64_t out_shape[8] = {0};
+  if (rank < 0 || rank > 8 || tfos_infer_output_shape(h, out_shape) != 0) {
+    fprintf(stderr, "shape: %s\n", tfos_infer_last_error());
+    return 1;
+  }
+  int64_t n_out = 1;
+  for (int i = 0; i < rank; i++) n_out *= out_shape[i];
+  float *out = (float *)malloc(n_out * sizeof(float));
+  if (tfos_infer_get_output(h, out, n_out) < 0) {
+    fprintf(stderr, "output: %s\n", tfos_infer_last_error());
+    return 1;
+  }
+  double sum = 0.0;
+  for (int64_t i = 0; i < n_out; i++) sum += out[i];
+  printf("OK n=%lld shape=%lldx%lld sum=%.6f first=%.6f\n", (long long)n_out,
+         (long long)out_shape[0], (long long)(rank > 1 ? out_shape[1] : 1),
+         sum, out[0]);
+  free(out);
+  tfos_infer_close(h);
+  return 0;
+}

@@ -1,0 +1,126 @@
+"""JVM-side inference shim: C-ABI sequence via ctypes (simulating the JNI
+call order), the no-Python-driver C demo, and the JNI library's exported
+symbols (VERDICT r2 task 4 / SURVEY §2.2 rows 1-2)."""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import ckpt
+from tensorflowonspark_tpu import models as model_zoo
+from tensorflowonspark_tpu.native import infer_native
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def export(tmp_path_factory):
+    """A tiny mnist_mlp export + its python-side forward for reference."""
+    import jax
+
+    lib = model_zoo.get_model("mnist_mlp")
+    config = lib.Config.tiny()
+    module = lib.make_model(config)
+    batch = lib.example_batch(config, batch_size=1)
+    from flax.linen import meta
+
+    variables = meta.unbox(module.init(jax.random.PRNGKey(0), batch["image"]))
+    params = variables["params"]
+    path = str(tmp_path_factory.mktemp("export") / "model")
+    ckpt.save_pytree({"params": params}, path)
+    forward = lib.make_forward_fn(module, config)
+    dim = config.image_size * config.image_size
+    return path, params, forward, dim
+
+
+@pytest.mark.skipif(not infer_native.available(),
+                    reason="native toolchain unavailable")
+def test_ctypes_jni_call_sequence(export):
+    path, params, forward, dim = export
+    x = (np.arange(4 * dim, dtype=np.float32) % 97) * 0.01
+    x = x.reshape(4, dim)
+
+    sess = infer_native.Session(path, "mnist_mlp")
+    try:
+        out = sess.predict(x)  # load → set_input("") → run → shape → output
+    finally:
+        sess.close()
+    expected = np.asarray(forward(params, {"image": x}))
+    assert out.shape == expected.shape
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.skipif(not infer_native.available(),
+                    reason="native toolchain unavailable")
+def test_named_input_and_reuse(export):
+    path, params, forward, dim = export
+    sess = infer_native.Session(path, "mnist_mlp")
+    try:
+        for batch_size in (2, 8):  # handle reuse across batch sizes
+            x = np.random.default_rng(batch_size).normal(
+                size=(batch_size, dim)).astype(np.float32)
+            sess.set_input("image", x)
+            sess.run()
+            out = sess.output()
+            expected = np.asarray(forward(params, {"image": x}))
+            np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-5)
+    finally:
+        sess.close()
+
+
+@pytest.mark.skipif(not infer_native.available(),
+                    reason="native toolchain unavailable")
+def test_unknown_input_name_surfaces_python_error(export):
+    path, _, _, dim = export
+    sess = infer_native.Session(path, "mnist_mlp")
+    try:
+        with pytest.raises(RuntimeError, match="unknown input"):
+            sess.set_input("nonexistent", np.zeros((1, dim), np.float32))
+    finally:
+        sess.close()
+
+
+def test_demo_runs_without_python_driver(export):
+    """A plain C process (no Python driver) scores a batch end-to-end."""
+    demo = infer_native.demo_binary()
+    if demo is None:
+        pytest.skip("demo driver did not build")
+    path, params, forward, dim = export
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("TFOS_JAX_PLATFORM", "cpu")
+    env.setdefault("TFOS_NUM_CHIPS", "0")
+    proc = subprocess.run(
+        [demo, path, "mnist_mlp", "4", str(dim)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    assert line.startswith("OK "), line
+    # reproduce the demo's deterministic input and check the output sum
+    x = ((np.arange(4 * dim, dtype=np.float32) % 97) * 0.01).reshape(4, dim)
+    expected = float(np.asarray(forward(params, {"image": x})).sum())
+    got = float(line.split("sum=")[1].split()[0])
+    assert abs(got - expected) < 1e-3 * max(1.0, abs(expected)), (got, expected)
+
+
+def test_jni_library_exports_expected_symbols():
+    lib = infer_native.jni_library()
+    if lib is None:
+        pytest.skip("JNI wrapper did not build")
+    syms = subprocess.run(["nm", "-D", lib], capture_output=True,
+                          text=True).stdout
+    for sym in (
+        "Java_com_tensorflowonspark_tpu_TFosInference_load",
+        "Java_com_tensorflowonspark_tpu_TFosInference_setInput",
+        "Java_com_tensorflowonspark_tpu_TFosInference_setInputInts",
+        "Java_com_tensorflowonspark_tpu_TFosInference_setInputLongs",
+        "Java_com_tensorflowonspark_tpu_TFosInference_run",
+        "Java_com_tensorflowonspark_tpu_TFosInference_outputShape",
+        "Java_com_tensorflowonspark_tpu_TFosInference_getOutput",
+        "Java_com_tensorflowonspark_tpu_TFosInference_close",
+        "Java_com_tensorflowonspark_tpu_TFRecordCodec_writeRecords",
+        "Java_com_tensorflowonspark_tpu_TFRecordCodec_indexRecords",
+    ):
+        assert sym in syms, f"missing JNI export {sym}"
