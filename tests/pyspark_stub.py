@@ -1,0 +1,160 @@
+"""Test-only stub ``pyspark`` package (VERDICT r2 task 7).
+
+Installs importable ``pyspark`` / ``pyspark.sql`` / ``pyspark.sql.types``
+modules into ``sys.modules`` so the ``backend == PYSPARK`` branches of
+``sql_compat`` and ``pipeline`` actually execute in this pyspark-less
+environment.  The stub mimics the exact protocol surface those branches
+touch: the ``Row(*names)(*values)`` factory with ``__fields__``, the
+``types`` constructors, and ``SparkSession.builder.getOrCreate().
+createDataFrame(rdd, schema)`` (recorded, not computed).
+"""
+
+from __future__ import annotations
+
+import sys
+import types as _pytypes
+
+
+class Row(tuple):
+    """pyspark.sql.Row protocol subset: factory + named access."""
+
+    def __new__(cls, *args, **kwargs):
+        if args and kwargs:
+            raise ValueError("cannot mix args and kwargs")
+        if kwargs:
+            row = tuple.__new__(cls, kwargs.values())
+            row.__fields__ = list(kwargs)
+            return row
+        return tuple.__new__(cls, args)
+
+    def __call__(self, *values):
+        # a Row built from names acts as a factory: Row("a","b")(1, 2)
+        if len(values) != len(self):
+            raise ValueError(f"expected {len(self)} values, got {len(values)}")
+        row = Row(*values)
+        row.__fields__ = list(self)
+        return row
+
+    def __getitem__(self, item):
+        if isinstance(item, str):
+            return tuple.__getitem__(self, self.__fields__.index(item))
+        return tuple.__getitem__(self, item)
+
+    def asDict(self):
+        return dict(zip(self.__fields__, self))
+
+    def __repr__(self):
+        if hasattr(self, "__fields__"):
+            body = ", ".join(f"{n}={v!r}" for n, v in zip(self.__fields__, self))
+            return f"Row({body})"
+        return f"Row({', '.join(map(repr, self))})"
+
+
+class DataType:
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+class ByteType(DataType): pass          # noqa: E701
+class ShortType(DataType): pass         # noqa: E701
+class IntegerType(DataType): pass       # noqa: E701
+class LongType(DataType): pass          # noqa: E701
+class FloatType(DataType): pass         # noqa: E701
+class DoubleType(DataType): pass        # noqa: E701
+class StringType(DataType): pass        # noqa: E701
+class BinaryType(DataType): pass        # noqa: E701
+class BooleanType(DataType): pass       # noqa: E701
+
+
+class ArrayType(DataType):
+    def __init__(self, elementType, containsNull=True):
+        self.elementType = elementType
+        self.containsNull = containsNull
+
+
+class StructField:
+    def __init__(self, name, dataType, nullable=True):
+        self.name = name
+        self.dataType = dataType
+        self.nullable = nullable
+
+    def __eq__(self, other):
+        return (isinstance(other, StructField) and self.name == other.name
+                and self.dataType == other.dataType)
+
+    def __repr__(self):
+        return f"StructField({self.name},{self.dataType!r})"
+
+
+class StructType(DataType):
+    def __init__(self, fields=None):
+        self.fields = list(fields or [])
+
+    @property
+    def names(self):
+        return [f.name for f in self.fields]
+
+
+class DataFrame:
+    """Just enough DataFrame for TFModel.transform: rdd/columns/session."""
+
+    def __init__(self, rdd, schema, sparkSession=None):
+        self.rdd = rdd
+        self.schema = schema
+        self.sparkSession = sparkSession
+
+    @property
+    def columns(self):
+        return [f.name for f in self.schema.fields]
+
+
+class SparkSession:
+    _active = None
+
+    class _Builder:
+        def getOrCreate(self):
+            if SparkSession._active is None:
+                SparkSession._active = SparkSession()
+            return SparkSession._active
+
+    builder = _Builder()
+
+    def __init__(self):
+        self.created: list = []  # (rdd, schema) recorder
+
+    def createDataFrame(self, rdd, schema=None):
+        self.created.append((rdd, schema))
+        return DataFrame(rdd, schema, self)
+
+
+def install() -> None:
+    """Make ``import pyspark.sql.types`` etc. resolve to this stub."""
+    if "pyspark" in sys.modules:
+        return
+    pyspark = _pytypes.ModuleType("pyspark")
+    sql = _pytypes.ModuleType("pyspark.sql")
+    T = _pytypes.ModuleType("pyspark.sql.types")
+    for cls in (Row, DataFrame, SparkSession):
+        cls.__module__ = "pyspark.sql"
+        setattr(sql, cls.__name__, cls)
+    for cls in (DataType, ByteType, ShortType, IntegerType, LongType,
+                FloatType, DoubleType, StringType, BinaryType, BooleanType,
+                ArrayType, StructField, StructType):
+        cls.__module__ = "pyspark.sql.types"
+        setattr(T, cls.__name__, cls)
+    sql.types = T
+    pyspark.sql = sql
+    sys.modules["pyspark"] = pyspark
+    sys.modules["pyspark.sql"] = sql
+    sys.modules["pyspark.sql.types"] = T
+
+
+def uninstall() -> None:
+    SparkSession._active = None
+    for name in ("pyspark", "pyspark.sql", "pyspark.sql.types"):
+        mod = sys.modules.get(name)
+        if mod is not None and getattr(mod, "__file__", None) is None:
+            del sys.modules[name]  # only remove the stub, never real pyspark

@@ -1,0 +1,144 @@
+"""Execute every ``backend == PYSPARK`` branch against the stub pyspark
+package (VERDICT r2 task 7 / SURVEY §2.2 row 4 — "py4j / Spark JVM kept
+as-is" portability, previously an unverified claim)."""
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import sql_compat
+
+import pyspark_stub
+
+
+@pytest.fixture(autouse=True)
+def stub():
+    pyspark_stub.install()
+    yield
+    pyspark_stub.uninstall()
+
+
+def test_backend_of_classifies_stub_objects():
+    from pyspark.sql import Row
+
+    row = Row("a")(1)
+    assert sql_compat.backend_of(row) == sql_compat.PYSPARK
+    assert sql_compat.backend_of(object()) == sql_compat.SPARKAPI
+
+
+def test_make_row_pyspark_ordered_fields():
+    row = sql_compat.make_row(["b", "a"], [2, 1], sql_compat.PYSPARK)
+    assert type(row).__module__ == "pyspark.sql"
+    assert row["b"] == 2 and row["a"] == 1
+    names, values = sql_compat.row_fields(row)
+    assert names == ["b", "a"] and values == [2, 1]
+
+
+def test_struct_type_pyspark_all_atomics():
+    from pyspark.sql import types as T
+
+    fields = [
+        ("t", "tinyint"), ("s", "smallint"), ("i", "int"), ("i2", "integer"),
+        ("b", "bigint"), ("l", "long"), ("f", "float"), ("d", "double"),
+        ("st", "string"), ("bin", "binary"), ("bool", "boolean"),
+        ("dec", "decimal(10,2)"), ("arr", "array<double>"),
+    ]
+    st = sql_compat.struct_type(fields, sql_compat.PYSPARK)
+    assert isinstance(st, T.StructType)
+    by_name = {f.name: f.dataType for f in st.fields}
+    assert isinstance(by_name["t"], T.ByteType)
+    assert isinstance(by_name["s"], T.ShortType)
+    assert isinstance(by_name["i"], T.IntegerType)
+    assert isinstance(by_name["b"], T.LongType)
+    assert isinstance(by_name["f"], T.FloatType)
+    assert isinstance(by_name["d"], T.DoubleType)
+    assert isinstance(by_name["st"], T.StringType)
+    assert isinstance(by_name["bin"], T.BinaryType)
+    assert isinstance(by_name["bool"], T.BooleanType)
+    assert isinstance(by_name["dec"], T.DoubleType)  # decimal degrades
+    assert isinstance(by_name["arr"], T.ArrayType)
+    assert isinstance(by_name["arr"].elementType, T.DoubleType)
+
+
+def test_struct_type_pyspark_unsupported_raises():
+    with pytest.raises(TypeError, match="unsupported"):
+        sql_compat.struct_type([("m", "map<string,int>")], sql_compat.PYSPARK)
+
+
+def test_create_dataframe_with_explicit_session():
+    from pyspark.sql import SparkSession, types as T
+
+    session = SparkSession()
+    sentinel_rdd = object()
+    df = sql_compat.create_dataframe(
+        sentinel_rdd, [("x", "double")], sql_compat.PYSPARK, session)
+    assert session.created == [(sentinel_rdd, df.schema)]
+    assert isinstance(df.schema.fields[0].dataType, T.DoubleType)
+
+
+def test_create_dataframe_builder_fallback():
+    from pyspark.sql import SparkSession
+
+    df = sql_compat.create_dataframe(
+        object(), [("x", "bigint")], sql_compat.PYSPARK, session=None)
+    assert df.sparkSession is SparkSession._active  # builder.getOrCreate path
+
+
+def test_fromTFExample_and_infer_schema_pyspark():
+    from pyspark.sql import types as T
+
+    from tensorflowonspark_tpu import dfutil, tfrecord
+
+    ex = tfrecord.encode_example({
+        "label": (tfrecord.INT64_LIST, [3]),
+        "vec": (tfrecord.FLOAT_LIST, [1.0, 2.0]),
+        "name": (tfrecord.BYTES_LIST, [b"abc"]),
+    })
+    row = dfutil.fromTFExample(ex, backend=sql_compat.PYSPARK)
+    assert type(row).__module__ == "pyspark.sql"
+    assert row["label"] == 3 and row["name"] == "abc"
+    assert row["vec"] == [1.0, 2.0]
+    schema = dfutil.infer_schema(ex, backend=sql_compat.PYSPARK)
+    assert isinstance(schema, T.StructType)
+    by_name = {f.name: f.dataType for f in schema.fields}
+    assert isinstance(by_name["label"], T.LongType)
+    assert isinstance(by_name["vec"], T.ArrayType)
+    assert isinstance(by_name["name"], T.StringType)
+
+
+def test_tfmodel_transform_pyspark_path(tmp_path):
+    """TFModel.transform over a pyspark-backed DataFrame: schema sampling,
+    make_row, and createDataFrame all take the PYSPARK branches (data rows
+    stay plain dicts so executor processes never need the stub)."""
+    from pyspark.sql import DataFrame as StubDF, SparkSession
+    from pyspark.sql import types as T
+
+    from tensorflowonspark_tpu import ckpt
+    from tensorflowonspark_tpu.pipeline import TFModel
+    from tensorflowonspark_tpu.sparkapi import get_spark_context
+
+    export = tmp_path / "export"
+    ckpt.save_pytree({"params": {"w": np.asarray([[2.0]])}}, str(export))
+
+    sc = get_spark_context("local[2]", "pyspark-compat")
+    try:
+        rows = [{"x": [float(i)]} for i in range(8)]
+        rdd = sc.parallelize(rows, 2)
+        session = SparkSession.builder.getOrCreate()
+        schema = T.StructType([T.StructField("x", T.ArrayType(T.DoubleType()))])
+        df = StubDF(rdd, schema, session)
+        assert sql_compat.backend_of(df) == sql_compat.PYSPARK
+
+        def predict_fn(params, batch):
+            return {"pred": np.asarray(batch["x"]) @ params["w"]}
+
+        model = TFModel(predict_fn=predict_fn)
+        model.setExportDir(str(export)).setBatchSize(4)
+        model.setInputMapping({"x": "x"})
+        out = model.transform(df)
+        # the output is a stub DataFrame created via session.createDataFrame
+        assert isinstance(out, StubDF)
+        assert out.sparkSession is session
+        assert [f.name for f in out.schema.fields] == ["pred"]
+        assert session.created[-1][1] is out.schema
+    finally:
+        sc.stop()
