@@ -18,8 +18,10 @@ self-set north-star targets below.
 
 Usage::
 
-    python bench.py                      # resnet50, auto batch/steps
-    python bench.py --model wide_deep    # Criteo steps/sec
+    python bench.py                      # BOTH halves of BASELINE.json::metric:
+                                         # resnet50 images/sec/chip (primary) +
+                                         # Criteo wide_deep steps/sec (secondary)
+    python bench.py --model wide_deep    # a single model only
 """
 
 from __future__ import annotations
@@ -41,6 +43,17 @@ TARGETS = {
     "cifar10_cnn": ("images/sec/chip", 20000.0),
 }
 
+# Per-chip auto batch sizes on accelerators (CPU fallback uses 16).  The CTR
+# model is bandwidth-bound (embedding gathers + dense optimizer update over
+# the fused table), so it wants a much larger batch than the conv nets.
+ACCEL_BATCH = {
+    "resnet50": 128,
+    "wide_deep": 4096,
+    "bert": 32,
+    "mnist_mlp": 512,
+    "cifar10_cnn": 256,
+}
+
 # Peak dense bf16 FLOP/s per chip, keyed by a substring of device_kind.
 # (MFU is conventionally quoted against the bf16 matmul peak.)
 PEAK_FLOPS = [
@@ -58,10 +71,14 @@ _FALLBACK_TIMEOUT_S = 420
 
 def _parse_args(argv=None):
     p = argparse.ArgumentParser()
-    p.add_argument("--model", default="resnet50", choices=sorted(TARGETS))
+    # default None = "the headline run": resnet50 primary + wide_deep secondary
+    p.add_argument("--model", default=None, choices=sorted(TARGETS))
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--steps", type=int, default=None)
     p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--feed", action="store_true",
+                   help="measure feed/compute overlap of the input pipeline "
+                        "(SURVEY §3.2 hard part (b)) instead of throughput")
     p.add_argument("--_measure", action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--_force-cpu", action="store_true", help=argparse.SUPPRESS)
     return p.parse_args(argv)
@@ -85,6 +102,15 @@ def _analytic_flops(model: str, config, batch_size: int) -> float | None:
     if model == "resnet50" and getattr(config, "image_size", 0) == 224 and \
             tuple(getattr(config, "stage_sizes", ())) == (3, 4, 6, 3):
         return 3.0 * 8.2e9 * batch_size  # ~4.1 GMACs fwd per 224x224 image
+    if model == "wide_deep":
+        # derived, not a constant: MLP matmul chain dominates the countable
+        # FLOPs (the gathers/optimizer update are bandwidth, not FLOPs)
+        from tensorflowonspark_tpu.models import widedeep as wd
+
+        dims = [wd.NUM_CAT * config.embed_dim + wd.NUM_DENSE,
+                *config.hidden, 1]
+        fwd = 2.0 * sum(a * b for a, b in zip(dims, dims[1:]))
+        return 3.0 * fwd * batch_size
     return None
 
 
@@ -109,7 +135,7 @@ def measure(args) -> dict:
     config = lib.Config() if on_accel else lib.Config.tiny()
     batch_size = args.batch_size
     if batch_size is None:
-        batch_size = (128 if on_accel else 16) * max(1, n_chips)
+        batch_size = (ACCEL_BATCH[args.model] if on_accel else 16) * max(1, n_chips)
     steps = args.steps
     if steps is None:
         steps = 20 if on_accel else 5
@@ -145,26 +171,34 @@ def measure(args) -> dict:
     if flops_per_step is None:
         flops_per_step = _analytic_flops(args.model, config, batch_size)
 
+    def fetch_loss(loss):
+        """Host round-trip of the loss, tolerant of None (steps=0) and
+        non-scalar losses (per-device replicas)."""
+        if loss is None:
+            return None
+        import numpy as np
+
+        return float(np.asarray(jax.device_get(loss)).mean())
+
     def timed_loop(state, sync_each_step):
         loss = None
         t0 = time.perf_counter()
         for _ in range(steps):
             state, loss = step_fn(state, device_batch)
             if sync_each_step:
-                float(jax.device_get(loss))  # hard host round-trip per step
+                fetch_loss(loss)  # hard host round-trip per step
         # fetch the actual bytes, not just block_until_ready: the final loss
         # data-depends on every step, and a remote backend can ack readiness
         # without finishing, but it cannot hand back a value it hasn't
         # computed
-        float(jax.device_get(loss))
+        fetch_loss(loss)
         return state, loss, time.perf_counter() - t0
 
     state = trainer.state
     loss = None
     for _ in range(args.warmup):
         state, loss = step_fn(state, device_batch)
-    if loss is not None:
-        float(jax.device_get(loss))
+    fetch_loss(loss)
 
     state, loss, dt = timed_loop(state, sync_each_step=False)
 
@@ -200,7 +234,7 @@ def measure(args) -> dict:
         "platform": platform,
         "n_chips": n_chips,
         "batch_size": batch_size,
-        "loss": round(float(loss), 4),
+        "loss": (round(fetch_loss(loss), 4) if loss is not None else None),
     }
     if mfu is not None:
         result["mfu"] = round(mfu, 4)
@@ -210,6 +244,106 @@ def measure(args) -> dict:
         result["synced_timing"] = True
     if flops_per_step is not None:
         result["flops_per_step"] = flops_per_step
+    return result
+
+
+def measure_feed(args) -> dict:
+    """Prove feed/compute overlap on the REAL input pipeline.
+
+    Times three passes over the same synthetic ImageNet-shaped TFRecords:
+    feed-only (readers pipeline, no training), compute-only (device-resident
+    batch), and overlapped (prefetch=2, batches staged onto the mesh by the
+    pipeline thread while the previous batch trains).  Overlap is proven
+    when overlapped ≈ max(feed, compute) rather than their sum.
+    """
+    if args._force_cpu:
+        os.environ["TFOS_JAX_PLATFORM"] = "cpu"
+        os.environ.setdefault("TFOS_NUM_CHIPS", "0")
+    import tempfile
+
+    from tensorflowonspark_tpu import util
+
+    util.ensure_jax_platform()
+    import jax
+
+    from tensorflowonspark_tpu import readers
+    from tensorflowonspark_tpu import models as model_zoo
+    from tensorflowonspark_tpu.models import resnet
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    platform = jax.default_backend()
+    on_accel = platform in ("tpu", "gpu")
+    lib = model_zoo.get_model("resnet50")
+    config = lib.Config() if on_accel else lib.Config.tiny()
+    side = config.image_size
+    # per-batch work must dwarf the ~0.3 ms thread handoff for the overlap
+    # signal to be measurable; the tiny CPU config needs a big batch
+    batch_size = args.batch_size or (64 if on_accel else 512)
+    n_batches = 12
+
+    tmpdir = tempfile.mkdtemp(prefix="tfos_feed_")
+    files = resnet.write_synthetic_tfrecords(
+        tmpdir, batch_size * n_batches, parts=4, side=side)
+
+    trainer = Trainer("resnet50", config=config)
+
+    def batches(prefetch):
+        return readers.tfrecord_batches(
+            files, batch_size, parse_fn=resnet.tfrecord_parse_fn(side),
+            drop_remainder=True, readers=2, prefetch=prefetch,
+            device_put=trainer.shard)
+
+    # compile once
+    warm = trainer.shard(lib.example_batch(config, batch_size=batch_size))
+    state, loss = trainer.state, None
+    for _ in range(2):
+        state, loss = trainer.train_step(state, warm)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    n = 0
+    for b in batches(prefetch=0):
+        jax.block_until_ready(jax.tree_util.tree_leaves(b)[0])
+        n += 1
+    feed_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state, loss = trainer.train_step(state, warm)
+    jax.block_until_ready(loss)
+    compute_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for b in batches(prefetch=2):
+        state, loss = trainer.train_step(state, b)
+    jax.block_until_ready(loss)
+    overlapped_s = time.perf_counter() - t0
+
+    serial = feed_s + compute_s
+    ideal = max(feed_s, compute_s)
+    # 1.0 = perfect overlap (wall == max); 0.0 = fully serialized (== sum)
+    efficiency = (serial - overlapped_s) / max(serial - ideal, 1e-9)
+    result = {
+        "metric": "feed_compute_overlap_efficiency",
+        "value": round(min(max(efficiency, 0.0), 1.5), 4),
+        "unit": "fraction",
+        "vs_baseline": round(min(max(efficiency, 0.0), 1.5), 4),
+        "platform": platform,
+        "batch_size": batch_size,
+        "n_batches": n,
+        "feed_only_s": round(feed_s, 4),
+        "compute_only_s": round(compute_s, 4),
+        "overlapped_s": round(overlapped_s, 4),
+        "serial_sum_s": round(serial, 4),
+        "ideal_max_s": round(ideal, 4),
+    }
+    if not on_accel:
+        # on the CPU backend the parse threads and XLA compute share the
+        # same cores — there is no second device to overlap against, so
+        # wall ≈ sum regardless of pipeline correctness (the sleep-based
+        # unit tests in tests/test_readers.py / test_datafeed.py isolate
+        # the mechanism instead)
+        result["limitation"] = "cpu backend: feed and compute share cores"
     return result
 
 
@@ -237,13 +371,9 @@ def _run_child(argv: list[str], timeout_s: int) -> dict | None:
     return {"_error": f"rc={proc.returncode}: {tail[:400]}"}
 
 
-def main() -> None:
-    args = _parse_args()
-    if args._measure:
-        print(json.dumps(measure(args)))
-        return
-
-    passthrough = [f"--model={args.model}", f"--warmup={args.warmup}"]
+def _bench_one(model: str, args) -> dict:
+    """Measure one model fail-soft: accelerator child → CPU child → stub."""
+    passthrough = [f"--model={model}", f"--warmup={args.warmup}"]
     if args.batch_size is not None:
         passthrough.append(f"--batch-size={args.batch_size}")
     if args.steps is not None:
@@ -251,27 +381,68 @@ def main() -> None:
 
     result = _run_child(passthrough, _PRIMARY_TIMEOUT_S)
     if result is not None and "_error" not in result:
-        print(json.dumps(result))
-        return
+        return result
 
     primary_error = (result or {}).get("_error", "no JSON from child")
-    print(f"bench: primary attempt failed ({primary_error}); "
+    print(f"bench: {model} primary attempt failed ({primary_error}); "
           "retrying on forced-CPU backend", file=sys.stderr)
     fallback = _run_child(passthrough + ["--_force-cpu"], _FALLBACK_TIMEOUT_S)
     if fallback is not None and "_error" not in fallback:
         fallback["degraded"] = f"accelerator unavailable: {primary_error}"
-        print(json.dumps(fallback))
-        return
+        return fallback
 
-    unit, _ = TARGETS[args.model]
-    print(json.dumps({
-        "metric": f"{args.model}_{unit.replace('/', '_per_').replace('.', '')}",
+    unit, _ = TARGETS[model]
+    return {
+        "metric": f"{model}_{unit.replace('/', '_per_').replace('.', '')}",
         "value": 0.0,
         "unit": unit,
         "vs_baseline": 0.0,
         "error": primary_error,
         "fallback_error": (fallback or {}).get("_error", "no JSON from child"),
-    }))
+    }
+
+
+def main() -> None:
+    args = _parse_args()
+    if args._measure:
+        if args.feed:
+            print(json.dumps(measure_feed(args)))
+            return
+        if args.model is None:
+            args.model = "resnet50"
+        print(json.dumps(measure(args)))
+        return
+
+    if args.feed:
+        passthrough = ["--feed"]
+        if args.batch_size is not None:
+            passthrough.append(f"--batch-size={args.batch_size}")
+        result = _run_child(passthrough, _PRIMARY_TIMEOUT_S)
+        if result is None or "_error" in result:
+            primary_error = (result or {}).get("_error", "no JSON from child")
+            result = _run_child(passthrough + ["--_force-cpu"],
+                                _FALLBACK_TIMEOUT_S)
+            if result is None or "_error" in result:
+                result = {  # same structured stub shape as _bench_one
+                    "metric": "feed_compute_overlap_efficiency",
+                    "value": 0.0, "unit": "fraction", "vs_baseline": 0.0,
+                    "error": primary_error,
+                    "fallback_error": (result or {}).get(
+                        "_error", "no JSON from child"),
+                }
+        print(json.dumps(result))
+        return
+
+    if args.model is not None:
+        print(json.dumps(_bench_one(args.model, args)))
+        return
+
+    # Headline run (driver invokes with no args): BOTH halves of
+    # BASELINE.json::metric — "ResNet-50 images/sec/chip; Criteo wide&deep
+    # steps/sec" — in the ONE json line, wide_deep under "secondary".
+    result = _bench_one("resnet50", args)
+    result["secondary"] = _bench_one("wide_deep", args)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
