@@ -9,6 +9,47 @@ from __future__ import annotations
 
 import numpy as np
 
+#: Tables at or below this many bytes are all-gathered before the lookup
+#: (one weight-sized collective, the same one ZeRO issues for every layer);
+#: bigger tables keep the sharded gather, where replication would not fit.
+EMBED_REPLICATE_MAX_BYTES = 256 * 1024 * 1024
+
+
+def embedding_lookup(table, ids):
+    """``table[ids]`` for a possibly vocab/embed-sharded embedding table.
+
+    A plain ``jnp.take`` on a table sharded over ``tp``/``fsdp`` makes SPMD
+    reshard the gather output from table-derived to batch/sequence sharding,
+    which the partitioner can only do by *involuntary full rematerialization*
+    (replicate, then re-partition — the warning captured in
+    ``MULTICHIP_r02.json``).  Constraining the table to be replicated *as an
+    activation* first makes the gather partition over the (batch, seq)-sharded
+    indices instead: storage stays ZeRO-sharded, XLA inserts one all-gather of
+    the table — the identical collective fsdp already issues per weight — and
+    the output lands directly in batch/sequence layout.
+
+    Tables larger than ``EMBED_REPLICATE_MAX_BYTES`` (e.g. wide&deep's fused
+    86M-row table) skip the constraint: replicating them per step would blow
+    HBM, and their lookups stay sharded gathers.
+
+    Needs the concrete mesh at trace time; the compiled-step wrappers
+    (``parallel.train._MeshBoundFn``) provide it via
+    ``mesh_lib.get_active_mesh()``.  Without an active mesh this is exactly
+    ``jnp.take(table, ids, axis=0)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.get_active_mesh()
+    nbytes = int(np.prod(table.shape)) * table.dtype.itemsize
+    if mesh is not None and nbytes <= EMBED_REPLICATE_MAX_BYTES:
+        table = jax.lax.with_sharding_constraint(
+            table, mesh_lib.replicated(mesh)
+        )
+    return jnp.take(table, ids, axis=0)
+
 
 def make_classification_loss_fn(module):
     """``loss(params, batch) -> scalar``: softmax cross-entropy in float32

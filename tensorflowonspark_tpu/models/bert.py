@@ -21,6 +21,8 @@ import math
 
 import numpy as np
 
+from tensorflowonspark_tpu.models import _common
+
 
 @dataclasses.dataclass(frozen=True)
 class Config:
@@ -136,9 +138,9 @@ def make_model(config: Config, mesh=None):
                 (config.type_vocab, config.hidden), jnp.float32,
             )
             s = input_ids.shape[1]
-            x = (jnp.take(tok, input_ids, axis=0)
+            x = (_common.embedding_lookup(tok, input_ids)
                  + pos[None, :s]
-                 + jnp.take(typ, token_type_ids, axis=0))
+                 + _common.embedding_lookup(typ, token_type_ids))
             x = nn.LayerNorm(dtype=jnp.float32, name="ln_embed")(x).astype(dtype)
             mask = attention_mask.astype(bool)
             block = Block

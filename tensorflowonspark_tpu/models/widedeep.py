@@ -23,6 +23,8 @@ import dataclasses
 
 import numpy as np
 
+from tensorflowonspark_tpu.models import _common
+
 NUM_DENSE = 13
 NUM_CAT = 26
 
@@ -74,8 +76,8 @@ def make_model(config: Config, mesh=None):
                 dtype,
             )
 
-            wide_logit = jnp.take(wide_table, ids, axis=0).sum(axis=1)  # (B,)
-            emb = jnp.take(deep_table, ids, axis=0)  # (B, 26, E)
+            wide_logit = _common.embedding_lookup(wide_table, ids).sum(axis=1)  # (B,)
+            emb = _common.embedding_lookup(deep_table, ids)  # (B, 26, E)
             x = jnp.concatenate(
                 [emb.reshape(emb.shape[0], -1),
                  jnp.log1p(jnp.maximum(dense, 0.0)).astype(dtype)],

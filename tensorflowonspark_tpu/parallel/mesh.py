@@ -21,9 +21,11 @@ no NCCL, no gRPC tensor plane.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
 import math
+import threading
 from typing import Any, Sequence
 
 logger = logging.getLogger(__name__)
@@ -93,6 +95,34 @@ def build_mesh(config: MeshConfig | None = None, devices: Sequence[Any] | None =
     except Exception:
         dev_array = np.asarray(list(devices)).reshape(shape)
     return jax.sharding.Mesh(dev_array, AXES)
+
+
+# -- active mesh -------------------------------------------------------------
+
+# Mesh visible to model code at trace time.  Models are mesh-agnostic (flax
+# logical axes), but a few ops need a concrete mesh to place a
+# ``with_sharding_constraint`` — e.g. the embedding gather, where letting SPMD
+# infer the reshard triggers an involuntary full rematerialization (see
+# ``models._common.embedding_lookup``).  ``jax.sharding.get_abstract_mesh()``
+# is empty under plain ``jax.jit`` with NamedSharding in_shardings, so the
+# compiled-step wrappers in ``parallel.train`` enter this context instead.
+_ACTIVE = threading.local()
+
+
+@contextlib.contextmanager
+def active_mesh(mesh):
+    """Make ``mesh`` visible to :func:`get_active_mesh` for the duration."""
+    prev = getattr(_ACTIVE, "mesh", None)
+    _ACTIVE.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _ACTIVE.mesh = prev
+
+
+def get_active_mesh():
+    """The mesh bound by :func:`active_mesh`, or ``None``."""
+    return getattr(_ACTIVE, "mesh", None)
 
 
 # -- sharding helpers --------------------------------------------------------

@@ -170,6 +170,30 @@ def apply_zero_sharding(param_shardings, mesh, params, min_size: int = 1 << 16):
     )
 
 
+class _MeshBoundFn:
+    """A jitted fn that traces/runs with its mesh entered as the active mesh
+    (``mesh_lib.active_mesh``), so model code can place mesh-aware sharding
+    constraints (e.g. ``models._common.embedding_lookup``).  Forwards
+    ``lower``/attribute access to the underlying jitted callable so AOT
+    compilation (``bench.py``) keeps working.
+    """
+
+    def __init__(self, jitted, mesh):
+        self._jitted = jitted
+        self._mesh = mesh
+
+    def __call__(self, *args, **kwargs):
+        with mesh_lib.active_mesh(self._mesh):
+            return self._jitted(*args, **kwargs)
+
+    def lower(self, *args, **kwargs):
+        with mesh_lib.active_mesh(self._mesh):
+            return self._jitted.lower(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._jitted, name)
+
+
 def make_train_step(
     loss_fn: Callable[[Any, Any], Any],
     optimizer,
@@ -216,11 +240,14 @@ def make_train_step(
         params = optax.apply_updates(st.params, updates)
         return TrainState(params, opt_state, st.step + 1, new_cols), loss
 
-    return jax.jit(
-        _step,
-        in_shardings=(shardings, batch_shardings),
-        out_shardings=(shardings, mesh_lib.replicated(mesh)),
-        donate_argnums=(0,) if donate else (),
+    return _MeshBoundFn(
+        jax.jit(
+            _step,
+            in_shardings=(shardings, batch_shardings),
+            out_shardings=(shardings, mesh_lib.replicated(mesh)),
+            donate_argnums=(0,) if donate else (),
+        ),
+        mesh,
     )
 
 
@@ -245,11 +272,17 @@ def make_eval_step(forward_fn, mesh, param_shardings, batch_example,
         col_shardings = jax.tree_util.tree_map(
             lambda _: mesh_lib.replicated(mesh), collections or {}
         )
-        return jax.jit(
-            forward_fn,
-            in_shardings=(param_shardings, col_shardings, batch_shardings),
+        return _MeshBoundFn(
+            jax.jit(
+                forward_fn,
+                in_shardings=(param_shardings, col_shardings, batch_shardings),
+            ),
+            mesh,
         )
-    return jax.jit(
-        forward_fn,
-        in_shardings=(param_shardings, batch_shardings),
+    return _MeshBoundFn(
+        jax.jit(
+            forward_fn,
+            in_shardings=(param_shardings, batch_shardings),
+        ),
+        mesh,
     )

@@ -212,3 +212,52 @@ def test_opt_state_inherits_zero_sharding_from_host_params():
     assert kernel_shards, "no kernel-shaped opt leaves found"
     for s in kernel_shards:
         assert s.spec == jax.sharding.PartitionSpec("fsdp"), s.spec
+
+
+def test_mesh_bound_step_exposes_active_mesh():
+    """Compiled steps trace with their mesh active (``mesh_lib.active_mesh``)
+    so mesh-aware model ops (``models._common.embedding_lookup``) can place
+    sharding constraints."""
+    from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+
+    mesh = build_mesh(MeshConfig(dp=8))
+    state, optimizer, shardings, loss_fn, batch = _toy_setup(mesh)
+    seen = []
+
+    def spying_loss(p, b):
+        seen.append(mesh_lib.get_active_mesh())
+        return loss_fn(p, b)
+
+    step = make_train_step(spying_loss, optimizer, mesh, shardings, state, batch)
+    step(state, shard_batch(mesh, batch))
+    assert seen and seen[0] is mesh
+    assert mesh_lib.get_active_mesh() is None  # restored after the call
+
+
+def test_embedding_lookup_constrains_and_matches_take():
+    """``embedding_lookup`` on a vocab×embed-sharded table matches a plain
+    take numerically and emits no awkward table-derived output sharding
+    (the MULTICHIP_r02 involuntary-full-remat repro, fixed)."""
+    from tensorflowonspark_tpu.models import _common
+    from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+
+    mesh = build_mesh(MeshConfig(dp=1, fsdp=2, sp=2, tp=2))
+    rng = np.random.RandomState(0)
+    table_h = rng.randn(8, 16).astype(np.float32)
+    ids_h = rng.randint(0, 8, (8, 64)).astype(np.int32)
+    table = jax.device_put(table_h, mesh_lib.named_sharding(mesh, "tp", "fsdp"))
+    ids = jax.device_put(ids_h, mesh_lib.named_sharding(mesh, ("dp", "fsdp"), "sp"))
+
+    fn = jax.jit(
+        _common.embedding_lookup,
+        in_shardings=(table.sharding, ids.sharding),
+        out_shardings=mesh_lib.named_sharding(mesh, ("dp", "fsdp"), "sp", None),
+    )
+    with mesh_lib.active_mesh(mesh):
+        out = fn(table, ids)
+    np.testing.assert_allclose(np.asarray(out), table_h[ids_h], rtol=0, atol=0)
+    # without an active mesh the helper degrades to a plain take
+    np.testing.assert_allclose(
+        np.asarray(_common.embedding_lookup(jnp.asarray(table_h), jnp.asarray(ids_h))),
+        table_h[ids_h],
+    )
