@@ -81,7 +81,11 @@ def _parse_args(argv=None):
                         "(SURVEY §3.2 hard part (b)) instead of throughput")
     p.add_argument("--_measure", action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--_force-cpu", action="store_true", help=argparse.SUPPRESS)
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.feed and args.model is not None:
+        p.error("--feed measures the resnet50 input pipeline; "
+                "--model is not supported with it")
+    return args
 
 
 def _peak_flops(device_kind: str) -> float | None:
@@ -266,10 +270,7 @@ def measure_feed(args) -> dict:
     util.ensure_jax_platform()
     import jax
 
-    from tensorflowonspark_tpu import readers
     from tensorflowonspark_tpu import models as model_zoo
-    from tensorflowonspark_tpu.models import resnet
-    from tensorflowonspark_tpu.trainer import Trainer
 
     platform = jax.default_backend()
     on_accel = platform in ("tpu", "gpu")
@@ -282,6 +283,23 @@ def measure_feed(args) -> dict:
     n_batches = 12
 
     tmpdir = tempfile.mkdtemp(prefix="tfos_feed_")
+    try:
+        return _measure_feed_body(tmpdir, lib, config, side, batch_size,
+                                  n_batches, platform, on_accel)
+    finally:
+        import shutil
+
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def _measure_feed_body(tmpdir, lib, config, side, batch_size, n_batches,
+                       platform, on_accel) -> dict:
+    import jax
+
+    from tensorflowonspark_tpu import readers
+    from tensorflowonspark_tpu.models import resnet
+    from tensorflowonspark_tpu.trainer import Trainer
+
     files = resnet.write_synthetic_tfrecords(
         tmpdir, batch_size * n_batches, parts=4, side=side)
 
@@ -422,7 +440,9 @@ def main() -> None:
             primary_error = (result or {}).get("_error", "no JSON from child")
             result = _run_child(passthrough + ["--_force-cpu"],
                                 _FALLBACK_TIMEOUT_S)
-            if result is None or "_error" in result:
+            if result is not None and "_error" not in result:
+                result["degraded"] = f"accelerator unavailable: {primary_error}"
+            else:
                 result = {  # same structured stub shape as _bench_one
                     "metric": "feed_compute_overlap_efficiency",
                     "value": 0.0, "unit": "fraction", "vs_baseline": 0.0,

@@ -26,14 +26,14 @@ def train_fun(args, ctx):
 
     util.ensure_jax_platform()
     import numpy as np
-    import optax
 
     from tensorflowonspark_tpu.models import widedeep
     from tensorflowonspark_tpu.trainer import Trainer
 
     config = widedeep.Config.tiny() if args.tiny else widedeep.Config()
-    trainer = Trainer("wide_deep", config=config,
-                      optimizer=optax.adagrad(args.lr))  # CTR-standard opt
+    # no explicit optimizer: the zoo's make_optimizer ships the CTR recipe
+    # (AdaGrad on the tables, AdamW on the MLP — BENCH_NOTES.md)
+    trainer = Trainer("wide_deep", config=config, learning_rate=args.lr)
     feed = ctx.get_data_feed(train_mode=True,
                              input_mapping=["dense", "cat", "label"])
     loss, steps = None, 0

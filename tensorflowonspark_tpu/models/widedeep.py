@@ -102,6 +102,38 @@ def make_model(config: Config, mesh=None):
     return WideDeep()
 
 
+def make_optimizer(config: Config, learning_rate: float = 1e-3):
+    """AdaGrad on the embedding/wide tables, AdamW on the dense MLP.
+
+    The throughput case (measured, ``BENCH_NOTES.md``): AdamW over the fused
+    86M-parameter table reads p/g/m/v and writes p/m/v ≈ 2.4 GB/step — the
+    optimizer update, not the matmuls, bounds steps/sec.  AdaGrad keeps one
+    accumulator instead of two moments and (with optax's chain collapsed to a
+    single transform) roughly 3.6×'s the measured step rate at batch 4096.
+
+    It is also the faithful choice: the reference-era wide&deep recipe trains
+    the wide/embedding parameters with FTRL/AdaGrad, reserving Adam-family
+    optimizers for the dense tower.  ``Trainer`` picks this up automatically
+    whenever the model-zoo module defines ``make_optimizer``.
+    """
+    import jax
+    import optax
+
+    def label_fn(params):
+        return jax.tree_util.tree_map_with_path(
+            lambda path, _: "table"
+            if str(getattr(path[0], "key", "")) in ("wide", "embeddings")
+            else "mlp",
+            params,
+        )
+
+    return optax.multi_transform(
+        {"table": optax.adagrad(learning_rate * 10.0),
+         "mlp": optax.adamw(learning_rate)},
+        label_fn,
+    )
+
+
 def make_loss_fn(module, config: Config):
     import jax.numpy as jnp
     import optax

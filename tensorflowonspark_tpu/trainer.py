@@ -65,7 +65,13 @@ class Trainer:
         self.config = config or self.module_lib.Config.tiny()
         self.mesh = build_mesh(mesh_config, devices=devices)
         self.model = self.module_lib.make_model(self.config, mesh=self.mesh)
-        self.optimizer = optimizer or optax.adamw(learning_rate)
+        if optimizer is None:
+            # a model-zoo module may prescribe its own optimizer recipe
+            # (e.g. widedeep's AdaGrad-on-tables / AdamW-on-MLP split)
+            make_opt = getattr(self.module_lib, "make_optimizer", None)
+            optimizer = (make_opt(self.config, learning_rate) if make_opt
+                         else optax.adamw(learning_rate))
+        self.optimizer = optimizer
         self.sequence_axes = getattr(self.module_lib, "SEQUENCE_AXES", {})
         if self.mesh.shape.get("sp", 1) <= 1:
             self.sequence_axes = {}

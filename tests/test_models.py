@@ -126,3 +126,45 @@ def test_resnet_batchnorm_checkpoint_roundtrip(tmp_path):
     np.testing.assert_allclose(
         np.asarray(t2.predict(batch)), pred_before, rtol=1e-5
     )
+
+
+def test_widedeep_zoo_optimizer_split():
+    """Trainer picks up widedeep's make_optimizer (AdaGrad on the tables,
+    AdamW on the MLP — the measured steps/sec lever, BENCH_NOTES.md) unless
+    an explicit optimizer is passed."""
+    import optax
+
+    from tensorflowonspark_tpu.models import widedeep
+    from tensorflowonspark_tpu.parallel.mesh import MeshConfig
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    import jax
+    import numpy as np
+
+    t = Trainer("wide_deep", mesh_config=MeshConfig(dp=2, fsdp=2, tp=2))
+    # multi_transform state: tables and MLP tracked by separate inner states
+    inner = getattr(t.state.opt_state, "inner_states", None)
+    assert inner is not None and set(inner) == {"table", "mlp"}
+    # the labels must actually LAND on the right params: the AdaGrad inner
+    # state carries real accumulators for wide/embeddings and masked-out
+    # nodes for the MLP (a silent fallthrough to AdamW would pass the key
+    # check above but fail here)
+    real_paths = [
+        tuple(str(getattr(k, "key", k)) for k in path)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            inner["table"]
+        )[0]
+        if isinstance(getattr(leaf, "shape", None), tuple)
+        and getattr(leaf, "size", 0) > 1
+    ]
+    assert any("wide" in p for p in real_paths), real_paths
+    assert any("embeddings" in p for p in real_paths), real_paths
+    assert not any(any(c.startswith("Dense") for c in p)
+                   for p in real_paths), real_paths
+    batch = widedeep.example_batch(widedeep.Config.tiny(), batch_size=16)
+    losses = [float(t.step(batch)) for _ in range(6)]
+    assert losses[-1] < losses[0]
+
+    explicit = optax.sgd(0.1)
+    t2 = Trainer("wide_deep", optimizer=explicit, mesh_config=MeshConfig(dp=8))
+    assert t2.optimizer is explicit
