@@ -30,9 +30,13 @@ def train_fun(args, ctx):
     from tensorflowonspark_tpu.models import widedeep
     from tensorflowonspark_tpu.trainer import Trainer
 
+    import dataclasses
+
     config = widedeep.Config.tiny() if args.tiny else widedeep.Config()
-    # no explicit optimizer: the zoo's make_optimizer ships the CTR recipe
-    # (AdaGrad on the tables, AdamW on the MLP — BENCH_NOTES.md)
+    # --lr drives both towers of the CTR recipe (BENCH_NOTES.md): AdaGrad on
+    # the tables at 10x (the classic wide-vs-deep rate split), AdamW on the
+    # MLP through the Trainer's default optimizer
+    config = dataclasses.replace(config, table_lr=args.lr * 10.0)
     trainer = Trainer("wide_deep", config=config, learning_rate=args.lr)
     feed = ctx.get_data_feed(train_mode=True,
                              input_mapping=["dense", "cat", "label"])
@@ -52,8 +56,18 @@ def train_fun(args, ctx):
     if ctx.job_name == "chief":
         from tensorflowonspark_tpu import compat
 
-        compat.export_saved_model(
-            {"params": trainer.params}, ctx.absolute_path(args.export_dir))
+        export = {"params": trainer.params}
+        serving_cols = {
+            # stateful models (wide&deep's embedding tables, BatchNorm
+            # stats) serve from their collections as much as their params —
+            # but optimizer-state collections (the sparse engine's per-row
+            # accumulators) are dead weight at serving time
+            k: v for k, v in trainer.state.collections.items()
+            if not k.endswith("_opt")
+        }
+        if serving_cols:
+            export["collections"] = serving_cols
+        compat.export_saved_model(export, ctx.absolute_path(args.export_dir))
 
 
 def synth_criteo(n: int, buckets: int, seed: int = 0):

@@ -1,0 +1,77 @@
+"""Sparse embedding engine: update only the rows a step actually touched.
+
+Reference anchor: the reference delegates embedding training to TensorFlow,
+whose sparse path (``tf.nn.embedding_lookup_sparse`` gradients as
+``IndexedSlices``, and on TPU the TPUEmbedding engine) applies optimizer
+updates only to the gathered rows.  An optax-style *dense* update instead
+touches every parameter every step: for wide&deep's fused 86M-parameter
+table that is ~2.4 GB of HBM traffic per step (grad materialization +
+p/m/v read-modify-write), which measured as the steps/sec bound on a v5e
+chip (``BENCH_NOTES.md``).
+
+The TPU-native equivalent here keeps the tables out of the optax parameter
+tree and applies the optimizer with gather/scatter on exactly the looked-up
+ids — O(batch·features·dim) HBM traffic instead of O(vocab·dim).  All ops
+are static-shaped ``.at[].add`` scatters and gathers, so the whole update
+jits into the train step and runs in-place on the donated table buffers.
+
+Duplicate-id semantics (two examples in the batch hit the same row): the
+squared gradients of all duplicates are accumulated FIRST (one scatter-add),
+then every duplicate's update is scaled by the post-accumulation statistic —
+the same "apply the summed slice" convention TF's sparse AdaGrad kernels
+use, and exactly reproducible: see ``tests/test_embedding.py``.
+
+Multi-chip note: tables live replicated (one copy per device, the default
+sharding for non-param collections in ``parallel.train.state_shardings``);
+under ``jit``'s global-view semantics the scatter is a single global op, so
+XLA keeps replicas consistent by combining each data shard's updates.
+Vocab-sharded tables (EP-style, for tables too large for one device's HBM)
+are the designed extension point: shard the ``vocab`` dim of table and
+accumulator alike and the same global-view scatter partitions over it.
+"""
+
+from __future__ import annotations
+
+
+def sparse_adagrad_update(table, acc, ids, grad_rows, lr: float,
+                          eps: float = 1e-10):
+    """One AdaGrad step on only the gathered rows of ``table``.
+
+    ``table``: ``(vocab, *row)`` parameter array; ``acc``: same-shape float32
+    accumulator; ``ids``: integer array of any shape; ``grad_rows``: the loss
+    gradient w.r.t. ``table[ids]``, shape ``ids.shape + row``.
+
+    Returns ``(new_table, new_acc)``.  Rows not in ``ids`` are bit-identical
+    to their inputs — the sparseness contract.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    row_shape = table.shape[1:]
+    flat_ids = ids.reshape(-1)
+    g = grad_rows.reshape((flat_ids.shape[0],) + row_shape).astype(jnp.float32)
+
+    acc = acc.at[flat_ids].add(g * g)
+    # gather AFTER the add: duplicates all see the fully-accumulated value
+    scale = lax.rsqrt(acc[flat_ids] + eps)
+    update = (-lr * g * scale).astype(table.dtype)
+    return table.at[flat_ids].add(update), acc
+
+
+def sparse_sgd_update(table, ids, grad_rows, lr: float, momentum=None):
+    """Plain sparse SGD on the gathered rows (no per-row state).
+
+    Returns ``new_table``.  ``momentum`` is deliberately unsupported —
+    momentum is a *dense* statistic (it decays rows the step never touched),
+    so a sparse variant would silently change the algorithm; use
+    :func:`sparse_adagrad_update` when per-row state is wanted.
+    """
+    import jax.numpy as jnp
+
+    if momentum is not None:
+        raise ValueError("momentum is a dense statistic; sparse SGD "
+                         "supports none (see docstring)")
+    row_shape = table.shape[1:]
+    flat_ids = ids.reshape(-1)
+    g = grad_rows.reshape((flat_ids.shape[0],) + row_shape).astype(jnp.float32)
+    return table.at[flat_ids].add((-lr * g).astype(table.dtype))

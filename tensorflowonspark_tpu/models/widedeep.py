@@ -7,14 +7,26 @@ features + 26 categorical features pre-hashed into per-feature buckets.
 
 TPU-first choices:
 
-- the wide path and each deep embedding lookup are ``table[ids]`` gathers —
-  XLA lowers them to efficient dynamic-gathers in HBM; the tables carry
-  ``("vocab", "embed")`` partitioning so big vocabularies shard over ``tp``
-  (a Pallas one-pass gather-fuse kernel is the planned upgrade for the
-  multi-table lookup once profiling justifies it).
 - all 26 categorical lookups run as ONE stacked gather over a single fused
   table (per-feature offsets added to the ids) instead of 26 small kernels —
   the batched-not-scalar rule of the MXU/HBM playbook.
+- the embedding tables live OUTSIDE the optax parameter tree, in the
+  ``"embedding"`` variable collection, and train with AdaGrad at
+  ``Config.table_lr`` while the dense MLP tower trains through whatever
+  optax optimizer the ``Trainer`` holds (AdamW by default) — the
+  reference-era split (FTRL/AdaGrad on wide+embeddings, Adam-family on
+  the dense tower), which measured 3.6× over AdamW-on-everything
+  (``BENCH_NOTES.md``).
+- the table update strategy is ``Config.table_update``: ``"dense"``
+  (gather-VJP grads + full-table AdaGrad pass) or ``"sparse"`` (the
+  sparse embedding engine, ``tensorflowonspark_tpu/embedding.py`` — only
+  the gathered rows are read/written, the TPUEmbedding-style path).
+  Both were profiled on the bench chip; dense wins there because XLA's
+  scatter lowering serializes (~20 ms per 106k-row scatter), sparse wins
+  wherever scatters are fast — see BENCH_NOTES.md for the numbers.
+- :func:`make_sharded_train_step` is the model-supplied custom step the
+  ``Trainer`` picks up; it composes with the generic machinery through
+  ``parallel.train.compile_step`` (same shardings, donation, active mesh).
 """
 
 from __future__ import annotations
@@ -22,8 +34,6 @@ from __future__ import annotations
 import dataclasses
 
 import numpy as np
-
-from tensorflowonspark_tpu.models import _common
 
 NUM_DENSE = 13
 NUM_CAT = 26
@@ -35,6 +45,14 @@ class Config:
     embed_dim: int = 32
     hidden: tuple = (1024, 512, 256)
     dtype: str = "float32"
+    table_lr: float = 0.01  # AdaGrad rate for wide+embedding tables
+    # "dense": table grads via the gather's VJP, full-table AdaGrad pass —
+    #   measured fastest on chips whose scatter lowering is serialized
+    #   (~20 ms per 106k-row scatter on the bench v5e; BENCH_NOTES.md).
+    # "sparse": embedding.sparse_adagrad_update touches only gathered rows —
+    #   O(batch) HBM traffic, the right mode where scatters are fast
+    #   (CPU; SparseCore-class hardware).
+    table_update: str = "dense"
 
     @classmethod
     def tiny(cls) -> "Config":
@@ -48,6 +66,14 @@ class Config:
 SEQUENCE_AXES: dict = {}
 
 
+def fold_ids(cat, config: Config):
+    """(B, 26) per-feature ids -> (B, 26) global ids into the fused table."""
+    import jax.numpy as jnp
+
+    offsets = jnp.arange(NUM_CAT, dtype=cat.dtype) * config.hash_buckets
+    return cat + offsets[None, :]
+
+
 def make_model(config: Config, mesh=None):
     import flax.linen as nn
     import jax.numpy as jnp
@@ -55,31 +81,47 @@ def make_model(config: Config, mesh=None):
     dtype = jnp.dtype(config.dtype)
 
     class WideDeep(nn.Module):
+        """``__call__(dense, cat)`` gathers internally (init / eval path);
+        the sparse train step passes pre-gathered ``emb_rows``/``wide_rows``
+        so it can take gradients w.r.t. exactly the touched rows."""
+
         @nn.compact
-        def __call__(self, dense, cat):
-            # per-feature offsets fold 26 tables into one fused gather
-            offsets = jnp.arange(NUM_CAT, dtype=cat.dtype) * config.hash_buckets
-            ids = cat + offsets[None, :]  # (B, 26) global ids
-
-            wide_table = self.param(
-                "wide",
-                nn.with_partitioning(nn.initializers.zeros_init(), ("vocab",)),
-                (config.total_buckets,),
-                jnp.float32,
-            )
-            deep_table = self.param(
-                "embeddings",
-                nn.with_partitioning(
-                    nn.initializers.normal(stddev=0.01), ("vocab", "embed")
+        def __call__(self, dense, cat, emb_rows=None, wide_rows=None):
+            deep_table = self.variable(
+                "embedding", "deep",
+                lambda: nn.initializers.normal(stddev=0.01)(
+                    self.make_rng("params"),
+                    (config.total_buckets, config.embed_dim), dtype,
                 ),
-                (config.total_buckets, config.embed_dim),
-                dtype,
             )
+            wide_table = self.variable(
+                "embedding", "wide",
+                lambda: jnp.zeros((config.total_buckets,), jnp.float32),
+            )
+            # per-row AdaGrad accumulators for the sparse engine; created at
+            # init so they ride the same collections/checkpoint machinery,
+            # but NOT required at apply time (a serving export may carry
+            # only params + the embedding tables)
+            if self.is_initializing():
+                self.variable(
+                    "embedding_opt", "deep_acc",
+                    lambda: jnp.zeros(
+                        (config.total_buckets, config.embed_dim),
+                        jnp.float32),
+                )
+                self.variable(
+                    "embedding_opt", "wide_acc",
+                    lambda: jnp.zeros((config.total_buckets,), jnp.float32),
+                )
 
-            wide_logit = _common.embedding_lookup(wide_table, ids).sum(axis=1)  # (B,)
-            emb = _common.embedding_lookup(deep_table, ids)  # (B, 26, E)
+            if emb_rows is None:
+                ids = fold_ids(cat, config)
+                emb_rows = jnp.take(deep_table.value, ids, axis=0)  # (B,26,E)
+                wide_rows = jnp.take(wide_table.value, ids, axis=0)  # (B,26)
+
+            wide_logit = wide_rows.sum(axis=1)  # (B,)
             x = jnp.concatenate(
-                [emb.reshape(emb.shape[0], -1),
+                [emb_rows.reshape(emb_rows.shape[0], -1).astype(dtype),
                  jnp.log1p(jnp.maximum(dense, 0.0)).astype(dtype)],
                 axis=-1,
             )
@@ -102,61 +144,140 @@ def make_model(config: Config, mesh=None):
     return WideDeep()
 
 
-def make_optimizer(config: Config, learning_rate: float = 1e-3):
-    """AdaGrad on the embedding/wide tables, AdamW on the dense MLP.
-
-    The throughput case (measured, ``BENCH_NOTES.md``): AdamW over the fused
-    86M-parameter table reads p/g/m/v and writes p/m/v ≈ 2.4 GB/step — the
-    optimizer update, not the matmuls, bounds steps/sec.  AdaGrad keeps one
-    accumulator instead of two moments and (with optax's chain collapsed to a
-    single transform) roughly 3.6×'s the measured step rate at batch 4096.
-
-    It is also the faithful choice: the reference-era wide&deep recipe trains
-    the wide/embedding parameters with FTRL/AdaGrad, reserving Adam-family
-    optimizers for the dense tower.  ``Trainer`` picks this up automatically
-    whenever the model-zoo module defines ``make_optimizer``.
-    """
-    import jax
-    import optax
-
-    def label_fn(params):
-        return jax.tree_util.tree_map_with_path(
-            lambda path, _: "table"
-            if str(getattr(path[0], "key", "")) in ("wide", "embeddings")
-            else "mlp",
-            params,
-        )
-
-    return optax.multi_transform(
-        {"table": optax.adagrad(learning_rate * 10.0),
-         "mlp": optax.adamw(learning_rate)},
-        label_fn,
+def _apply(module, params, collections, batch, **rows):
+    return module.apply(
+        {"params": params, **collections},
+        batch["dense"], batch["cat"], **rows,
     )
 
 
 def make_loss_fn(module, config: Config):
+    """Stateful loss for the GENERIC step path: reads the tables from the
+    collections and returns them unchanged.  Note the generic optax path
+    does not train the tables — table updates are the sparse step's job
+    (:func:`make_sharded_train_step`, which the ``Trainer`` prefers
+    automatically); this loss exists for API parity and eval-style use.
+    """
     import jax.numpy as jnp
     import optax
 
-    def loss_fn(params, batch):
-        logit = module.apply({"params": params}, batch["dense"], batch["cat"])
-        return jnp.mean(
+    def loss_fn(params, collections, batch):
+        logit = _apply(module, params, collections, batch)
+        loss = jnp.mean(
             optax.sigmoid_binary_cross_entropy(
                 logit.astype(jnp.float32), batch["label"].astype(jnp.float32)
             )
         )
+        return loss, collections
 
+    loss_fn.stateful = True
+    # flag for parallel.train.make_train_step: training through the generic
+    # optax path would leave the collection-resident tables frozen
+    loss_fn.tables_frozen = True
     return loss_fn
 
 
 def make_forward_fn(module, config: Config):
     import jax
 
-    def forward(params, batch):
-        logit = module.apply({"params": params}, batch["dense"], batch["cat"])
-        return jax.nn.sigmoid(logit)
+    def forward(params, collections, batch):
+        return jax.nn.sigmoid(_apply(module, params, collections, batch))
 
+    forward.stateful = True
     return forward
+
+
+def make_sharded_train_step(module, config: Config, optimizer, mesh,
+                            param_shardings, state, batch_example,
+                            sequence_axes=None):
+    """The model-supplied train step the ``Trainer`` picks up.
+
+    MLP tower: ``optimizer`` (optax) over ``state.params``.  Tables: AdaGrad
+    at ``config.table_lr``, either ``"dense"`` (gather-VJP grad + full-table
+    pass) or ``"sparse"`` (``embedding.sparse_adagrad_update`` on only the
+    gathered rows) per ``config.table_update`` — see the module docstring
+    for the measured tradeoff.  Compiled through the same
+    ``parallel.train.compile_step`` as the generic path (shardings, buffer
+    donation — the table updates land in the donated buffers in place —
+    and the active-mesh binding).
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tensorflowonspark_tpu import embedding
+    from tensorflowonspark_tpu.parallel import train as train_lib
+
+    if config.table_update not in ("dense", "sparse"):
+        raise ValueError(f"table_update must be dense|sparse, "
+                         f"got {config.table_update!r}")
+    sparse = config.table_update == "sparse"
+
+    def _bce(logit, labels):
+        return jnp.mean(
+            optax.sigmoid_binary_cross_entropy(
+                logit.astype(jnp.float32), labels.astype(jnp.float32)
+            )
+        )
+
+    def _dense_adagrad(table, acc, g, eps=1e-10):
+        """Full-table AdaGrad pass; untouched rows see g == 0 and are
+        unchanged, so the sparseness contract still holds bit-wise."""
+        g = g.astype(jnp.float32)
+        acc = acc + g * g
+        update = (-config.table_lr * g * jax.lax.rsqrt(acc + eps))
+        return table + update.astype(table.dtype), acc
+
+    def _step(st, batch):
+        emb = st.collections["embedding"]
+        acc = st.collections["embedding_opt"]
+        ids = fold_ids(batch["cat"], config)
+
+        if sparse:
+            deep_rows = jnp.take(emb["deep"], ids, axis=0)
+            wide_rows = jnp.take(emb["wide"], ids, axis=0)
+
+            def loss_of(params, dr, wr):
+                logit = _apply(module, params, st.collections, batch,
+                               emb_rows=dr, wide_rows=wr)
+                return _bce(logit, batch["label"])
+
+            loss, (g_p, g_dr, g_wr) = jax.value_and_grad(
+                loss_of, argnums=(0, 1, 2)
+            )(st.params, deep_rows, wide_rows)
+            new_deep, new_dacc = embedding.sparse_adagrad_update(
+                emb["deep"], acc["deep_acc"], ids, g_dr, config.table_lr)
+            new_wide, new_wacc = embedding.sparse_adagrad_update(
+                emb["wide"], acc["wide_acc"], ids, g_wr, config.table_lr)
+        else:
+            def loss_of(params, deep, wide):
+                dr = jnp.take(deep, ids, axis=0)
+                wr = jnp.take(wide, ids, axis=0)
+                logit = _apply(module, params, st.collections, batch,
+                               emb_rows=dr, wide_rows=wr)
+                return _bce(logit, batch["label"])
+
+            loss, (g_p, g_deep, g_wide) = jax.value_and_grad(
+                loss_of, argnums=(0, 1, 2)
+            )(st.params, emb["deep"], emb["wide"])
+            new_deep, new_dacc = _dense_adagrad(
+                emb["deep"], acc["deep_acc"], g_deep)
+            new_wide, new_wacc = _dense_adagrad(
+                emb["wide"], acc["wide_acc"], g_wide)
+
+        updates, opt_state = optimizer.update(g_p, st.opt_state, st.params)
+        params = optax.apply_updates(st.params, updates)
+
+        cols = {"embedding": {"deep": new_deep, "wide": new_wide},
+                "embedding_opt": {"deep_acc": new_dacc,
+                                  "wide_acc": new_wacc}}
+        return train_lib.TrainState(params, opt_state, st.step + 1,
+                                    cols), loss
+
+    return train_lib.compile_step(
+        _step, mesh, param_shardings, state, batch_example,
+        sequence_axes=sequence_axes,
+    )
 
 
 def example_batch(config: Config, batch_size: int = 8, seed: int = 0):

@@ -10,6 +10,10 @@ XLA collectives emitted by ``pjit``/``shard_map`` over a
 from tensorflowonspark_tpu.parallel.distributed import (  # noqa: F401
     maybe_initialize,
 )
+from tensorflowonspark_tpu.parallel.pipeline_parallel import (  # noqa: F401
+    pipeline_apply,
+    stack_stage_params,
+)
 from tensorflowonspark_tpu.parallel.mesh import (  # noqa: F401
     AXES,
     MeshConfig,
@@ -26,6 +30,7 @@ from tensorflowonspark_tpu.parallel.mesh import (  # noqa: F401
 from tensorflowonspark_tpu.parallel.train import (  # noqa: F401
     TrainState,
     apply_zero_sharding,
+    compile_step,
     create_train_state,
     make_eval_step,
     make_train_step,

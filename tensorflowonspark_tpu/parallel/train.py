@@ -194,6 +194,53 @@ class _MeshBoundFn:
         return getattr(self._jitted, name)
 
 
+def compile_step(
+    step_fn: Callable[[TrainState, Any], Any],
+    mesh,
+    param_shardings,
+    state: TrainState,
+    batch_example: Any,
+    sequence_axes: dict[str, int] | None = None,
+    donate: bool = True,
+):
+    """Jit an arbitrary ``state, batch -> state, loss`` step over the mesh.
+
+    Computes the full train-state shardings (params + optimizer state +
+    collections) and batch shardings, jits with buffer donation, and binds
+    the mesh as the active mesh at trace/run time (:class:`_MeshBoundFn`).
+    This is the shared lower half of :func:`make_train_step`; model-zoo
+    modules with a custom step (e.g. wide&deep's sparse embedding update,
+    ``models/widedeep.py::make_sharded_train_step``) call it directly.
+    """
+    import jax
+
+    shardings = state_shardings(state, param_shardings, mesh)
+    batch_shardings = _batch_shardings(mesh, batch_example, sequence_axes)
+
+    return _MeshBoundFn(
+        jax.jit(
+            step_fn,
+            in_shardings=(shardings, batch_shardings),
+            out_shardings=(shardings, mesh_lib.replicated(mesh)),
+            donate_argnums=(0,) if donate else (),
+        ),
+        mesh,
+    )
+
+
+def _batch_shardings(mesh, batch_example, sequence_axes=None):
+    """Per-leaf batch shardings: axis 0 over (dp, fsdp), named sequence
+    axes over sp (one rule for the train and eval compile paths)."""
+    import jax
+
+    def _one(leaf_path, leaf):
+        name = leaf_path[-1].key if leaf_path and hasattr(leaf_path[-1], "key") else None
+        sa = (sequence_axes or {}).get(name)
+        return mesh_lib.batch_sharding(mesh, getattr(leaf, "ndim", 0), sa)
+
+    return jax.tree_util.tree_map_with_path(_one, batch_example)
+
+
 def make_train_step(
     loss_fn: Callable[[Any, Any], Any],
     optimizer,
@@ -217,14 +264,14 @@ def make_train_step(
     import jax
 
     stateful = bool(getattr(loss_fn, "stateful", False))
-    shardings = state_shardings(state, param_shardings, mesh)
-
-    def _batch_sharding(leaf_path, leaf):
-        name = leaf_path[-1].key if leaf_path and hasattr(leaf_path[-1], "key") else None
-        sa = (sequence_axes or {}).get(name)
-        return mesh_lib.batch_sharding(mesh, getattr(leaf, "ndim", 0), sa)
-
-    batch_shardings = jax.tree_util.tree_map_with_path(_batch_sharding, batch_example)
+    if getattr(loss_fn, "tables_frozen", False):
+        logger.warning(
+            "loss_fn marks its embedding tables as collection-resident "
+            "(tables_frozen): the generic optax step will train only the "
+            "dense params and leave the tables at their initial values. "
+            "Use the model's make_sharded_train_step (the Trainer picks it "
+            "up automatically) to train the tables."
+        )
 
     def _step(st: TrainState, batch):
         if stateful:
@@ -240,15 +287,8 @@ def make_train_step(
         params = optax.apply_updates(st.params, updates)
         return TrainState(params, opt_state, st.step + 1, new_cols), loss
 
-    return _MeshBoundFn(
-        jax.jit(
-            _step,
-            in_shardings=(shardings, batch_shardings),
-            out_shardings=(shardings, mesh_lib.replicated(mesh)),
-            donate_argnums=(0,) if donate else (),
-        ),
-        mesh,
-    )
+    return compile_step(_step, mesh, param_shardings, state, batch_example,
+                        sequence_axes=sequence_axes, donate=donate)
 
 
 def make_eval_step(forward_fn, mesh, param_shardings, batch_example,
@@ -262,12 +302,7 @@ def make_eval_step(forward_fn, mesh, param_shardings, batch_example,
     """
     import jax
 
-    def _batch_sharding(leaf_path, leaf):
-        name = leaf_path[-1].key if leaf_path and hasattr(leaf_path[-1], "key") else None
-        sa = (sequence_axes or {}).get(name)
-        return mesh_lib.batch_sharding(mesh, getattr(leaf, "ndim", 0), sa)
-
-    batch_shardings = jax.tree_util.tree_map_with_path(_batch_sharding, batch_example)
+    batch_shardings = _batch_shardings(mesh, batch_example, sequence_axes)
     if getattr(forward_fn, "stateful", False):
         col_shardings = jax.tree_util.tree_map(
             lambda _: mesh_lib.replicated(mesh), collections or {}

@@ -120,10 +120,20 @@ class Trainer:
         self._step_callbacks: list = []
         self._last_step_t: float | None = None
 
-        self.train_step = make_train_step(
-            self.loss_fn, self.optimizer, self.mesh, self.param_shardings,
-            self.state, example, sequence_axes=self.sequence_axes,
-        )
+        # a model-zoo module may supply its own sharded step (e.g. wide&deep's
+        # sparse embedding update); it composes via parallel.train.compile_step
+        make_custom = getattr(self.module_lib, "make_sharded_train_step", None)
+        if make_custom is not None:
+            self.train_step = make_custom(
+                self.model, self.config, self.optimizer, self.mesh,
+                self.param_shardings, self.state, example,
+                sequence_axes=self.sequence_axes,
+            )
+        else:
+            self.train_step = make_train_step(
+                self.loss_fn, self.optimizer, self.mesh, self.param_shardings,
+                self.state, example, sequence_axes=self.sequence_axes,
+            )
         self.eval_step = make_eval_step(
             self.forward_fn, self.mesh, self.param_shardings,
             example, sequence_axes=self.sequence_axes,

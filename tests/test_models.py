@@ -128,42 +128,50 @@ def test_resnet_batchnorm_checkpoint_roundtrip(tmp_path):
     )
 
 
-def test_widedeep_zoo_optimizer_split():
-    """Trainer picks up widedeep's make_optimizer (AdaGrad on the tables,
-    AdamW on the MLP — the measured steps/sec lever, BENCH_NOTES.md) unless
-    an explicit optimizer is passed."""
+@pytest.mark.parametrize("table_update", ["dense", "sparse"])
+def test_widedeep_embedding_step(table_update):
+    """Trainer picks up widedeep's custom step in both table-update modes:
+    tables live in the 'embedding' collection (NOT the optax param tree),
+    only the gathered rows change per step (bit-wise, in both modes), and
+    the MLP trains through the optax optimizer (AdamW default / explicit
+    override respected)."""
+    import dataclasses
+
+    import jax
     import optax
 
     from tensorflowonspark_tpu.models import widedeep
     from tensorflowonspark_tpu.parallel.mesh import MeshConfig
     from tensorflowonspark_tpu.trainer import Trainer
 
-    import jax
-    import numpy as np
+    t = Trainer(
+        "wide_deep",
+        config=dataclasses.replace(widedeep.Config.tiny(),
+                                   table_update=table_update),
+        mesh_config=MeshConfig(dp=2, fsdp=2, tp=2),
+    )
+    # tables are out of the param/optax tree entirely
+    assert set(t.state.collections) == {"embedding", "embedding_opt"}
+    assert not any("embedding" in str(p) for p, _ in
+                   jax.tree_util.tree_flatten_with_path(t.state.params)[0])
 
-    t = Trainer("wide_deep", mesh_config=MeshConfig(dp=2, fsdp=2, tp=2))
-    # multi_transform state: tables and MLP tracked by separate inner states
-    inner = getattr(t.state.opt_state, "inner_states", None)
-    assert inner is not None and set(inner) == {"table", "mlp"}
-    # the labels must actually LAND on the right params: the AdaGrad inner
-    # state carries real accumulators for wide/embeddings and masked-out
-    # nodes for the MLP (a silent fallthrough to AdamW would pass the key
-    # check above but fail here)
-    real_paths = [
-        tuple(str(getattr(k, "key", k)) for k in path)
-        for path, leaf in jax.tree_util.tree_flatten_with_path(
-            inner["table"]
-        )[0]
-        if isinstance(getattr(leaf, "shape", None), tuple)
-        and getattr(leaf, "size", 0) > 1
-    ]
-    assert any("wide" in p for p in real_paths), real_paths
-    assert any("embeddings" in p for p in real_paths), real_paths
-    assert not any(any(c.startswith("Dense") for c in p)
-                   for p in real_paths), real_paths
-    batch = widedeep.example_batch(widedeep.Config.tiny(), batch_size=16)
+    cfg = widedeep.Config.tiny()
+    before = np.asarray(t.state.collections["embedding"]["deep"])
+    batch = widedeep.example_batch(cfg, batch_size=16)
     losses = [float(t.step(batch)) for _ in range(6)]
     assert losses[-1] < losses[0]
+
+    # sparseness contract: rows never gathered are bit-identical
+    after = np.asarray(t.state.collections["embedding"]["deep"])
+    ids = np.asarray(widedeep.fold_ids(
+        jax.numpy.asarray(batch["cat"]), cfg)).reshape(-1)
+    untouched = np.setdiff1d(np.arange(cfg.total_buckets), ids)
+    assert untouched.size > 0
+    np.testing.assert_array_equal(after[untouched], before[untouched])
+    assert not np.array_equal(after[ids[0]], before[ids[0]])
+    # touched rows accumulated AdaGrad state
+    acc = np.asarray(t.state.collections["embedding_opt"]["deep_acc"])
+    assert (acc[ids] > 0).any() and (acc[untouched] == 0).all()
 
     explicit = optax.sgd(0.1)
     t2 = Trainer("wide_deep", optimizer=explicit, mesh_config=MeshConfig(dp=8))
