@@ -12,7 +12,8 @@ axes carry
 - ``fsdp``— ZeRO-style parameter/optimizer sharding (the ``num_ps`` mapping),
 - ``tp``  — tensor parallelism (feature axes of large matmuls),
 - ``sp``  — sequence/context parallelism (ring attention over ICI),
-- ``pp``  — pipeline parallelism (stage axis).
+- ``pp``  — pipeline parallelism (GPipe microbatch schedule over stacked
+  stage params — ``parallel/pipeline_parallel.py``).
 
 ``pjit``/``jax.jit`` with ``NamedSharding`` then emit the collectives
 (``psum``/``all_gather``/``reduce_scatter``/``ppermute``) over ICI/DCN —
@@ -197,7 +198,7 @@ DEFAULT_RULES: tuple[tuple[str, Any], ...] = (
     ("vocab", "tp"),
     ("classes", None),
     ("conv_kernel", None),
-    ("stage", "pp"),
+    ("stage", "pp"),       # stacked pipeline-stage dim (pipeline_parallel.py)
 )
 
 
