@@ -212,3 +212,181 @@ class _LoadPartition:
         for path in iterator:
             for payload in tfrecord.read_records(path):
                 yield fromTFExample(payload, self.binary_features, self.backend)
+
+
+# ---------------------------------------------------------------------------
+# Parquet (Arrow columnar) save / load
+# ---------------------------------------------------------------------------
+
+_ARROW_TYPES = {
+    "tinyint": "int8", "smallint": "int16", "int": "int32",
+    "bigint": "int64", "long": "int64", "boolean": "bool_",
+    "float": "float32", "double": "float64",
+    "string": "string", "binary": "binary",
+}
+
+
+def _arrow_schema(dtypes: list[tuple[str, str]]):
+    """Spark simpleString dtypes → pyarrow schema."""
+    import pyarrow as pa
+
+    fields = []
+    for name, dt in dtypes:
+        dt = str(dt)
+        elem = dt[6:-1] if dt.startswith("array<") else dt
+        if elem.startswith("decimal"):
+            elem = "double"
+        try:
+            typ = getattr(pa, _ARROW_TYPES[elem])()
+        except KeyError:
+            raise TypeError(f"column {name!r}: unsupported dtype {dt!r}")
+        if dt.startswith("array<"):
+            typ = pa.list_(typ)
+        fields.append(pa.field(name, typ))
+    return pa.schema(fields)
+
+
+def _parquet_fields(schema) -> list[tuple[str, str]]:
+    """pyarrow schema → [(name, simpleString)] (inverse of _arrow_schema)."""
+    import pyarrow as pa
+
+    back: dict[str, str] = {}
+    for simple, attr in _ARROW_TYPES.items():
+        # first writer wins: canonical simpleString for aliased types
+        # (int64 → "bigint", not "long")
+        back.setdefault(str(getattr(pa, attr)()), simple)
+    fields = []
+    for f in schema:
+        typ, wrap = f.type, False
+        if pa.types.is_list(typ) or pa.types.is_large_list(typ):
+            typ, wrap = typ.value_type, True
+        name = back.get(str(typ))
+        if name is None:
+            raise TypeError(f"column {f.name!r}: unsupported Parquet type "
+                            f"{f.type}")
+        fields.append((f.name, f"array<{name}>" if wrap else name))
+    return fields
+
+
+def saveAsParquet(df, output_dir: str) -> None:
+    """Write ``df`` as Parquet, one ``part-r-NNNNN.parquet`` per partition.
+
+    The Arrow-columnar sibling of :func:`saveAsTFRecords` (``SURVEY.md
+    §2.2``: "columnar (Arrow/Parquet)→HBM path, the idiomatic 2026
+    choice") — pairs with :func:`tensorflowonspark_tpu.readers.
+    parquet_batches` for row-loop-free training input.  Same shared-
+    filesystem requirement as :func:`saveAsTFRecords`.
+    """
+    fs.makedirs(output_dir)
+    dtypes = [(name, str(dt)) for name, dt in df.dtypes]
+    df.rdd.mapPartitionsWithIndex(
+        _SaveParquetPartition(output_dir, dtypes)
+    ).count()
+    logger.info("saved Parquet to %s", output_dir)
+
+
+class _SaveParquetPartition:
+    #: rows buffered per Arrow batch — streams like the TFRecord sibling
+    #: instead of materializing the whole partition in Python lists
+    CHUNK_ROWS = 4096
+
+    def __init__(self, output_dir: str, dtypes: list[tuple[str, str]]):
+        self.output_dir = output_dir
+        self.dtypes = dtypes
+
+    def __call__(self, pindex: int, iterator):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        schema = _arrow_schema(self.dtypes)
+        index = {name: i for i, (name, _) in enumerate(self.dtypes)}
+        # decimal columns carry decimal.Decimal objects pyarrow won't
+        # coerce to float64 — convert while accumulating
+        decimal_cols = {
+            name for name, dt in self.dtypes
+            if (dt[6:-1] if dt.startswith("array<") else dt)
+            .startswith("decimal")
+        }
+
+        def _cell(row, name, by_position):
+            v = row[index[name]] if by_position else row[name]
+            if name in decimal_cols and v is not None:
+                return ([float(e) for e in v] if isinstance(v, (list, tuple))
+                        else float(v))
+            return v
+
+        path = fs.join(self.output_dir, f"part-r-{pindex:05d}.parquet")
+        local = fs.local_path(path)
+        sink = local if local is not None else fs.open(path, "wb")
+        total = 0
+        try:
+            with pq.ParquetWriter(sink, schema) as writer:
+                columns: dict[str, list] = {n: [] for n, _ in self.dtypes}
+                for row in iterator:
+                    by_position = isinstance(row, (list, tuple))
+                    for name, _ in self.dtypes:
+                        columns[name].append(_cell(row, name, by_position))
+                    total += 1
+                    if total % self.CHUNK_ROWS == 0:
+                        writer.write_batch(
+                            pa.record_batch(columns, schema=schema))
+                        columns = {n: [] for n, _ in self.dtypes}
+                if next(iter(columns.values()), []):
+                    writer.write_batch(
+                        pa.record_batch(columns, schema=schema))
+        finally:
+            if local is None:
+                sink.close()
+        yield total
+
+
+def loadParquet(sc, input_dir: str):
+    """Load a Parquet directory back into a DataFrame (schema from the
+    Parquet footer — no record sampling needed, unlike TFRecords)."""
+    import pyarrow.parquet as pq
+
+    from tensorflowonspark_tpu import sql_compat
+
+    backend = sql_compat.backend_of(sc)
+    files = sorted(
+        fs.join(input_dir, f)
+        for f in fs.listdir(input_dir)
+        if f.endswith(".parquet")
+    )
+    if not files:
+        raise FileNotFoundError(f"no .parquet part files in {input_dir}")
+    local = fs.local_path(files[0])
+    if local is not None:
+        schema = pq.read_schema(local)
+    else:
+        with fs.open(files[0], "rb") as f:
+            schema = pq.read_schema(f)
+    fields = _parquet_fields(schema)
+    rows = sc.parallelize(files, len(files)).mapPartitions(
+        _LoadParquetPartition(fields, backend)
+    )
+    return sql_compat.create_dataframe(rows, fields, backend)
+
+
+class _LoadParquetPartition:
+    def __init__(self, fields: list[tuple[str, str]], backend="sparkapi"):
+        self.fields = fields
+        self.backend = backend
+
+    def __call__(self, iterator):
+        import pyarrow.parquet as pq
+
+        from tensorflowonspark_tpu import sql_compat
+
+        names = [name for name, _ in self.fields]
+        for path in iterator:
+            local = fs.local_path(path)
+            if local is not None:
+                table = pq.read_table(local)
+            else:
+                with fs.open(path, "rb") as f:
+                    table = pq.read_table(f)
+            for record in table.to_pylist():
+                yield sql_compat.make_row(
+                    names, [record[n] for n in names], self.backend
+                )

@@ -1,5 +1,5 @@
 """InputMode.TENSORFLOW input pipeline: sharded, parallel, prefetched
-TFRecord reading.
+TFRecord and Parquet (Arrow columnar) reading.
 
 Reference anchor: in the reference this layer *is* ``tf.data`` —
 ``TFRecordDataset(files).shard(num_workers, task_index).shuffle(...).
@@ -18,6 +18,10 @@ threads + queues over :mod:`tensorflowonspark_tpu.tfrecord`:
   HBM) in a pipeline thread ``prefetch`` batches ahead of the consumer, so
   step time approaches ``max(compute, feed)`` instead of their sum
   (``SURVEY.md §3.2`` perf-critical path / hard part (b)).
+
+:func:`parquet_batches` is the Arrow-columnar sibling (``SURVEY.md §2.2``):
+row groups decode straight to column buffers — no per-row hot loop at all —
+through the same prefetch/``device_put`` machinery.
 
 Everything is pull-based and bounded; no unbounded buffering.
 """
@@ -210,20 +214,40 @@ def tfrecord_batches(
             if rows and not drop_remainder:
                 yield _stage(_columnarize(rows))
 
-    def _stage(batch: dict[str, Any]) -> dict[str, Any]:
-        if callable(device_put):
-            # custom staging (e.g. Trainer.shard: device_put with the mesh
-            # shardings) runs in the pipeline thread, overlapping H2D with
-            # compute
-            return device_put(batch)
-        if device_put:
+    _stage = _stager(device_put)
+
+    yield from _prefetched(batch_gen, prefetch)
+
+
+def _stager(device_put) -> Callable[[dict[str, Any]], dict[str, Any]]:
+    """Batch-staging function from the ``device_put`` option: ``False`` =
+    host arrays, ``True`` = default-device ``jax.device_put``, callable =
+    custom staging (e.g. ``Trainer.shard`` — device_put with the mesh
+    shardings).  Runs in the pipeline thread, overlapping H2D with
+    compute."""
+    if callable(device_put):
+        return device_put
+    if device_put:
+        def _put(batch: dict[str, Any]) -> dict[str, Any]:
             import jax
 
-            batch = {k: jax.device_put(v) for k, v in batch.items()}
-        return batch
+            return {k: jax.device_put(v) for k, v in batch.items()}
 
+        return _put
+    return lambda batch: batch
+
+
+def _prefetched(batch_gen_fn: Callable[[], Iterator[Any]],
+                prefetch: int) -> Iterator[Any]:
+    """Run ``batch_gen_fn()`` in a pipeline thread, ``prefetch`` items ahead.
+
+    ``prefetch <= 0`` degrades to the plain generator.  Producer exceptions
+    re-raise on the consumer side; abandoning the iterator (break /
+    GeneratorExit) stops the pump and the underlying generator's cleanup
+    (``finally`` blocks, reader pools) runs promptly.
+    """
     if prefetch <= 0:
-        yield from batch_gen()
+        yield from batch_gen_fn()
         return
 
     out: _queue_mod.Queue = _queue_mod.Queue(maxsize=prefetch)
@@ -231,7 +255,7 @@ def tfrecord_batches(
     abandoned = threading.Event()  # consumer gave up (break / GeneratorExit)
 
     def pump() -> None:
-        gen = batch_gen()
+        gen = batch_gen_fn()
         try:
             for b in gen:
                 while not abandoned.is_set():
@@ -245,7 +269,7 @@ def tfrecord_batches(
         except BaseException as e:  # surfaced on the consumer side
             err.append(e)
         finally:
-            gen.close()  # runs _record_stream's finally → pool.stop()
+            gen.close()  # runs the source's finally → pool.stop()
             # The sentinel MUST reach a live consumer even when the queue is
             # momentarily full of staged batches; dropping it is only safe
             # once the consumer has abandoned the iterator.
@@ -275,3 +299,107 @@ def tfrecord_batches(
         t.join(timeout=10.0)
     if err:
         raise err[0]
+
+
+def parquet_batches(
+    files: Sequence[str] | str,
+    batch_size: int,
+    *,
+    columns: Sequence[str] | None = None,
+    num_epochs: int = 1,
+    shuffle_files: bool = False,
+    seed: int = 0,
+    drop_remainder: bool = False,
+    prefetch: int = 2,
+    device_put: bool | Callable[[dict[str, Any]], dict[str, Any]] = False,
+) -> Iterator[dict[str, Any]]:
+    """Yield columnar batches straight from Parquet row groups.
+
+    The Arrow→HBM path (``SURVEY.md §2.2``: "columnar (Arrow/Parquet)→HBM
+    path, the idiomatic 2026 choice"): row groups decode to Arrow column
+    buffers and convert to NumPy without any per-row Python work — there is
+    no row-at-a-time hot loop anywhere on this path, unlike the reference's
+    pickled-row queues (``SURVEY.md §3.2``).  Shares the prefetch pipeline
+    thread and ``device_put`` staging with :func:`tfrecord_batches`, so
+    batch N+1 moves host→HBM while batch N trains.
+
+    ``files`` should already be this node's shard (:func:`shard_files`
+    works on ``.parquet`` part files too).  Row-level shuffling is not
+    provided here — shuffle at the file/row-group level
+    (``shuffle_files=True``) or upstream at write time.
+    """
+    import pyarrow.parquet as pq
+
+    if isinstance(files, str):
+        files = fs.glob(files)
+    files = list(files)
+    if not files:
+        return
+    _stage = _stager(device_put)
+
+    def _open_parquet(path: str):
+        """Returns (ParquetFile, handle-to-close-or-None): ParquetFile.close
+        does not close a caller-supplied source, so remote handles must be
+        closed explicitly."""
+        local = fs.local_path(path)
+        if local is not None:
+            return pq.ParquetFile(local), None
+        handle = fs.open(path, "rb")
+        return pq.ParquetFile(handle), handle
+
+    def batch_gen() -> Iterator[dict[str, Any]]:
+        for epoch in range(num_epochs):
+            epoch_files = list(files)
+            if shuffle_files:
+                np.random.default_rng(seed + epoch).shuffle(epoch_files)
+            pending: dict[str, list[np.ndarray]] = {}
+            count = 0
+            names: list[str] | None = None
+            for path in epoch_files:
+                pf, handle = _open_parquet(path)
+                try:
+                    for rb in pf.iter_batches(columns=list(columns)
+                                              if columns else None):
+                        if rb.num_rows == 0:
+                            continue
+                        if names is None:
+                            names = list(rb.schema.names)
+                        elif list(rb.schema.names) != names:
+                            # schema drift across part files would silently
+                            # misalign the columnar accumulators
+                            raise ValueError(
+                                f"{path}: columns {rb.schema.names} != "
+                                f"{names} of the first file"
+                            )
+                        for name, col in zip(rb.schema.names, rb.columns):
+                            pending.setdefault(name, []).append(
+                                np.asarray(col)
+                            )
+                        count += rb.num_rows
+                        while count >= batch_size:
+                            batch, pending, count = _slice_batch(
+                                pending, count, batch_size
+                            )
+                            yield _stage(batch)
+                finally:
+                    pf.close()
+                    if handle is not None:
+                        handle.close()
+            if count and not drop_remainder:
+                batch, pending, count = _slice_batch(pending, count, count)
+                yield _stage(batch)
+
+    yield from _prefetched(batch_gen, prefetch)
+
+
+def _slice_batch(pending: dict[str, list[np.ndarray]], count: int,
+                 batch_size: int):
+    """Take the first ``batch_size`` rows out of columnar accumulators."""
+    batch: dict[str, np.ndarray] = {}
+    rest: dict[str, list[np.ndarray]] = {}
+    for name, chunks in pending.items():
+        col = chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
+        batch[name] = col[:batch_size]
+        if len(col) > batch_size:
+            rest[name] = [col[batch_size:]]
+    return batch, rest, count - batch_size

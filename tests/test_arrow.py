@@ -1,0 +1,146 @@
+"""Arrow/Parquet columnar path: DataFrame↔Parquet round-trip (`dfutil`)
+and row-group-native columnar batches (`readers.parquet_batches`) — the
+"columnar → HBM" sibling of the TFRecord path (SURVEY.md §2.2)."""
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import dfutil, readers
+from tensorflowonspark_tpu.sparkapi import LocalSparkContext
+from tensorflowonspark_tpu.sparkapi.sql import LocalSparkSession
+
+
+def test_dataframe_parquet_round_trip(tmp_path):
+    sc = LocalSparkContext("local-cluster[2,1,1024]", "arrow-rt")
+    spark = LocalSparkSession(sc)
+    out = str(tmp_path / "pq")
+    try:
+        rows = [
+            (i, float(i) / 2, f"s{i}", [1.0 * i, 2.0 * i], [i, i + 1])
+            for i in range(20)
+        ]
+        df = spark.createDataFrame(
+            rows, ["id", "x", "name", "vec", "idx"]).repartition(2)
+        dfutil.saveAsParquet(df, out)
+
+        df2 = dfutil.loadParquet(sc, out)
+        assert dict(df2.dtypes) == dict(df.dtypes)  # schema survives exactly
+        got = sorted(df2.collect(), key=lambda r: r.id)
+        for i, r in enumerate(got):
+            assert r.id == i
+            assert r.x == pytest.approx(i / 2)
+            assert r.name == f"s{i}"
+            assert list(r.vec) == pytest.approx([1.0 * i, 2.0 * i])
+            assert list(r.idx) == [i, i + 1]
+    finally:
+        sc.stop()
+
+
+def test_load_parquet_missing_dir_and_empty(tmp_path):
+    sc = LocalSparkContext("local[1]", "arrow-missing")
+    try:
+        with pytest.raises(FileNotFoundError):
+            dfutil.loadParquet(sc, str(tmp_path / "nope"))
+    finally:
+        sc.stop()
+
+
+def _write_parquet_files(tmp_path, n_files=3, rows_per_file=10):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    files = []
+    k = 0
+    for f in range(n_files):
+        cols = {
+            "x": np.arange(k, k + rows_per_file, dtype=np.float32),
+            "label": np.arange(k, k + rows_per_file, dtype=np.int64) % 3,
+        }
+        k += rows_per_file
+        path = str(tmp_path / f"part-r-{f:05d}.parquet")
+        # two row groups per file to exercise iter_batches chunking
+        pq.write_table(pa.table(cols), path, row_group_size=rows_per_file // 2)
+        files.append(path)
+    return files
+
+
+def test_parquet_batches_columnar(tmp_path):
+    files = _write_parquet_files(tmp_path)
+    batches = list(readers.parquet_batches(files, batch_size=8, prefetch=2))
+    assert [len(b["x"]) for b in batches] == [8, 8, 8, 6]  # 30 rows total
+    all_x = np.concatenate([b["x"] for b in batches])
+    np.testing.assert_array_equal(all_x, np.arange(30, dtype=np.float32))
+    assert batches[0]["label"].dtype == np.int64
+
+
+def test_parquet_batches_drop_remainder_columns_epochs(tmp_path):
+    files = _write_parquet_files(tmp_path)
+    batches = list(readers.parquet_batches(
+        files, batch_size=8, drop_remainder=True, columns=["x"],
+        num_epochs=2, prefetch=0))
+    assert len(batches) == 6  # 3 full batches per epoch, remainder dropped
+    assert all(set(b) == {"x"} for b in batches)
+    np.testing.assert_array_equal(batches[3]["x"], batches[0]["x"])
+
+
+def test_parquet_batches_device_put_callable(tmp_path):
+    files = _write_parquet_files(tmp_path, n_files=1, rows_per_file=8)
+    staged = []
+
+    def stage(batch):
+        staged.append(True)
+        return {k: v * 2 for k, v in batch.items()}
+
+    batches = list(readers.parquet_batches(files, batch_size=4,
+                                           device_put=stage))
+    assert staged and len(batches) == 2
+    np.testing.assert_array_equal(batches[0]["x"],
+                                  np.arange(4, dtype=np.float32) * 2)
+
+
+def test_parquet_batches_glob_and_shard(tmp_path):
+    _write_parquet_files(tmp_path)
+    pattern = str(tmp_path / "part-r-*.parquet")
+    shard = readers.shard_files(pattern, task_index=1, num_shards=3)
+    assert len(shard) == 1 and shard[0].endswith("part-r-00001.parquet")
+    batches = list(readers.parquet_batches(shard, batch_size=5))
+    np.testing.assert_array_equal(
+        np.concatenate([b["x"] for b in batches]),
+        np.arange(10, 20, dtype=np.float32))
+
+
+def test_save_parquet_decimal_column(tmp_path):
+    import decimal
+
+    from tensorflowonspark_tpu.sparkapi.sql import StructField, StructType
+
+    sc = LocalSparkContext("local[1]", "arrow-dec")
+    spark = LocalSparkSession(sc)
+    out = str(tmp_path / "pq")
+    try:
+        rows = [(i, decimal.Decimal(f"{i}.25")) for i in range(4)]
+        df = spark.createDataFrame(rows, StructType([
+            StructField("id", "bigint"),
+            StructField("amount", "decimal(10,2)"),
+        ]))
+        # decimal columns save as float64; Decimal cells must be converted,
+        # not crash pyarrow
+        dfutil.saveAsParquet(df, out)
+        got = sorted(dfutil.loadParquet(sc, out).collect(),
+                     key=lambda r: r.id)
+        assert [float(r.amount) for r in got] == [0.25, 1.25, 2.25, 3.25]
+    finally:
+        sc.stop()
+
+
+def test_parquet_batches_schema_drift_raises(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    a = str(tmp_path / "a.parquet")
+    b = str(tmp_path / "b.parquet")
+    pq.write_table(pa.table({"x": np.arange(4, dtype=np.float32),
+                             "label": np.arange(4)}), a)
+    pq.write_table(pa.table({"x": np.arange(4, dtype=np.float32)}), b)
+    with pytest.raises(ValueError, match="columns"):
+        list(readers.parquet_batches([a, b], batch_size=16, prefetch=0))
