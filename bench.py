@@ -37,6 +37,10 @@ import time
 # nothing, so these anchor vs_baseline at a roofline-informed v5e estimate.
 TARGETS = {
     "resnet50": ("images/sec/chip", 2000.0),
+    # XLA cost analysis counts ~41 GFLOP/img for this SAME-padded variant
+    # (vs ~17 canonical); at the chip's 0.30-0.35 MFU band the roofline is
+    # ~1500-1700 img/s — target set to the band's floor
+    "inception_v3": ("images/sec/chip", 1500.0),
     "wide_deep": ("steps/sec", 100.0),
     "bert": ("examples/sec/chip", 100.0),
     "mnist_mlp": ("images/sec/chip", 100000.0),
@@ -48,6 +52,7 @@ TARGETS = {
 # the fused table), so it wants a much larger batch than the conv nets.
 ACCEL_BATCH = {
     "resnet50": 128,
+    "inception_v3": 128,
     "wide_deep": 4096,
     "bert": 32,
     "mnist_mlp": 512,
@@ -106,6 +111,12 @@ def _analytic_flops(model: str, config, batch_size: int) -> float | None:
     if model == "resnet50" and getattr(config, "image_size", 0) == 224 and \
             tuple(getattr(config, "stage_sizes", ())) == (3, 4, 6, 3):
         return 3.0 * 8.2e9 * batch_size  # ~4.1 GMACs fwd per 224x224 image
+    if model == "inception_v3" and getattr(config, "image_size", 0) == 299 \
+            and getattr(config, "width_mult", 0) == 1.0:
+        # measured via XLA cost analysis on this SAME-padded variant
+        # (~41 GFLOP/img train ≈ 3 × 13.7 GFLOP fwd; the canonical
+        # VALID-padded stem would be ~3 × 5.7 — see TARGETS comment)
+        return 3.0 * 13.7e9 * batch_size
     if model == "wide_deep":
         # derived, not a constant: MLP matmul chain dominates the countable
         # FLOPs (the gathers/optimizer update are bandwidth, not FLOPs)

@@ -45,8 +45,11 @@ def map_fun(args, ctx):
     from tensorflowonspark_tpu.trainer import Trainer
 
     distributed.maybe_initialize(ctx)
-    config = resnet.Config.tiny() if args.tiny else resnet.Config()
-    trainer = Trainer("resnet50", config=config, learning_rate=args.lr)
+    from tensorflowonspark_tpu import models as model_zoo
+
+    arch_lib = model_zoo.get_model(args.arch)
+    config = arch_lib.Config.tiny() if args.tiny else arch_lib.Config()
+    trainer = Trainer(args.arch, config=config, learning_rate=args.lr)
     reporter = metrics.MetricsReporter(ctx, interval=5)
     trainer.add_step_callback(reporter)
     side = config.image_size
@@ -54,8 +57,8 @@ def map_fun(args, ctx):
     loss = None
     if args.synthetic:
         # pure-compute ceiling: one device-resident batch, no input pipeline
-        batch = resnet.example_batch(config, batch_size=args.batch_size,
-                                     seed=ctx.task_index)
+        batch = arch_lib.example_batch(config, batch_size=args.batch_size,
+                                       seed=ctx.task_index)
         device_batch = trainer.shard(batch)
         state = trainer.state
         for _ in range(args.warmup):
@@ -102,6 +105,9 @@ def prep_tfrecords(data_dir: str, n: int, parts: int, side: int,
 
 def main(argv=None):
     p = argparse.ArgumentParser()
+    p.add_argument("--arch", default="resnet50",
+                   choices=["resnet50", "inception_v3"],
+                   help="acceptance config #3 names both architectures")
     p.add_argument("--cluster_size", type=int, default=2)
     p.add_argument("--batch_size", type=int, default=32)
     p.add_argument("--epochs", type=int, default=1)
@@ -129,7 +135,10 @@ def main(argv=None):
     if not args.synthetic:
         import glob
 
-        side = (resnet.Config.tiny() if args.tiny else resnet.Config()).image_size
+        from tensorflowonspark_tpu import models as model_zoo
+
+        lib = model_zoo.get_model(args.arch)
+        side = (lib.Config.tiny() if args.tiny else lib.Config()).image_size
         if not glob.glob(os.path.join(args.data_dir, "part-*")):
             prep_tfrecords(args.data_dir, args.num_samples,
                            args.cluster_size * 2, side)

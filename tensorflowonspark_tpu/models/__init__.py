@@ -24,6 +24,7 @@ _REGISTRY = {
     "mnist_mlp": "tensorflowonspark_tpu.models.mnist",
     "cifar10_cnn": "tensorflowonspark_tpu.models.cifar",
     "resnet50": "tensorflowonspark_tpu.models.resnet",
+    "inception_v3": "tensorflowonspark_tpu.models.inception",
     "wide_deep": "tensorflowonspark_tpu.models.widedeep",
     "bert": "tensorflowonspark_tpu.models.bert",
 }

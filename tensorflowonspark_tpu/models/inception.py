@@ -1,0 +1,218 @@
+"""Inception-v3 for ImageNet — the second architecture of acceptance
+config #3 (``BASELINE.md``: "ImageNet ResNet-50 / Inception-v3").
+
+Reference anchor: ``examples/imagenet/inception`` — the reference's
+original headline workload (Yahoo's published scaling claims were
+Inception-v3 data-parallel training; ``SURVEY.md §6``).  TPU-first
+choices match :mod:`tensorflowonspark_tpu.models.resnet`: NHWC layout,
+bfloat16 compute with float32 params, GroupNorm by default for a pure
+``(params, batch)`` loss (``norm="batch"`` switches to BatchNorm with
+running stats in the train-state collections).
+
+Architectural notes:
+
+- the classic tower structure: stem → 3×InceptionA (35×35) → ReductionA →
+  4×InceptionB (17×17, factorized 1×7/7×1 convs) → ReductionB →
+  2×InceptionC (8×8, split 1×3/3×1 branches) → global pool → classifier;
+- all convs use ``SAME`` padding (the canonical stem mixes VALID/SAME;
+  SAME end-to-end keeps every stage shape a clean power-of-two fraction
+  of the input, which XLA tiles better and which makes the tiny test
+  config work at 32×32 without special cases);
+- the auxiliary classifier head is omitted — it exists to aid optimization
+  of the original SGD recipe, contributes nothing at inference, and would
+  complicate the uniform ``make_loss_fn`` zoo contract.
+- ``width_mult`` scales every branch width (tiny config trains in CI).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from tensorflowonspark_tpu.models import _common
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    num_classes: int = 1000
+    image_size: int = 299
+    width_mult: float = 1.0
+    groups: int = 32
+    dtype: str = "bfloat16"
+    norm: str = "group"  # "group" (pure) | "batch" (stats in collections)
+
+    @classmethod
+    def tiny(cls) -> "Config":
+        return cls(num_classes=10, image_size=32, width_mult=0.125,
+                   groups=2, dtype="float32")
+
+
+SEQUENCE_AXES: dict = {}
+
+
+def make_model(config: Config, mesh=None):
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(config.dtype)
+    conv_init = nn.with_partitioning(
+        nn.initializers.he_normal(), (None, None, "embed", "mlp")
+    )
+    batch_norm = config.norm == "batch"
+
+    def ch(c: int) -> int:
+        return max(8, int(round(c * config.width_mult)))
+
+    def gn_groups(c: int) -> int:
+        """Largest divisor of ``c`` not exceeding ``config.groups`` —
+        inception towers have widths (80, 48, …) that 32 doesn't divide."""
+        g = min(config.groups, c)
+        while c % g:
+            g -= 1
+        return g
+
+    class ConvNorm(nn.Module):
+        """conv → norm → relu, the inception building block."""
+
+        filters: int
+        kernel: tuple
+        strides: int = 1
+
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = nn.Conv(self.filters, self.kernel,
+                        strides=(self.strides,) * 2, use_bias=False,
+                        dtype=dtype, kernel_init=conv_init)(x)
+            if batch_norm:
+                x = nn.BatchNorm(use_running_average=not train,
+                                 momentum=0.9, dtype=dtype)(x)
+            else:
+                x = nn.GroupNorm(num_groups=gn_groups(self.filters),
+                                 dtype=dtype)(x)
+            return nn.relu(x)
+
+    def avg_pool3(x):
+        return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+    class InceptionA(nn.Module):
+        pool_features: int
+
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            b1 = ConvNorm(ch(64), (1, 1))(x, train)
+            b5 = ConvNorm(ch(48), (1, 1))(x, train)
+            b5 = ConvNorm(ch(64), (5, 5))(b5, train)
+            b3 = ConvNorm(ch(64), (1, 1))(x, train)
+            b3 = ConvNorm(ch(96), (3, 3))(b3, train)
+            b3 = ConvNorm(ch(96), (3, 3))(b3, train)
+            bp = ConvNorm(ch(self.pool_features), (1, 1))(
+                avg_pool3(x), train)
+            return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+    class ReductionA(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            b3 = ConvNorm(ch(384), (3, 3), strides=2)(x, train)
+            bd = ConvNorm(ch(64), (1, 1))(x, train)
+            bd = ConvNorm(ch(96), (3, 3))(bd, train)
+            bd = ConvNorm(ch(96), (3, 3), strides=2)(bd, train)
+            bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            return jnp.concatenate([b3, bd, bp], axis=-1)
+
+    class InceptionB(nn.Module):
+        c7: int  # width of the factorized 7x7 towers
+
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            c7 = ch(self.c7)
+            b1 = ConvNorm(ch(192), (1, 1))(x, train)
+            b7 = ConvNorm(c7, (1, 1))(x, train)
+            b7 = ConvNorm(c7, (1, 7))(b7, train)
+            b7 = ConvNorm(ch(192), (7, 1))(b7, train)
+            bd = ConvNorm(c7, (1, 1))(x, train)
+            bd = ConvNorm(c7, (7, 1))(bd, train)
+            bd = ConvNorm(c7, (1, 7))(bd, train)
+            bd = ConvNorm(c7, (7, 1))(bd, train)
+            bd = ConvNorm(ch(192), (1, 7))(bd, train)
+            bp = ConvNorm(ch(192), (1, 1))(avg_pool3(x), train)
+            return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+    class ReductionB(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            b3 = ConvNorm(ch(192), (1, 1))(x, train)
+            b3 = ConvNorm(ch(320), (3, 3), strides=2)(b3, train)
+            b7 = ConvNorm(ch(192), (1, 1))(x, train)
+            b7 = ConvNorm(ch(192), (1, 7))(b7, train)
+            b7 = ConvNorm(ch(192), (7, 1))(b7, train)
+            b7 = ConvNorm(ch(192), (3, 3), strides=2)(b7, train)
+            bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            return jnp.concatenate([b3, b7, bp], axis=-1)
+
+    class InceptionC(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            b1 = ConvNorm(ch(320), (1, 1))(x, train)
+            b3 = ConvNorm(ch(384), (1, 1))(x, train)
+            b3 = jnp.concatenate([
+                ConvNorm(ch(384), (1, 3))(b3, train),
+                ConvNorm(ch(384), (3, 1))(b3, train),
+            ], axis=-1)
+            bd = ConvNorm(ch(448), (1, 1))(x, train)
+            bd = ConvNorm(ch(384), (3, 3))(bd, train)
+            bd = jnp.concatenate([
+                ConvNorm(ch(384), (1, 3))(bd, train),
+                ConvNorm(ch(384), (3, 1))(bd, train),
+            ], axis=-1)
+            bp = ConvNorm(ch(192), (1, 1))(avg_pool3(x), train)
+            return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+    class InceptionV3(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = x.astype(dtype)
+            # stem: 299 -> 37 (SAME padding keeps clean halvings)
+            x = ConvNorm(ch(32), (3, 3), strides=2)(x, train)
+            x = ConvNorm(ch(32), (3, 3))(x, train)
+            x = ConvNorm(ch(64), (3, 3))(x, train)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            x = ConvNorm(ch(80), (1, 1))(x, train)
+            x = ConvNorm(ch(192), (3, 3))(x, train)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+            for pool_features in (32, 64, 64):
+                x = InceptionA(pool_features)(x, train)
+            x = ReductionA()(x, train)
+            for c7 in (128, 160, 160, 192):
+                x = InceptionB(c7)(x, train)
+            x = ReductionB()(x, train)
+            for _ in range(2):
+                x = InceptionC()(x, train)
+
+            x = x.mean(axis=(1, 2))
+            return nn.Dense(
+                config.num_classes, dtype=jnp.float32,
+                kernel_init=nn.with_partitioning(
+                    nn.initializers.lecun_normal(), ("embed", "classes")
+                ),
+            )(x)
+
+    return InceptionV3()
+
+
+def make_loss_fn(module, config: Config):
+    if config.norm == "batch":
+        return _common.make_stateful_classification_loss_fn(module)
+    return _common.make_classification_loss_fn(module)
+
+
+def make_forward_fn(module, config: Config):
+    if config.norm == "batch":
+        return _common.make_stateful_classification_forward_fn(module)
+    return _common.make_classification_forward_fn(module)
+
+
+def example_batch(config: Config, batch_size: int = 8, seed: int = 0):
+    return _common.image_example_batch(
+        (config.image_size, config.image_size, 3), config.num_classes,
+        batch_size, seed,
+    )

@@ -78,3 +78,14 @@ def test_resnet_spark_example_tfrecord_pipeline(tmp_path, capsys):
               "--data_dir", str(tmp_path / "imagenet_tfr")])
     out = capsys.readouterr().out
     assert "cluster total:" in out and "images/sec" in out
+
+
+def test_inception_spark_example_synthetic(capsys):
+    """Acceptance config #3 names both architectures; --arch inception_v3
+    runs the same DP example on the Inception-v3 zoo entry."""
+    mod = _load("imagenet", "resnet_spark")
+    mod.main(["--cluster_size", "2", "--tiny", "--steps", "2",
+              "--warmup", "1", "--batch_size", "8", "--synthetic",
+              "--arch", "inception_v3"])
+    out = capsys.readouterr().out
+    assert "cluster total:" in out and "images/sec" in out

@@ -18,7 +18,8 @@ ALL_MODELS = zoo.available()
 
 def test_registry_lists_all():
     assert ALL_MODELS == sorted(
-        ["mnist_mlp", "cifar10_cnn", "resnet50", "wide_deep", "bert"]
+        ["mnist_mlp", "cifar10_cnn", "resnet50", "inception_v3",
+         "wide_deep", "bert"]
     )
 
 
