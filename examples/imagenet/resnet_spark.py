@@ -129,7 +129,6 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     from tensorflowonspark_tpu import TFCluster
-    from tensorflowonspark_tpu.models import resnet
     from tensorflowonspark_tpu.sparkapi import get_spark_context
 
     if not args.synthetic:
@@ -139,6 +138,9 @@ def main(argv=None):
 
         lib = model_zoo.get_model(args.arch)
         side = (lib.Config.tiny() if args.tiny else lib.Config()).image_size
+        # records are side-specific: key the synthetic dir on the image size
+        # so --arch/--tiny switches never reuse incompatible records
+        args.data_dir = os.path.join(args.data_dir, f"side{side}")
         if not glob.glob(os.path.join(args.data_dir, "part-*")):
             prep_tfrecords(args.data_dir, args.num_samples,
                            args.cluster_size * 2, side)

@@ -170,7 +170,7 @@ def make_model(config: Config, mesh=None):
         @nn.compact
         def __call__(self, x, train: bool = False):
             x = x.astype(dtype)
-            # stem: 299 -> 37 (SAME padding keeps clean halvings)
+            # stem: 299 -> 150 -> 75 -> 38 (SAME padding: ceil halvings)
             x = ConvNorm(ch(32), (3, 3), strides=2)(x, train)
             x = ConvNorm(ch(32), (3, 3))(x, train)
             x = ConvNorm(ch(64), (3, 3))(x, train)

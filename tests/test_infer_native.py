@@ -124,3 +124,41 @@ def test_jni_library_exports_expected_symbols():
         "Java_com_tensorflowonspark_tpu_TFRecordCodec_indexRecords",
     ):
         assert sym in syms, f"missing JNI export {sym}"
+
+
+@pytest.mark.skipif(not infer_native.available(),
+                    reason="native toolchain unavailable")
+def test_widedeep_collections_export_serves(tmp_path):
+    """A collections-stateful model (wide&deep: embedding tables outside the
+    param tree) must serve through the same C-ABI sequence — the criteo
+    acceptance config's serving path without a Python driver."""
+    import jax
+
+    lib = model_zoo.get_model("wide_deep")
+    config = lib.Config.tiny()
+    module = lib.make_model(config)
+    batch = lib.example_batch(config, batch_size=1)
+    from flax.linen import meta
+
+    variables = meta.unbox(
+        module.init(jax.random.PRNGKey(0), batch["dense"], batch["cat"]))
+    params = variables["params"]
+    collections = {"embedding": variables["embedding"]}
+    path = str(tmp_path / "model")
+    ckpt.save_pytree({"params": params, "collections": collections}, path)
+
+    full = lib.example_batch(config, batch_size=4, seed=1)
+    sess = infer_native.Session(path, "wide_deep")
+    try:
+        sess.set_input("dense", full["dense"])
+        sess.set_input("cat", full["cat"])
+        sess.run()
+        out = sess.output()
+    finally:
+        sess.close()
+    forward = lib.make_forward_fn(module, config)
+    expected = np.asarray(forward(params, collections,
+                                  {"dense": full["dense"],
+                                   "cat": full["cat"]}))
+    assert out.shape == expected.shape
+    np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
