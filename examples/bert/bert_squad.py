@@ -34,11 +34,17 @@ def map_fun(args, ctx):
     from tensorflowonspark_tpu.trainer import Trainer
 
     distributed.maybe_initialize(ctx)
+    import dataclasses
+
     config = bert.Config.tiny() if args.tiny else bert.Config(remat=True)
+    if args.pp > 1:
+        # GPipe trunk: stacked layer params over the pp axis
+        config = dataclasses.replace(config, pp_stages=args.pp,
+                                     pp_microbatches=args.pp_microbatches)
     trainer = Trainer(
         "bert", config=config,
         mesh_config=MeshConfig(dp=args.dp, fsdp=args.fsdp, sp=args.sp,
-                               tp=args.tp),
+                               tp=args.tp, pp=args.pp),
         optimizer=optax.adamw(args.lr, weight_decay=0.01),
         zero=args.fsdp > 1 or ctx.num_ps > 0,  # num_ps parity: ZeRO mapping
     )
@@ -94,6 +100,9 @@ def main(argv=None):
     p.add_argument("--fsdp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline stages (GPipe trunk; not with --sp > 1)")
+    p.add_argument("--pp_microbatches", type=int, default=4)
     p.add_argument("--num_samples", type=int, default=512)
     p.add_argument("--model_dir", default=None)
     p.add_argument("--tiny", action="store_true")

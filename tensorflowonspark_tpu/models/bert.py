@@ -35,6 +35,12 @@ class Config:
     type_vocab: int = 2
     dtype: str = "bfloat16"
     remat: bool = False  # jax.checkpoint each layer: FLOPs for HBM
+    # pipeline parallelism: > 1 switches the encoder trunk to STACKED layer
+    # params (leading "stage" dim sharded over pp) run as a GPipe microbatch
+    # schedule when the mesh has that many pp ranks, a lax.scan otherwise
+    # (parallel/pipeline_parallel.py).  layers % pp_stages must be 0.
+    pp_stages: int = 0
+    pp_microbatches: int = 4
 
     @classmethod
     def tiny(cls) -> "Config":
@@ -113,9 +119,9 @@ def make_model(config: Config, mesh=None):
             x = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + y).astype(dtype)
             return x
 
-    class Bert(nn.Module):
+    class Embeddings(nn.Module):
         @nn.compact
-        def __call__(self, input_ids, token_type_ids, attention_mask):
+        def __call__(self, input_ids, token_type_ids):
             tok = self.param(
                 "tok_embed",
                 nn.with_partitioning(
@@ -141,19 +147,165 @@ def make_model(config: Config, mesh=None):
             x = (_common.embedding_lookup(tok, input_ids)
                  + pos[None, :s]
                  + _common.embedding_lookup(typ, token_type_ids))
-            x = nn.LayerNorm(dtype=jnp.float32, name="ln_embed")(x).astype(dtype)
+            return nn.LayerNorm(
+                dtype=jnp.float32, name="ln_embed")(x).astype(dtype)
+
+    class StackedEncoder(nn.Module):
+        """``config.layers`` post-LN blocks with STACKED parameters: every
+        leaf carries a leading layer dim annotated ``"stage"`` (→ ``pp``).
+        Executed as a GPipe pipeline (``parallel.pipeline_parallel``) when
+        the mesh has ``pp == config.pp_stages`` ranks, as a ``lax.scan``
+        otherwise — identical numerics either way (tested).  Dense masked
+        attention only (ring/sp attention belongs to the layered variant).
+
+        Deliberately a functional twin of :class:`Block` rather than
+        ``nn.scan(Block)``: nn.scan owns the execution (sequential) and
+        hides its stacked params from ``pipeline_apply``, which needs them
+        as a plain pytree to reshape into stages.  The two implementations
+        are pinned to each other by
+        ``tests/test_models.py::test_bert_stacked_encoder_matches_layered_block``
+        (grafts layered weights into the stacked layout and compares
+        forwards), so a drift in eps/masking/dtype policy fails loudly.
+        """
+
+        @nn.compact
+        def __call__(self, x, mask):
+            from tensorflowonspark_tpu.parallel.pipeline_parallel import (
+                pipeline_apply,
+            )
+
+            L, H = config.layers, config.hidden
+            M, nh, hd = config.mlp_dim, config.heads, config.head_dim
+            normal = nn.initializers.normal(stddev=0.02)
+            zeros = nn.initializers.zeros_init()
+            ones = nn.initializers.ones_init()
+
+            def par(name, shape, axes, init):
+                return self.param(
+                    name, nn.with_partitioning(init, ("stage",) + axes),
+                    (L,) + shape, jnp.float32,
+                )
+
+            w = {
+                "qkv_w": par("qkv_w", (H, 3 * H), ("embed", "mlp"), normal),
+                "qkv_b": par("qkv_b", (3 * H,), (None,), zeros),
+                "out_w": par("out_w", (H, H), ("mlp", "embed"), normal),
+                "out_b": par("out_b", (H,), (None,), zeros),
+                "ln1_s": par("ln1_s", (H,), (None,), ones),
+                "ln1_b": par("ln1_b", (H,), (None,), zeros),
+                "mlp_in_w": par("mlp_in_w", (H, M), ("embed", "mlp"), normal),
+                "mlp_in_b": par("mlp_in_b", (M,), (None,), zeros),
+                "mlp_out_w": par("mlp_out_w", (M, H), ("mlp", "embed"),
+                                 normal),
+                "mlp_out_b": par("mlp_out_b", (H,), (None,), zeros),
+                "ln2_s": par("ln2_s", (H,), (None,), ones),
+                "ln2_b": par("ln2_b", (H,), (None,), zeros),
+            }
+
+            def layer_norm(h, scale, bias):
+                h32 = h.astype(jnp.float32)
+                mu = h32.mean(axis=-1, keepdims=True)
+                var = ((h32 - mu) ** 2).mean(axis=-1, keepdims=True)
+                return ((h32 - mu) * jax.lax.rsqrt(var + 1e-6)
+                        * scale + bias).astype(dtype)
+
+            def block(lw, h, m):
+                b, s = h.shape[0], h.shape[1]
+                qkv = (h @ lw["qkv_w"].astype(dtype)
+                       + lw["qkv_b"].astype(dtype))
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+                q = q.reshape(b, s, nh, hd)
+                k = k.reshape(b, s, nh, hd)
+                v = v.reshape(b, s, nh, hd)
+                sc = jnp.einsum(
+                    "bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                    k.astype(jnp.float32)
+                ) * (1.0 / math.sqrt(hd))
+                sc = jnp.where(m[:, None, None, :], sc, -1e30)
+                p = jax.nn.softmax(sc, axis=-1)
+                o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(dtype), v)
+                o = o.reshape(b, s, H)
+                o = o @ lw["out_w"].astype(dtype) + lw["out_b"].astype(dtype)
+                h = layer_norm(h + o, lw["ln1_s"], lw["ln1_b"])
+                y = nn.gelu(h @ lw["mlp_in_w"].astype(dtype)
+                            + lw["mlp_in_b"].astype(dtype))
+                y = (y @ lw["mlp_out_w"].astype(dtype)
+                     + lw["mlp_out_b"].astype(dtype))
+                return layer_norm(h + y, lw["ln2_s"], lw["ln2_b"])
+
+            # per-layer rematerialization in BOTH execution paths (finer
+            # than checkpointing a whole pipeline stage)
+            blk = jax.checkpoint(block) if config.remat else block
+
+            def stage_fn(sp, h, m):
+                def body(carry, lw):
+                    return blk(lw, carry, m), None
+
+                h, _ = jax.lax.scan(body, h, sp)
+                return h
+
+            n_pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+            if n_pp > 1 and n_pp == config.pp_stages:
+                staged = jax.tree_util.tree_map(
+                    lambda l: l.reshape((n_pp, L // n_pp) + l.shape[1:]), w
+                )
+                return pipeline_apply(
+                    stage_fn, staged, x, mesh=mesh,
+                    n_microbatches=config.pp_microbatches, aux=mask,
+                )
+            return stage_fn(w, x, mask)
+
+    class Bert(nn.Module):
+        @nn.compact
+        def __call__(self, input_ids, token_type_ids, attention_mask):
+            x = Embeddings(name="embeddings")(input_ids, token_type_ids)
             mask = attention_mask.astype(bool)
-            block = Block
-            if config.remat:
-                block = nn.remat(Block)
-            for i in range(config.layers):
-                x = block(name=f"layer_{i}")(x, mask)
+            if config.pp_stages > 1:
+                x = StackedEncoder(name="encoder")(x, mask)
+            else:
+                block = Block
+                if config.remat:
+                    block = nn.remat(Block)
+                for i in range(config.layers):
+                    x = block(name=f"layer_{i}")(x, mask)
             # SQuAD span head: start/end logits per position
             span = dense((2,), ("embed", "classes"), name="span")(x)
             logits = span.astype(jnp.float32)
             logits = jnp.where(mask[:, :, None], logits, -1e30)
             return logits[..., 0], logits[..., 1]  # start, end: (B, S)
 
+    if config.pp_stages > 1:
+        if config.layers % config.pp_stages:
+            raise ValueError(
+                f"layers={config.layers} not divisible by "
+                f"pp_stages={config.pp_stages}"
+            )
+        if mesh is not None and mesh.shape.get("sp", 1) > 1:
+            raise ValueError(
+                "pp_stages > 1 uses dense attention; combine pp with "
+                "dp/fsdp, not sp (ring attention belongs to the layered "
+                "variant)"
+            )
+        if mesh is not None and mesh.shape.get("tp", 1) > 1:
+            raise ValueError(
+                "pp_stages > 1 does not shard over tp (the pipeline "
+                "stage_fn has no internal tp collectives — tp ranks would "
+                "silently replicate); combine pp with dp/fsdp"
+            )
+        mesh_pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+        if mesh_pp > 1 and mesh_pp != config.pp_stages:
+            raise ValueError(
+                f"mesh has pp={mesh_pp} but config.pp_stages="
+                f"{config.pp_stages}: the trunk would fall back to "
+                "sequential execution and replicate over every pp rank — "
+                "make them equal"
+            )
+    elif mesh is not None and mesh.shape.get("pp", 1) > 1:
+        raise ValueError(
+            "mesh has pp > 1 but config.pp_stages <= 1: the layered model "
+            "would replicate over every pp rank; set "
+            "Config(pp_stages=mesh pp) for the GPipe trunk"
+        )
     return Bert()
 
 

@@ -58,6 +58,7 @@ def pipeline_apply(
     n_microbatches: int,
     axis: str = "pp",
     remat: bool = False,
+    aux=None,
 ):
     """GPipe forward over ``mesh.shape[axis]`` stages; differentiable.
 
@@ -67,6 +68,13 @@ def pipeline_apply(
     pipelined trunk).  ``stage_params`` leaves have leading dim
     ``n_stages == mesh.shape[axis]``; ``x`` is the global batch, with
     ``x.shape[0] % n_microbatches == 0``.
+
+    ``aux`` (optional): a pytree of per-example arrays (leading dim ==
+    ``x.shape[0]``) that every stage needs alongside the activation — e.g.
+    an attention mask.  Aux is split into the same microbatches but NOT
+    pipelined: at each tick every rank indexes the microbatch it is
+    currently processing (``tick - rank``), and ``stage_fn`` is called as
+    ``stage_fn(params, activation, aux_microbatch)``.
 
     Composes with data parallelism: each microbatch's batch dim is sharded
     over ``(dp, fsdp)``, so a ``dp×pp`` mesh pipelines ``dp`` disjoint data
@@ -99,6 +107,19 @@ def pipeline_apply(
 
     micro = x.reshape((n_microbatches, x.shape[0] // n_microbatches)
                       + x.shape[1:])
+    aux_micro = None
+    if aux is not None:
+        for leaf in jax.tree_util.tree_leaves(aux):
+            if leaf.shape[0] != x.shape[0]:
+                raise ValueError(
+                    f"aux leaf leading dim {leaf.shape[0]} != batch "
+                    f"{x.shape[0]}"
+                )
+        aux_micro = jax.tree_util.tree_map(
+            lambda l: l.reshape((n_microbatches,
+                                 l.shape[0] // n_microbatches) + l.shape[1:]),
+            aux,
+        )
 
     # pp composes with data parallelism: each microbatch's batch dim is
     # sharded over (dp, fsdp), so every dp shard pipelines its own slice of
@@ -116,7 +137,7 @@ def pipeline_apply(
     data_spec = data_axes if len(data_axes) > 1 else (
         data_axes[0] if data_axes else None)
 
-    def _ranked(params, micro_in):
+    def _ranked(params, micro_in, aux_in):
         # inside shard_map: leaves have leading dim 1 (this rank's stage)
         my = jax.tree_util.tree_map(lambda l: l[0], params)
         rank = jax.lax.axis_index(axis)
@@ -134,7 +155,13 @@ def pipeline_apply(
             recv = carry  # activation handed to us at the end of tick t-1
             inject = queue[jnp.minimum(t, n_ticks - 1)]
             inp = jnp.where(rank == 0, inject, recv)
-            out = stage_fn(my, inp)
+            if aux_in is None:
+                out = stage_fn(my, inp)
+            else:
+                # the microbatch this rank works on at tick t is t - rank
+                mb = jnp.clip(t - rank, 0, m - 1)
+                a = jax.tree_util.tree_map(lambda q: q[mb], aux_in)
+                out = stage_fn(my, inp, a)
             # hand to the next stage (ring; last->0 edge carries garbage
             # that rank 0 overwrites with its injection next tick)
             handed = jax.lax.ppermute(out, axis, fwd)
@@ -152,11 +179,23 @@ def pipeline_apply(
                          jnp.zeros_like(result))
         return jax.lax.psum(mine, axis)  # (m, b_local, ...)
 
-    sm = _shard_map(
-        _ranked,
-        mesh,
-        in_specs=(P(axis), P(None, data_spec)),
-        out_specs=P(None, data_spec),
-    )
-    out = sm(stage_params, micro)  # (M, B/M, ...) global view
+    if aux_micro is None:
+        sm = _shard_map(
+            lambda p, m_: _ranked(p, m_, None),
+            mesh,
+            in_specs=(P(axis), P(None, data_spec)),
+            out_specs=P(None, data_spec),
+        )
+        out = sm(stage_params, micro)  # (M, B/M, ...) global view
+    else:
+        aux_spec = jax.tree_util.tree_map(
+            lambda _: P(None, data_spec), aux_micro
+        )
+        sm = _shard_map(
+            _ranked,
+            mesh,
+            in_specs=(P(axis), P(None, data_spec), aux_spec),
+            out_specs=P(None, data_spec),
+        )
+        out = sm(stage_params, micro, aux_micro)
     return out.reshape((x.shape[0],) + out.shape[2:])
