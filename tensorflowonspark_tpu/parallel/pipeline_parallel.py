@@ -155,7 +155,7 @@ def pipeline_apply(
             recv = carry  # activation handed to us at the end of tick t-1
             inject = queue[jnp.minimum(t, n_ticks - 1)]
             inp = jnp.where(rank == 0, inject, recv)
-            if aux_in is None:
+            if aux_micro is None:
                 out = stage_fn(my, inp)
             else:
                 # the microbatch this rank works on at tick t is t - rank
@@ -179,23 +179,16 @@ def pipeline_apply(
                          jnp.zeros_like(result))
         return jax.lax.psum(mine, axis)  # (m, b_local, ...)
 
-    if aux_micro is None:
-        sm = _shard_map(
-            lambda p, m_: _ranked(p, m_, None),
-            mesh,
-            in_specs=(P(axis), P(None, data_spec)),
-            out_specs=P(None, data_spec),
-        )
-        out = sm(stage_params, micro)  # (M, B/M, ...) global view
-    else:
-        aux_spec = jax.tree_util.tree_map(
-            lambda _: P(None, data_spec), aux_micro
-        )
-        sm = _shard_map(
-            _ranked,
-            mesh,
-            in_specs=(P(axis), P(None, data_spec), aux_spec),
-            out_specs=P(None, data_spec),
-        )
-        out = sm(stage_params, micro, aux_micro)
+    # no-aux is the empty pytree: same shard_map shape either way
+    aux_operand = aux_micro if aux_micro is not None else ()
+    aux_spec = jax.tree_util.tree_map(
+        lambda _: P(None, data_spec), aux_operand
+    )
+    sm = _shard_map(
+        _ranked,
+        mesh,
+        in_specs=(P(axis), P(None, data_spec), aux_spec),
+        out_specs=P(None, data_spec),
+    )
+    out = sm(stage_params, micro, aux_operand)  # (M, B/M, ...) global view
     return out.reshape((x.shape[0],) + out.shape[2:])

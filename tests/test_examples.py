@@ -89,3 +89,13 @@ def test_inception_spark_example_synthetic(capsys):
               "--arch", "inception_v3"])
     out = capsys.readouterr().out
     assert "cluster total:" in out and "images/sec" in out
+
+
+def test_bert_squad_example_pipeline_parallel(capsys):
+    """--pp 2: the GPipe stacked trunk through the full cluster path."""
+    mod = _load("bert", "bert_squad")
+    mod.main(["--cluster_size", "2", "--epochs", "1", "--tiny",
+              "--num_samples", "64", "--batch_size", "8",
+              "--seq_len", "32", "--pp", "2", "--pp_microbatches", "2"])
+    out = capsys.readouterr().out
+    assert "'pp': 2" in out
