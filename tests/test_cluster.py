@@ -201,3 +201,63 @@ def test_train_requires_spark_mode(sc):
     with pytest.raises(RuntimeError, match="InputMode.SPARK"):
         cluster.train(sc.parallelize([1], 1))
     cluster.shutdown(grace_secs=30)
+
+
+def ckpt_train_fun(args, ctx):
+    """Trainer-based map_fun exercising the restart-from-checkpoint model
+    (SURVEY §5: fail fast, resume from the last checkpoint)."""
+    from tensorflowonspark_tpu import util
+
+    util.ensure_jax_platform()
+    import numpy as np
+
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    t = Trainer("mnist_mlp", learning_rate=1e-2)
+    if args.restore:
+        t.restore(args.model_dir)
+    feed = ctx.get_data_feed(train_mode=True, input_mapping=["image", "label"])
+    while not feed.should_stop():
+        batch = feed.next_batch(32)
+        if not batch or batch["image"].shape[0] == 0:
+            continue
+        t.step({"image": np.asarray(batch["image"], np.float32),
+                "label": np.asarray(batch["label"], np.int32)})
+    ctx.mgr.set("step_count", int(t.state.step))
+    if ctx.job_name == "chief":
+        t.save(args.model_dir)
+
+
+def test_checkpoint_restart_through_cluster(sc, tmp_path):
+    """Job 1 trains and checkpoints; job 2 restores and CONTINUES — the
+    step counter carries across cluster restarts (the documented recovery
+    model: spark.task.maxFailures=1 + restart from checkpoint)."""
+    import argparse
+
+    rng = np.random.default_rng(0)
+    data = [(rng.random(64).astype(np.float32), int(i % 10))
+            for i in range(256)]
+    model_dir = str(tmp_path / "ckpt")
+
+    def run_job(restore):
+        args = argparse.Namespace(model_dir=model_dir, restore=restore)
+        cluster = TFCluster.run(sc, ckpt_train_fun, tf_args=args,
+                                num_executors=2, master_node="chief",
+                                input_mode=TFCluster.InputMode.SPARK)
+        cluster.train(sc.parallelize(data, 2), num_epochs=2,
+                      feed_timeout=120)
+        cluster.shutdown(grace_secs=30)
+        authkey = bytes.fromhex(cluster.cluster_meta["authkey_hex"])
+        return {
+            meta["job_name"]: TFManager.connect(
+                tuple(meta["addr"]), authkey).get("step_count")
+            for meta in cluster.cluster_info
+        }
+
+    first = run_job(restore=False)
+    assert all(s and s > 0 for s in first.values()), first
+    second = run_job(restore=True)
+    # every node restored the chief's checkpoint: its counter continues
+    # from the first job's chief step count instead of restarting at zero
+    for job, steps in second.items():
+        assert steps > first["chief"], (first, second)
