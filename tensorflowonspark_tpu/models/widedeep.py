@@ -187,9 +187,43 @@ def make_forward_fn(module, config: Config):
     return forward
 
 
+def make_collection_shardings(config: Config, mesh):
+    """Vocab-shard the embedding tables (and accumulators) over ``tp``.
+
+    The capacity story for tables too large for one chip's HBM: with
+    ``tp > 1`` each device stores ``1/tp`` of the fused table and its
+    AdaGrad state (dim 0 = the vocab dim; ``DEFAULT_RULES`` maps the
+    ``vocab`` logical axis to ``tp``).  Lookups on a vocab-sharded table
+    partition as masked local gathers + psum under jit's global view; the
+    dense update stays elementwise on the shards.  Returns ``None`` (fully
+    replicated tables) when ``tp == 1`` or the bucket count doesn't divide.
+    """
+    import logging
+
+    from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+
+    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+    if tp <= 1:
+        return None
+    if config.total_buckets % tp:
+        logging.getLogger(__name__).warning(
+            "embedding tables will be REPLICATED on every device: "
+            "total_buckets=%d does not divide tp=%d (the vocab-sharding "
+            "capacity saving is lost; pick hash_buckets so 26*hash_buckets "
+            "%% tp == 0)", config.total_buckets, tp,
+        )
+        return None
+    vocab2d = mesh_lib.named_sharding(mesh, "tp", None)
+    vocab1d = mesh_lib.named_sharding(mesh, "tp")
+    return {
+        "embedding": {"deep": vocab2d, "wide": vocab1d},
+        "embedding_opt": {"deep_acc": vocab2d, "wide_acc": vocab1d},
+    }
+
+
 def make_sharded_train_step(module, config: Config, optimizer, mesh,
                             param_shardings, state, batch_example,
-                            sequence_axes=None):
+                            sequence_axes=None, collection_shardings=None):
     """The model-supplied train step the ``Trainer`` picks up.
 
     MLP tower: ``optimizer`` (optax) over ``state.params``.  Tables: AdaGrad
@@ -274,9 +308,13 @@ def make_sharded_train_step(module, config: Config, optimizer, mesh,
         return train_lib.TrainState(params, opt_state, st.step + 1,
                                     cols), loss
 
+    if collection_shardings is None:
+        # direct callers (not via Trainer, which passes the hook's result)
+        collection_shardings = make_collection_shardings(config, mesh)
     return train_lib.compile_step(
         _step, mesh, param_shardings, state, batch_example,
         sequence_axes=sequence_axes,
+        collection_shardings=collection_shardings,
     )
 
 

@@ -77,7 +77,24 @@ def create_train_state(params, optimizer, collections=None):
                       unbox(collections) if collections else {})
 
 
-def state_shardings(state: TrainState, param_shardings, mesh):
+def merge_collection_shardings(collections, mesh, overrides=None):
+    """Per-collection shardings: a model-prescribed override wins, every
+    other collection replicates.  The one merge used by init
+    (``Trainer.__init__``), train (``state_shardings``), and eval
+    (``make_eval_step``) compilation, so the three can't diverge."""
+    import jax
+
+    overrides = overrides or {}
+    return {
+        name: (overrides[name] if name in overrides
+               else jax.tree_util.tree_map(
+                   lambda _: mesh_lib.replicated(mesh), tree))
+        for name, tree in (collections or {}).items()
+    }
+
+
+def state_shardings(state: TrainState, param_shardings, mesh,
+                    collection_shardings=None):
     """Shardings for the full train state.
 
     Optimizer-state leaves carry the sharding the eager ``optimizer.init``
@@ -86,6 +103,11 @@ def state_shardings(state: TrainState, param_shardings, mesh):
     layout, including ZeRO ``fsdp`` sharding (the ``num_ps`` mapping).
     Leaves without a mesh sharding (step counts, EMA decay scalars)
     replicate.
+
+    ``collection_shardings`` optionally maps a collection name to a pytree
+    of shardings for its leaves (e.g. wide&deep's embedding tables sharded
+    over the vocab dim — the module hook ``make_collection_shardings``);
+    unnamed collections replicate as before.
     """
     import jax
 
@@ -131,12 +153,11 @@ def state_shardings(state: TrainState, param_shardings, mesh):
             "(ZeRO memory savings lost for them); shapes: %s",
             len(degraded), degraded[:5],
         )
-    # non-param collections (batch_stats running averages) replicate: their
-    # batch-dim reductions are global under pjit view, so every device holds
-    # the same per-channel vectors
-    col_shardings = jax.tree_util.tree_map(
-        lambda _: mesh_lib.replicated(mesh), state.collections
-    )
+    # non-param collections (batch_stats running averages) replicate unless
+    # the model prescribed a sharding for them: their batch-dim reductions
+    # are global under pjit view, so every device holds the same values
+    col_shardings = merge_collection_shardings(
+        state.collections, mesh, collection_shardings)
     return TrainState(param_shardings, opt_shardings,
                       mesh_lib.replicated(mesh), col_shardings)
 
@@ -202,6 +223,7 @@ def compile_step(
     batch_example: Any,
     sequence_axes: dict[str, int] | None = None,
     donate: bool = True,
+    collection_shardings=None,
 ):
     """Jit an arbitrary ``state, batch -> state, loss`` step over the mesh.
 
@@ -214,7 +236,8 @@ def compile_step(
     """
     import jax
 
-    shardings = state_shardings(state, param_shardings, mesh)
+    shardings = state_shardings(state, param_shardings, mesh,
+                                collection_shardings=collection_shardings)
     batch_shardings = _batch_shardings(mesh, batch_example, sequence_axes)
 
     return _MeshBoundFn(
@@ -250,6 +273,7 @@ def make_train_step(
     batch_example: Any,
     sequence_axes: dict[str, int] | None = None,
     donate: bool = True,
+    collection_shardings=None,
 ):
     """Compile ``state, batch -> state, loss`` over the mesh.
 
@@ -288,25 +312,26 @@ def make_train_step(
         return TrainState(params, opt_state, st.step + 1, new_cols), loss
 
     return compile_step(_step, mesh, param_shardings, state, batch_example,
-                        sequence_axes=sequence_axes, donate=donate)
+                        sequence_axes=sequence_axes, donate=donate,
+                        collection_shardings=collection_shardings)
 
 
 def make_eval_step(forward_fn, mesh, param_shardings, batch_example,
                    sequence_axes: dict[str, int] | None = None,
-                   collections=None):
+                   collections=None, collection_shardings=None):
     """Compile a sharded ``params, batch -> outputs`` inference step.
 
     A stateful forward (``forward_fn.stateful`` truthy) has signature
     ``forward_fn(params, collections, batch)`` — BatchNorm running stats are
-    read (not updated) at eval time.
+    read (not updated) at eval time.  ``collection_shardings`` mirrors
+    :func:`state_shardings`' option (model-prescribed table shardings).
     """
     import jax
 
     batch_shardings = _batch_shardings(mesh, batch_example, sequence_axes)
     if getattr(forward_fn, "stateful", False):
-        col_shardings = jax.tree_util.tree_map(
-            lambda _: mesh_lib.replicated(mesh), collections or {}
-        )
+        col_shardings = merge_collection_shardings(
+            collections, mesh, collection_shardings)
         return _MeshBoundFn(
             jax.jit(
                 forward_fn,

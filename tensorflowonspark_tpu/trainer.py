@@ -101,9 +101,16 @@ class Trainer:
             self.param_shardings = apply_zero_sharding(
                 self.param_shardings, self.mesh, unbox(boxed_shapes)
             )
-        col_shardings = jax.tree_util.tree_map(
-            lambda _: mesh_lib.replicated(self.mesh), unbox(col_shapes)
+        # a model module may prescribe shardings for its collections (e.g.
+        # wide&deep's vocab-sharded embedding tables); others replicate
+        from tensorflowonspark_tpu.parallel.train import (
+            merge_collection_shardings,
         )
+
+        mk_cs = getattr(self.module_lib, "make_collection_shardings", None)
+        col_overrides = (mk_cs(self.config, self.mesh) or {}) if mk_cs else {}
+        col_shardings = merge_collection_shardings(
+            unbox(col_shapes), self.mesh, col_overrides)
 
         # sharded init: params materialise already laid out across the mesh
         def _init():
@@ -128,16 +135,19 @@ class Trainer:
                 self.model, self.config, self.optimizer, self.mesh,
                 self.param_shardings, self.state, example,
                 sequence_axes=self.sequence_axes,
+                collection_shardings=col_overrides or None,
             )
         else:
             self.train_step = make_train_step(
                 self.loss_fn, self.optimizer, self.mesh, self.param_shardings,
                 self.state, example, sequence_axes=self.sequence_axes,
+                collection_shardings=col_overrides or None,
             )
         self.eval_step = make_eval_step(
             self.forward_fn, self.mesh, self.param_shardings,
             example, sequence_axes=self.sequence_axes,
             collections=self.state.collections,
+            collection_shardings=col_overrides or None,
         )
 
     # -- stepping ------------------------------------------------------------
