@@ -60,3 +60,16 @@ def test_queue_maxsize_backpressure():
             q.put(3, block=False)
     finally:
         m.shutdown()
+
+
+def test_wrong_authkey_rejected(mgr):
+    """A peer with the wrong authkey must not reach the queues (the data
+    plane's authentication — same contract as the reservation token)."""
+    from multiprocessing.context import AuthenticationError
+
+    with pytest.raises((AuthenticationError, OSError)):
+        TFManager.connect(mgr.address, b"not-the-secret")
+    # the real key still works afterwards
+    ok = TFManager.connect(mgr.address, b"secret")
+    ok.get_queue("input").put(1)
+    assert ok.get_queue("input").get(timeout=5) == 1

@@ -118,3 +118,44 @@ def test_request_stop():
     # after stop, new connections fail
     with pytest.raises((ConnectionError, OSError, RuntimeError)):
         c.register({"executor_id": 0})
+
+
+def test_server_survives_garbage_and_oversized_bytes():
+    """Hostile/broken peers at the reservation port (random bytes, an
+    oversized length prefix, an abrupt disconnect) must not take the
+    control plane down — a later legitimate client still registers."""
+    import socket
+    import struct
+
+    server = reservation.Server(count=1)
+    addr = server.start()
+
+    # 1. pure garbage (not even a length prefix worth of structure)
+    s = socket.create_connection(addr, timeout=5)
+    s.sendall(b"\xde\xad\xbe\xef" * 16)
+    s.close()
+
+    # 2. oversized length prefix (> _MAX_MSG): the server must actively
+    #    refuse (close the connection), not sit in a 1 GiB recv — keep our
+    #    end open so a missing guard shows up as a hang/timeout here
+    s = socket.create_connection(addr, timeout=5)
+    s.settimeout(5)
+    s.sendall(struct.pack(">I", 1 << 30) + b"x" * 64)
+    assert s.recv(1) == b""  # EOF: server dropped us
+    s.close()
+
+    # 3. valid length prefix, truncated body, abrupt close mid-message
+    s = socket.create_connection(addr, timeout=5)
+    s.sendall(struct.pack(">I", 1024) + b"{")
+    s.close()
+
+    # 4. valid length, non-JSON body
+    s = socket.create_connection(addr, timeout=5)
+    payload = b"\x00\x01\x02 not json"
+    s.sendall(struct.pack(">I", len(payload)) + payload)
+    s.close()
+
+    good = reservation.Client(addr, server.auth_token)
+    good.register({"executor_id": 0})
+    assert server.await_reservations(timeout=5.0)
+    server.stop()
