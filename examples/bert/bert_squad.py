@@ -64,10 +64,8 @@ def map_fun(args, ctx):
     ctx.mgr.set("steps", steps)
     ctx.mgr.set("mesh", dict(trainer.mesh.shape))
     if args.model_dir and ctx.executor_id == 0:
-        from tensorflowonspark_tpu import compat
-
-        compat.export_saved_model(
-            {"params": trainer.params}, ctx.absolute_path(args.model_dir))
+        # weights + serialized forward + signature (SavedModel parity)
+        trainer.export(ctx.absolute_path(args.model_dir))
 
 
 def synth_squad(n: int, vocab: int, seq_len: int, seed: int = 0):

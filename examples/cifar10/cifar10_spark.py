@@ -86,10 +86,8 @@ def map_fun(args, ctx):
     ctx.mgr.set("shard_files", [os.path.basename(f) for f in shard])
     ctx.mgr.set("examples_per_sec", snap["examples_per_sec"])
     if args.model_dir and ctx.executor_id == 0:
-        from tensorflowonspark_tpu import compat
-
-        compat.export_saved_model(
-            {"params": trainer.params}, ctx.absolute_path(args.model_dir))
+        # weights + serialized forward + signature (SavedModel parity)
+        trainer.export(ctx.absolute_path(args.model_dir))
 
 
 def prep_tfrecords(spark, data_dir: str, n: int, parts: int, side: int,

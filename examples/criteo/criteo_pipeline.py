@@ -54,20 +54,9 @@ def train_fun(args, ctx):
     ctx.mgr.set("final_loss", float(loss) if loss is not None else None)
     ctx.mgr.set("steps", steps)
     if ctx.job_name == "chief":
-        from tensorflowonspark_tpu import compat
-
-        export = {"params": trainer.params}
-        serving_cols = {
-            # stateful models (wide&deep's embedding tables, BatchNorm
-            # stats) serve from their collections as much as their params —
-            # but optimizer-state collections (the sparse engine's per-row
-            # accumulators) are dead weight at serving time
-            k: v for k, v in trainer.state.collections.items()
-            if not k.endswith("_opt")
-        }
-        if serving_cols:
-            export["collections"] = serving_cols
-        compat.export_saved_model(export, ctx.absolute_path(args.export_dir))
+        # weights + serving collections + serialized forward + signature;
+        # Trainer.export strips the sparse engine's _opt accumulators
+        trainer.export(ctx.absolute_path(args.export_dir))
 
 
 def synth_criteo(n: int, buckets: int, seed: int = 0):

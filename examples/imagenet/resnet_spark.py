@@ -89,10 +89,8 @@ def map_fun(args, ctx):
     ctx.mgr.set("final_loss",
                 float(np.asarray(loss).mean()) if loss is not None else None)
     if args.model_dir and ctx.executor_id == 0:
-        from tensorflowonspark_tpu import compat
-
-        compat.export_saved_model(
-            {"params": trainer.params}, ctx.absolute_path(args.model_dir))
+        # weights + serialized forward + signature (SavedModel parity)
+        trainer.export(ctx.absolute_path(args.model_dir))
 
 
 def prep_tfrecords(data_dir: str, n: int, parts: int, side: int,

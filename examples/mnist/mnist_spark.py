@@ -101,7 +101,17 @@ def map_fun(args, ctx):
         from tensorflowonspark_tpu import compat
 
         host_params = jax.tree.map(np.asarray, params)
-        compat.export_saved_model(host_params, ctx.absolute_path(args.model_dir))
+
+        def serve(state, batch):
+            # self-describing export: this closure is serialized as
+            # StableHLO, so TFModel/the JNI shim can serve the export with
+            # no access to this script (SavedModel parity)
+            return apply(state, batch["image"].astype(jnp.float32) / 255.0)
+
+        compat.export_saved_model(
+            host_params, ctx.absolute_path(args.model_dir),
+            forward_fn=serve,
+            example_batch={"image": np.zeros((1, 784), np.float32)})
 
 
 def synth_mnist(n: int, seed: int = 0):

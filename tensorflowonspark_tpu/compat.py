@@ -33,16 +33,37 @@ def disable_auto_shard(options) -> "object":
     return options
 
 
-def export_saved_model(model_state, export_dir: str) -> str:
+def export_saved_model(model_state, export_dir: str, *, forward_fn=None,
+                       example_batch=None, model_name: str | None = None,
+                       platforms=("cpu", "tpu")) -> str:
     """Export a trained model for serving/transform.
 
     Reference parity: ``compat.py::export_saved_model`` (TF SavedModel).  The
     TPU rebuild's export format is an Orbax-style checkpoint directory written
-    by :mod:`tensorflowonspark_tpu.ckpt`.  Only *state* is persisted; the
-    apply function is supplied by the consumer at load time (``TFModel``
-    takes it as a constructor/param argument), matching JAX's functional
-    split of code and data.
+    by :mod:`tensorflowonspark_tpu.ckpt`, plus — when ``forward_fn`` and
+    ``example_batch`` are given — a **self-describing forward**: the apply
+    function serialized as StableHLO with an input/output signature
+    (:mod:`tensorflowonspark_tpu.saved_model`), matching the reference
+    SavedModel's graph+weights+signature bundle.  Weights-only exports remain
+    valid; their consumers supply the forward via ``model_name``/``predict_fn``
+    at load time.
+
+    ``forward_fn`` must have the canonical serving signature
+    ``f(model_state, batch_dict) -> outputs`` (adapt zoo forwards with
+    :func:`saved_model.wrap_state_forward`); ``example_batch`` is a dict of
+    input-name → array with a leading batch dimension.
     """
     from tensorflowonspark_tpu import ckpt
 
-    return ckpt.save_pytree(model_state, os.path.join(export_dir, "model"))
+    path = ckpt.save_pytree(model_state, os.path.join(export_dir, "model"))
+    if forward_fn is not None:
+        if example_batch is None:
+            raise ValueError(
+                "export_saved_model(forward_fn=...) needs example_batch to "
+                "record the serving signature")
+        from tensorflowonspark_tpu import saved_model
+
+        saved_model.export_forward(
+            forward_fn, model_state, example_batch, export_dir,
+            model_name=model_name, platforms=platforms)
+    return path

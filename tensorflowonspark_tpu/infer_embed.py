@@ -35,7 +35,15 @@ _DTYPES = {0: np.float32, 1: np.int32, 2: np.int64}
 
 
 def load(export_dir: str, model_name: str = "") -> int:
-    """Load an Orbax export + model-zoo forward fn; returns a handle."""
+    """Load an export and its forward fn; returns a handle.
+
+    Prefers the **self-describing** path: when the export carries a
+    serialized forward + signature (``saved_model`` layout, the SavedModel
+    parity artifact), the model is served from the artifact alone and
+    ``model_name`` is ignored — a JVM can score models it has no Python
+    code for.  Weights-only exports fall back to rebuilding the forward
+    from the ``model_name`` zoo entry, as in rounds 1-3.
+    """
     from tensorflowonspark_tpu import util
 
     util.ensure_jax_platform()
@@ -43,9 +51,7 @@ def load(export_dir: str, model_name: str = "") -> int:
 
     import jax
 
-    from tensorflowonspark_tpu import ckpt
-    from tensorflowonspark_tpu import models as model_zoo
-    from tensorflowonspark_tpu.pipeline import _is_tiny
+    from tensorflowonspark_tpu import ckpt, saved_model
 
     path = export_dir
     model_sub = os.path.join(path, "model")
@@ -55,20 +61,34 @@ def load(export_dir: str, model_name: str = "") -> int:
     params = state.get("params", state) if isinstance(state, dict) else state
     collections = state.get("collections") if isinstance(state, dict) else None
 
-    lib = model_zoo.get_model(model_name)
-    config = lib.Config.tiny() if _is_tiny(params, lib) else lib.Config()
-    module = lib.make_model(config)
-    forward = lib.make_forward_fn(module, config)
-    if getattr(forward, "stateful", False):
-        cols = collections or {}
-        fn = jax.jit(lambda p, b: forward(p, cols, b))
+    output_order: list[str] | None = None
+    if saved_model.has_forward(export_dir):
+        fn, sig = saved_model.load_forward(export_dir)
+        params = state  # canonical serve(state, batch) takes the whole pytree
+        input_names = [i["name"] for i in sig["inputs"]]
+        output_order = [o["name"] for o in sig["outputs"]]
     else:
-        fn = jax.jit(forward)
+        from tensorflowonspark_tpu import models as model_zoo
+        from tensorflowonspark_tpu.pipeline import _is_tiny
 
-    # input names come from the zoo's example batch (labels stripped)
-    example = lib.example_batch(config, batch_size=1)
-    label_keys = {"label", "start_positions", "end_positions"}
-    input_names = [k for k in example if k not in label_keys]
+        if not model_name:
+            raise ValueError(
+                f"export at {export_dir} is weights-only (no saved_forward/) "
+                "— a model_name is required to rebuild the forward")
+        lib = model_zoo.get_model(model_name)
+        config = lib.Config.tiny() if _is_tiny(params, lib) else lib.Config()
+        module = lib.make_model(config)
+        forward = lib.make_forward_fn(module, config)
+        if getattr(forward, "stateful", False):
+            cols = collections or {}
+            fn = jax.jit(lambda p, b: forward(p, cols, b))
+        else:
+            fn = jax.jit(forward)
+
+        # input names come from the zoo's example batch (labels stripped)
+        example = lib.example_batch(config, batch_size=1)
+        label_keys = {"label", "start_positions", "end_positions"}
+        input_names = [k for k in example if k not in label_keys]
 
     with _LOCK:
         h = next(_NEXT)
@@ -76,6 +96,7 @@ def load(export_dir: str, model_name: str = "") -> int:
             "fn": fn,
             "params": params,
             "input_names": input_names,
+            "output_order": output_order,
             "inputs": {},
             "output": None,
         }
@@ -107,8 +128,9 @@ def run(handle: int) -> None:
     if missing:
         raise ValueError(f"inputs not set before run: {missing}")
     out = st["fn"](st["params"], dict(st["inputs"]))
-    if isinstance(out, dict):  # multi-output models: first output
-        out = next(iter(out.values()))
+    if isinstance(out, dict):  # multi-output models: first *declared* output
+        order = st.get("output_order")
+        out = out[order[0]] if order else next(iter(out.values()))
     st["output"] = np.asarray(out, dtype=np.float32)
     st["inputs"] = {}
 

@@ -31,9 +31,11 @@ _SRC = os.path.join(_DIR, "tfos_infer.cc")
 _SRC_JNI = os.path.join(_DIR, "tfos_infer_jni.cc")
 _SRC_CODEC = os.path.join(_DIR, "tfrecord_codec.cc")
 _SRC_DEMO = os.path.join(_DIR, "tfos_infer_main.c")
+_SRC_HARNESS = os.path.join(_DIR, "jni_harness.cc")
 _LIB = os.path.join(_DIR, "libtfos_infer.so")
 _LIB_JNI = os.path.join(_DIR, "libtfos_infer_jni.so")
 _DEMO = os.path.join(_DIR, "tfos_infer_demo")
+_HARNESS = os.path.join(_DIR, "tfos_jni_harness")
 
 _lock = threading.Lock()
 _lib_state: list = []  # [CDLL or None] once probed
@@ -81,6 +83,13 @@ def build(force: bool = False) -> bool:
             os.path.getmtime(_DEMO) < os.path.getmtime(_SRC_DEMO):
         _run(["g++", "-O2", _SRC_DEMO, "-o", _DEMO,
               f"-L{_DIR}", "-ltfos_infer", f"-Wl,-rpath,{_DIR}", *link])
+    # fake-JVM harness: EXECUTES the Java_* glue without a JDK (dlopens the
+    # JNI wrapper against a hand-built JNINativeInterface_ table)
+    if force or not os.path.exists(_HARNESS) or \
+            os.path.getmtime(_HARNESS) < max(os.path.getmtime(_SRC_HARNESS),
+                                             os.path.getmtime(_SRC_JNI)):
+        _run(["g++", "-O2", "-std=c++17", _SRC_HARNESS, "-o", _HARNESS,
+              "-ldl"])
     return os.path.exists(_LIB)
 
 
@@ -136,6 +145,12 @@ def jni_library() -> str | None:
     """Path of the JNI-loadable wrapper, if built."""
     build()
     return _LIB_JNI if os.path.exists(_LIB_JNI) else None
+
+
+def jni_harness() -> str | None:
+    """Path of the fake-JVM harness that executes the Java_* glue, if built."""
+    build()
+    return _HARNESS if os.path.exists(_HARNESS) else None
 
 
 class Session:

@@ -1,0 +1,103 @@
+package com.tensorflowonspark.tpu;
+
+import java.util.LinkedHashMap;
+import java.util.Map;
+
+/**
+ * Handle-owning convenience wrapper over the raw {@link TFosInference}
+ * natives — the JVM analogue of the Python {@code infer_native.Session}.
+ *
+ * <p>Spark-free by design: this class compiles with a bare {@code javac}
+ * (no Spark on the classpath), so the native call protocol is testable
+ * wherever a JDK exists; the Spark {@code DataFrame} adapter
+ * ({@code spark/TFosModel.java}) layers row batching on top.
+ *
+ * <p>Reference anchor: the reference's Scala inference API wrapped the TF
+ * Java API's {@code Session.Runner} the same way (SURVEY.md §2.2 row 1);
+ * here the "session" is an export served by the embedded XLA forward —
+ * self-describing exports ({@code saved_forward/} present) need no
+ * {@code modelName} at all.
+ */
+public final class TFosSession implements AutoCloseable {
+  private long handle;
+
+  /** Staged input dtypes, for introspection/debugging. */
+  private final Map<String, String> staged = new LinkedHashMap<>();
+
+  /**
+   * Load an export directory produced by
+   * {@code tensorflowonspark_tpu.compat.export_saved_model} /
+   * {@code Trainer.export}.
+   *
+   * @param exportDir export directory (local path visible to this executor)
+   * @param modelName zoo model name; pass {@code ""} for self-describing
+   *                  exports (the signature in the artifact wins)
+   */
+  public TFosSession(String exportDir, String modelName) {
+    this.handle = TFosInference.load(exportDir, modelName == null ? "" : modelName);
+  }
+
+  private void ensureOpen() {
+    if (handle <= 0) {
+      throw new IllegalStateException("TFosSession is closed");
+    }
+  }
+
+  /** Stage a float32 tensor ({@code ""} = the model's single input). */
+  public TFosSession feed(String name, float[] data, long[] shape) {
+    ensureOpen();
+    TFosInference.setInput(handle, name, data, shape);
+    staged.put(name, "float32");
+    return this;
+  }
+
+  /** Stage an int32 tensor (categorical ids, token ids). */
+  public TFosSession feed(String name, int[] data, long[] shape) {
+    ensureOpen();
+    TFosInference.setInputInts(handle, name, data, shape);
+    staged.put(name, "int32");
+    return this;
+  }
+
+  /** Stage an int64 tensor. */
+  public TFosSession feed(String name, long[] data, long[] shape) {
+    ensureOpen();
+    TFosInference.setInputLongs(handle, name, data, shape);
+    staged.put(name, "int64");
+    return this;
+  }
+
+  /** Execute the compiled forward over all staged inputs. */
+  public void run() {
+    ensureOpen();
+    TFosInference.run(handle);
+    staged.clear();
+  }
+
+  /** Shape of the float32 output of the last {@link #run()}. */
+  public long[] outputShape() {
+    ensureOpen();
+    return TFosInference.outputShape(handle);
+  }
+
+  /** The output of the last {@link #run()}, flattened row-major. */
+  public float[] output() {
+    ensureOpen();
+    return TFosInference.getOutput(handle);
+  }
+
+  /** Single-input convenience: feed → run → output. */
+  public float[] predict(float[] data, long[] shape) {
+    feed("", data, shape);
+    run();
+    return output();
+  }
+
+  @Override
+  public void close() {
+    if (handle > 0) {
+      TFosInference.close(handle);
+      handle = -1;
+    }
+  }
+}

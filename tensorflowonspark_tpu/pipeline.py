@@ -268,9 +268,12 @@ class TFModel(TFParams, HasBatchSize, HasInputMapping, HasOutputMapping,
 
     Reference anchor: ``pipeline.py::TFModel`` — no cluster is formed;
     each executor loads the exported model once and maps its partitions.
-    Supply the apply function either via ``model_name`` (a
-    ``tensorflowonspark_tpu.models`` zoo entry, rebuilt on the executor) or
-    ``predict_fn`` (a picklable ``f(params, inputs_dict) -> outputs``).
+    The apply function comes from, in precedence order: an explicit
+    ``predict_fn`` (a picklable ``f(params, inputs_dict) -> outputs``), the
+    export's own serialized forward when it is self-describing
+    (``saved_model.py`` — the SavedModel-parity path, no model code
+    needed), or ``model_name`` (a ``tensorflowonspark_tpu.models`` zoo
+    entry, rebuilt on the executor).
     """
 
     def __init__(self, tf_args: Any = None,
@@ -357,6 +360,8 @@ class _RunModel:
     def _load(self):
         import os
 
+        from tensorflowonspark_tpu import saved_model
+
         path = self.export_dir
         model_sub = os.path.join(path, "model")
         if "://" not in path and os.path.isdir(model_sub):
@@ -367,7 +372,12 @@ class _RunModel:
                 mtime = os.path.getmtime(path)
             except OSError:
                 pass
-        fn_id = getattr(self.predict_fn, "__qualname__", self.model_name)
+        # precedence: an explicitly passed predict_fn (user intent) beats
+        # the artifact's serialized forward, which beats model_name
+        serialized = (self.predict_fn is None
+                      and saved_model.has_forward(self.export_dir))
+        fn_id = ("saved_forward" if serialized else
+                 getattr(self.predict_fn, "__qualname__", self.model_name))
         key = (path, fn_id, mtime)
         if key in _MODEL_CACHE:
             return _MODEL_CACHE[key]
@@ -378,6 +388,14 @@ class _RunModel:
         params = state.get("params", state) if isinstance(state, dict) else state
         collections = state.get("collections") if isinstance(state, dict) else None
 
+        if serialized:
+            # self-describing export: serve from the artifact alone — no
+            # model code needed (the SavedModel-parity path)
+            fn, _sig = saved_model.load_forward(self.export_dir)
+            _MODEL_CACHE[key] = (fn, state)
+            logger.info("executor loaded serialized forward from %s",
+                        self.export_dir)
+            return fn, state
         if self.predict_fn is not None:
             fn = self.predict_fn
         elif self.model_name:

@@ -1,0 +1,328 @@
+"""Self-describing model exports: serialized forward + signature + weights.
+
+Reference anchor: a TF SavedModel is *self-describing* — it carries graph,
+weights, and a signature, and serving resolves input/output tensors from the
+artifact alone (``tensorflowonspark/pipeline.py::TFModel`` "loads SavedModel
+(signature → input/output tensor mapping)", ``SURVEY.md §2.1`` pipeline row
+and ``§3.4`` call stack).  Rounds 1-3 exported a weights-only Orbax pytree,
+so every serving path needed the model code (zoo ``model_name`` or a user
+``predict_fn``) to rebuild the forward.  This module closes that gap the
+TPU-native way: the forward is serialized as **StableHLO via
+:func:`jax.export.export`** — compiler IR instead of a TF graph — next to the
+weights, with a JSON signature recording input/output names, dtypes and
+shapes.  A consumer (``pipeline.TFModel``, the JNI shim's
+``infer_embed.load``, or plain :func:`load_forward`) can then serve a model
+it has no Python code for.
+
+Export layout (under ``export_dir``)::
+
+    model/                      Orbax pytree checkpoint (weights; existing)
+    saved_forward/forward.bin   jax.export serialized artifact (StableHLO)
+    saved_forward/signature.json  input/output signature + format metadata
+
+The serialized callable has the canonical serving signature
+``serve(state, batch) -> outputs`` where ``state`` is exactly the pytree
+stored in ``model/`` and ``batch`` is a dict of input-name → array.  The
+batch dimension is exported **shape-polymorphic** when the model traces
+under a symbolic batch size; otherwise a fixed-batch artifact is written
+and :func:`load_forward` chunk-pads batches to the exported size.
+
+Artifacts are lowered for ``("cpu", "tpu")`` by default so an export
+written on a TPU host serves on CPU executors and vice versa.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import posixpath
+from typing import Any, Callable, Mapping, Sequence
+
+logger = logging.getLogger(__name__)
+
+FORMAT = "tfos-stablehlo-v1"
+_SUBDIR = "saved_forward"
+_FORWARD_FILE = "forward.bin"
+_SIGNATURE_FILE = "signature.json"
+
+
+def _join(base: str, *parts: str) -> str:
+    if "://" in base:
+        return posixpath.join(base, *parts)
+    import os
+
+    return os.path.join(base, *parts)
+
+
+def _spec_of(leaf) -> "Any":
+    import jax
+    import numpy as np
+
+    a = np.asarray(leaf) if not hasattr(leaf, "shape") else leaf
+    return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+
+
+def _batch_specs(example_batch: Mapping[str, Any], batch_dim) -> dict:
+    """Input specs with the leading axis replaced by ``batch_dim`` (or kept
+    concrete when ``batch_dim`` is None)."""
+    import jax
+    import numpy as np
+
+    specs = {}
+    for name, arr in example_batch.items():
+        arr = np.asarray(arr)
+        if batch_dim is not None and arr.ndim >= 1:
+            specs[name] = jax.ShapeDtypeStruct(
+                (batch_dim,) + tuple(arr.shape[1:]), arr.dtype)
+        else:
+            specs[name] = jax.ShapeDtypeStruct(tuple(arr.shape), arr.dtype)
+    return specs
+
+
+def _shape_json(shape) -> list:
+    """Shape tuple → JSON list; symbolic/polymorphic dims become None."""
+    out = []
+    for d in shape:
+        out.append(int(d) if isinstance(d, int) else None)
+    return out
+
+
+def _signature_entry(name: str, aval) -> dict:
+    return {
+        "name": name,
+        "shape": _shape_json(aval.shape),
+        "dtype": str(aval.dtype),
+    }
+
+
+def wrap_state_forward(forward: Callable) -> Callable:
+    """Adapt a zoo-style forward to the canonical ``serve(state, batch)``.
+
+    Zoo forwards are ``forward(params, batch)`` or — when tagged
+    ``forward.stateful`` (BatchNorm collections) —
+    ``forward(params, collections, batch)``; exports store
+    ``{"params": ..., "collections": ...}``, ``{"params": ...}``, or a bare
+    params pytree.  The returned callable unpacks whichever layout ``state``
+    uses and routes to the right arity.
+    """
+    stateful = bool(getattr(forward, "stateful", False))
+
+    def serve(state, batch):
+        if isinstance(state, Mapping) and "params" in state:
+            params = state["params"]
+            collections = state.get("collections") or {}
+        else:
+            params, collections = state, {}
+        if stateful:
+            return forward(params, collections, batch)
+        return forward(params, batch)
+
+    return serve
+
+
+def export_forward(
+    forward_fn: Callable[[Any, dict], Any],
+    state: Any,
+    example_batch: Mapping[str, Any],
+    export_dir: str,
+    *,
+    model_name: str | None = None,
+    platforms: Sequence[str] = ("cpu", "tpu"),
+    poly_batch: bool = True,
+) -> str:
+    """Serialize ``forward_fn(state, batch)`` + signature under ``export_dir``.
+
+    ``state`` must be the same pytree structure the weights checkpoint holds
+    (what ``ckpt.load_pytree`` will return at serving time); ``example_batch``
+    is a dict of input-name → array with a leading batch dimension.  Tries a
+    shape-polymorphic batch first so serving accepts any batch size; models
+    whose lowering rejects symbolic shapes fall back to a fixed-batch
+    artifact (recorded in the signature; the loader chunk-pads).
+    """
+    import jax
+    import numpy as np
+    from jax import export as jax_export
+
+    from tensorflowonspark_tpu import fs
+
+    # Specs against the *checkpoint-roundtripped* structure: Orbax restores
+    # plain nested dicts, and jax.export pins the input pytree structure, so
+    # export against that form — not e.g. a FrozenDict.  Shapes/dtypes only:
+    # never materialize the (possibly multi-host-sharded) values here.
+    state_spec = jax.tree.map(_spec_of, _plain(state))
+
+    fixed_batch = int(np.asarray(next(iter(example_batch.values()))).shape[0])
+    attempts = []
+    if poly_batch:
+        attempts.append(("polymorphic", jax_export.symbolic_shape("b")[0]))
+    attempts.append((fixed_batch, None))
+
+    # JAX pytree flattening sorts dict keys, so the *authored* output order
+    # (what the C-ABI "first output" convention means) would be lost.
+    # Observe the dict the forward literally returns during the export
+    # trace, before flattening.
+    authored_order: list[str] = []
+
+    def recording_forward(state, batch):
+        out = forward_fn(state, batch)
+        if isinstance(out, Mapping):
+            authored_order[:] = list(out.keys())
+        return out
+
+    exported = None
+    batch_mode: Any = None
+    last_err: Exception | None = None
+    for mode, dim in attempts:
+        try:
+            specs = _batch_specs(example_batch, dim)
+            exported = jax_export.export(
+                jax.jit(recording_forward), platforms=tuple(platforms)
+            )(state_spec, specs)
+            batch_mode = mode
+            break
+        except Exception as e:  # symbolic-shape lowering is best-effort
+            last_err = e
+            if mode == "polymorphic":
+                logger.info(
+                    "polymorphic-batch export failed (%s); retrying with "
+                    "fixed batch %d", e, fixed_batch)
+    if exported is None:
+        raise RuntimeError(
+            f"could not serialize forward for {export_dir}") from last_err
+
+    outputs = _output_entries(exported, authored_order)
+    signature = {
+        "format": FORMAT,
+        "model_name": model_name,
+        "batch": "polymorphic" if batch_mode == "polymorphic" else batch_mode,
+        "inputs": [
+            _signature_entry(name, _spec_of(np.asarray(arr)))
+            if batch_mode != "polymorphic"
+            else {
+                "name": name,
+                "shape": [None] + _shape_json(np.asarray(arr).shape[1:]),
+                "dtype": str(np.asarray(arr).dtype),
+            }
+            for name, arr in example_batch.items()
+        ],
+        "outputs": outputs,
+        "platforms": list(platforms),
+    }
+
+    sub = _join(export_dir, _SUBDIR)
+    fs.makedirs(sub)
+    with fs.open(_join(sub, _FORWARD_FILE), "wb") as f:
+        f.write(exported.serialize())
+    with fs.open(_join(sub, _SIGNATURE_FILE), "wb") as f:
+        f.write(json.dumps(signature, indent=1).encode())
+    logger.info(
+        "saved self-describing forward (%s batch, platforms=%s) under %s",
+        signature["batch"], list(platforms), sub)
+    return sub
+
+
+def _plain(tree):
+    """Mappings → plain dicts recursively (match Orbax's restored structure)."""
+    if isinstance(tree, Mapping):
+        return {k: _plain(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_plain(v) for v in tree)
+    return tree
+
+
+def _output_entries(exported, authored_order: list[str]) -> list[dict]:
+    """Name the exported outputs: dict keys when the output is a dict,
+    positional ``output_i`` otherwise — listed in *authored* order (the
+    C-ABI/JNI shim's single-output convention is "first declared output"),
+    with possibly-polymorphic shapes from the exported avals."""
+    import jax
+
+    leaves_with_path = jax.tree_util.tree_flatten_with_path(
+        jax.tree_util.tree_unflatten(
+            exported.out_tree, list(exported.out_avals))
+    )[0]
+    by_name = {}
+    entries = []
+    for i, (keypath, aval) in enumerate(leaves_with_path):
+        if keypath:
+            name = "/".join(
+                str(getattr(k, "key", getattr(k, "idx", k))) for k in keypath)
+        else:
+            name = f"output_{i}"
+        by_name[name] = _signature_entry(name, aval)
+        entries.append(by_name[name])
+
+    if authored_order and set(authored_order) == set(by_name):
+        return [by_name[k] for k in authored_order]
+    return entries
+
+
+def read_signature(export_dir: str) -> dict:
+    """Load ``signature.json``; raises FileNotFoundError when the export is
+    weights-only (pre-v1 layout)."""
+    from tensorflowonspark_tpu import fs
+
+    path = _join(export_dir, _SUBDIR, _SIGNATURE_FILE)
+    if not fs.exists(path):
+        raise FileNotFoundError(f"no {_SIGNATURE_FILE} under {export_dir}")
+    with fs.open(path, "rb") as f:
+        return json.loads(f.read().decode())
+
+
+def has_forward(export_dir: str) -> bool:
+    from tensorflowonspark_tpu import fs
+
+    return fs.exists(_join(export_dir, _SUBDIR, _FORWARD_FILE))
+
+
+def load_forward(export_dir: str):
+    """Deserialize the saved forward.  Returns ``(fn, signature)`` with
+    ``fn(state, batch) -> outputs``; raises FileNotFoundError when the
+    export carries no serialized forward (caller falls back to
+    ``model_name``/``predict_fn``)."""
+    from jax import export as jax_export
+
+    from tensorflowonspark_tpu import fs
+
+    signature = read_signature(export_dir)
+    blob_path = _join(export_dir, _SUBDIR, _FORWARD_FILE)
+    if not fs.exists(blob_path):
+        raise FileNotFoundError(f"no {_FORWARD_FILE} under {export_dir}")
+    with fs.open(blob_path, "rb") as f:
+        exported = jax_export.deserialize(bytearray(f.read()))
+
+    batch = signature.get("batch")
+    if batch == "polymorphic":
+        fn = exported.call
+    else:
+        fn = _fixed_batch_caller(exported, int(batch))
+    return fn, signature
+
+
+def _fixed_batch_caller(exported, fixed: int) -> Callable:
+    """Serve arbitrary batch sizes against a fixed-batch artifact by
+    chunking to ``fixed`` rows (zero-padding the tail) and slicing the
+    concatenated outputs back to the true length."""
+    import jax
+    import numpy as np
+
+    def fn(state, batch):
+        n = int(np.asarray(next(iter(batch.values()))).shape[0])
+        outs = []
+        for start in range(0, max(n, 1), fixed):
+            chunk = {}
+            for k, v in batch.items():
+                v = np.asarray(v)
+                part = v[start:start + fixed]
+                if part.shape[0] < fixed:
+                    pad = [(0, fixed - part.shape[0])] + [(0, 0)] * (
+                        part.ndim - 1)
+                    part = np.pad(part, pad)
+                chunk[k] = part
+            outs.append(exported.call(state, chunk))
+        out = jax.tree.map(
+            lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0),
+            *outs)
+        return jax.tree.map(lambda x: np.asarray(x)[:n], out)
+
+    return fn

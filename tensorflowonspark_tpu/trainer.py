@@ -60,8 +60,10 @@ class Trainer:
 
         if isinstance(model, str):
             self.module_lib = model_zoo.get_model(model)
+            self.model_name = model
         else:
             self.module_lib = model
+            self.model_name = getattr(model, "__name__", None)
         self.config = config or self.module_lib.Config.tiny()
         self.mesh = build_mesh(mesh_config, devices=devices)
         self.model = self.module_lib.make_model(self.config, mesh=self.mesh)
@@ -198,6 +200,41 @@ class Trainer:
         if self.state.collections:
             tree["collections"] = self.state.collections
         ckpt.save_pytree(tree, path)
+
+    def export(self, export_dir: str, *, self_describing: bool = True) -> str:
+        """Write a serving export: weights + serialized forward + signature.
+
+        The SavedModel-parity artifact (``saved_model.py``): consumers
+        (``TFModel.transform``, the JNI shim) serve it with no model code.
+        Optimizer state and optimizer-only collections (the sparse embedding
+        engine's per-row accumulators, suffix ``_opt``) are stripped — they
+        are dead weight at serving time.  ``self_describing=False`` keeps
+        the round-1-3 weights-only layout.
+        """
+        from tensorflowonspark_tpu import compat, saved_model
+
+        # hand orbax the (possibly sharded, possibly not-fully-addressable)
+        # jax.Arrays directly — it gathers during serialization; a host
+        # np.asarray here would break multi-host ZeRO exports and double
+        # host RAM on single host
+        state: dict[str, Any] = {"params": self.state.params}
+        serving_cols = {k: v for k, v in self.state.collections.items()
+                        if not k.endswith("_opt")}
+        if serving_cols:
+            state["collections"] = serving_cols
+        if not self_describing:
+            return compat.export_saved_model(state, export_dir)
+        label_keys = {"label", "start_positions", "end_positions"}
+        example = {
+            k: np.asarray(v)
+            for k, v in self.module_lib.example_batch(
+                self.config, batch_size=2).items()
+            if k not in label_keys
+        }
+        return compat.export_saved_model(
+            state, export_dir,
+            forward_fn=saved_model.wrap_state_forward(self.forward_fn),
+            example_batch=example, model_name=self.model_name)
 
     def restore(self, path: str) -> None:
         from tensorflowonspark_tpu import ckpt

@@ -1,0 +1,110 @@
+"""JVM DataFrame adapter sources (VERDICT r3 item 6 / SURVEY §2.2 row 1).
+
+The dev image has no JDK, so these tests validate the shipped *source*:
+structure, the native-method contract staying in sync with the JNI wrapper's
+exported symbols, and — when a ``javac`` IS present (deployment-side CI) —
+that the Spark-free classes actually compile.  The Spark-dependent
+``TFosModel`` additionally needs Spark jars; that compile gates on both.
+"""
+
+import os
+import re
+import shutil
+import subprocess
+
+import pytest
+
+_JAVA_ROOT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tensorflowonspark_tpu", "native", "java")
+_PKG = os.path.join(_JAVA_ROOT, "com", "tensorflowonspark", "tpu")
+
+_CORE_SOURCES = ["TFosInference.java", "TFRecordCodec.java",
+                 "TFosSession.java"]
+_SPARK_SOURCE = os.path.join("spark", "TFosModel.java")
+
+
+def _read(rel):
+    with open(os.path.join(_PKG, rel)) as f:
+        return f.read()
+
+
+def test_sources_ship_in_tree():
+    for rel in _CORE_SOURCES + [_SPARK_SOURCE]:
+        assert os.path.exists(os.path.join(_PKG, rel)), rel
+    assert os.path.exists(os.path.join(_JAVA_ROOT, "README.md"))
+
+
+def test_native_declarations_match_jni_exports():
+    """Every `public static native` method in the Java classes must have a
+    matching Java_<class>_<method> export in the JNI wrapper source — the
+    contract a JVM enforces at first call."""
+    jni_src_path = os.path.join(os.path.dirname(_JAVA_ROOT),
+                                "tfos_infer_jni.cc")
+    with open(jni_src_path) as f:
+        jni_src = f.read()
+    for java_file, jclass in [("TFosInference.java", "TFosInference"),
+                              ("TFRecordCodec.java", "TFRecordCodec")]:
+        src = _read(java_file)
+        natives = re.findall(
+            r"public static native\s+[\w\[\]]+\s+(\w+)\s*\(", src)
+        assert natives, f"no natives found in {java_file}"
+        for method in natives:
+            sym = f"Java_com_tensorflowonspark_tpu_{jclass}_{method}"
+            assert sym in jni_src, f"{java_file}.{method} has no {sym}"
+
+
+def test_tfosmodel_is_a_dataframe_adapter():
+    """Structural checks on the Spark adapter: mapPartitions over Rows,
+    batching, per-executor cache, output schema — the reference's Scala
+    inference API shape (SURVEY §2.2 row 1)."""
+    src = _read(_SPARK_SOURCE)
+    for needle in [
+        "mapPartitions",              # DataFrame-in/DataFrame-out
+        "Dataset<Row> transform(Dataset<Row> df)",
+        "ConcurrentHashMap<String, TFosSession> SESSIONS",  # executor cache
+        "setInputMapping",            # df column -> model input
+        "setBatchSize",
+        "outputSchema",               # schema from the output column
+        "TFosSession",                # layered over the JNI session
+    ]:
+        assert needle in src, f"TFosModel.java missing {needle!r}"
+    # session cache must be keyed by export, not created per partition
+    assert "computeIfAbsent" in src
+
+
+def test_session_is_spark_free():
+    """TFosSession must compile with a bare javac: no Spark imports."""
+    src = _read("TFosSession.java")
+    assert "org.apache.spark" not in src
+    assert "AutoCloseable" in src
+
+
+@pytest.mark.skipif(shutil.which("javac") is None,
+                    reason="no JDK in this image (deployment-side check)")
+def test_core_classes_compile(tmp_path):
+    srcs = [os.path.join(_PKG, rel) for rel in _CORE_SOURCES]
+    proc = subprocess.run(
+        ["javac", "-d", str(tmp_path), *srcs],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert (tmp_path / "com" / "tensorflowonspark" / "tpu"
+            / "TFosSession.class").exists()
+
+
+def _spark_jars() -> str | None:
+    home = os.environ.get("SPARK_HOME")
+    if home and os.path.isdir(os.path.join(home, "jars")):
+        return os.path.join(home, "jars", "*")
+    return None
+
+
+@pytest.mark.skipif(shutil.which("javac") is None or _spark_jars() is None,
+                    reason="needs a JDK plus Spark jars (SPARK_HOME)")
+def test_spark_adapter_compiles(tmp_path):
+    srcs = [os.path.join(_PKG, rel)
+            for rel in _CORE_SOURCES + [_SPARK_SOURCE]]
+    proc = subprocess.run(
+        ["javac", "-cp", _spark_jars(), "-d", str(tmp_path), *srcs],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]

@@ -1,0 +1,124 @@
+"""Hybrid ICI×DCN mesh construction (`parallel/mesh.py::hybrid_device_array`,
+VERDICT r3 item 7 / SURVEY §2.2 row 3 "DCN collectives across slices").
+
+No multi-slice hardware exists anywhere near this machine, but the mesh
+layout is pure topology code: these tests pin the contract — tp/sp/pp lines
+never cross a slice boundary, the DCN axis walks slices slice-major — on
+the virtual 8-device CPU topology, and train a real step on a two-slice
+2×4 mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from tensorflowonspark_tpu.parallel import MeshConfig, build_mesh
+from tensorflowonspark_tpu.parallel.mesh import (
+    AXES,
+    hybrid_device_array,
+    slice_groups,
+)
+
+
+def _device_slice_map(devices, n_slices):
+    """id(device) -> emulated slice number (contiguous chunks, the same rule
+    slice_groups applies on slice_index-less devices)."""
+    groups = slice_groups(devices, n_slices)
+    return {id(d): s for s, g in enumerate(groups) for d in g}
+
+
+def _check_ici_axes_stay_in_slice(mesh, dcn_axis, n_slices, dev_to_slice):
+    """Walking any non-DCN axis (and the intra-slice remainder of the DCN
+    axis) must stay inside one slice; walking the DCN axis slice-major must
+    cross slices."""
+    arr = mesh.devices
+    for axis_i, axis in enumerate(AXES):
+        if arr.shape[axis_i] == 1:
+            continue
+        moved = np.moveaxis(arr, axis_i, 0)
+        lines = moved.reshape(moved.shape[0], -1)
+        for col in range(lines.shape[1]):
+            slices_seen = {dev_to_slice[id(d)] for d in lines[:, col]}
+            if axis == dcn_axis:
+                assert len(slices_seen) == n_slices, (
+                    f"DCN axis {axis} must span all slices, saw {slices_seen}")
+            else:
+                assert len(slices_seen) == 1, (
+                    f"ICI axis {axis} crosses slices: {slices_seen}")
+
+
+def test_two_slice_mesh_confines_tp_sp_to_a_slice():
+    devices = jax.devices()[:8]
+    cfg = MeshConfig(dp=2, sp=2, tp=2, slices=2).resolve(8)
+    assert cfg.dcn_axis() == "dp"
+    mesh = build_mesh(cfg, devices=devices)
+    assert dict(mesh.shape) == {"dp": 2, "fsdp": 1, "pp": 1, "sp": 2, "tp": 2}
+    _check_ici_axes_stay_in_slice(
+        mesh, "dp", 2, _device_slice_map(devices, 2))
+
+
+def test_fsdp_takes_dcn_axis_when_dp_cannot():
+    devices = jax.devices()[:8]
+    cfg = MeshConfig(dp=1, fsdp=2, tp=4, slices=2).resolve(8)
+    assert cfg.dcn_axis() == "fsdp"
+    mesh = build_mesh(cfg, devices=devices)
+    _check_ici_axes_stay_in_slice(
+        mesh, "fsdp", 2, _device_slice_map(devices, 2))
+
+
+def test_four_slices_on_dp():
+    devices = jax.devices()[:8]
+    cfg = MeshConfig(dp=4, tp=2, slices=4).resolve(8)
+    mesh = build_mesh(cfg, devices=devices)
+    _check_ici_axes_stay_in_slice(
+        mesh, "dp", 4, _device_slice_map(devices, 4))
+
+
+def test_slice_major_ordering_on_dcn_axis():
+    """dp index s*per+i must land on slice s — gradient allreduce then
+    decomposes into in-slice reduce + one cross-slice exchange."""
+    devices = jax.devices()[:8]
+    cfg = MeshConfig(dp=2, tp=4, slices=2).resolve(8)
+    arr = hybrid_device_array(cfg, list(devices))
+    dev_to_slice = _device_slice_map(devices, 2)
+    k = AXES.index("dp")
+    for dp_i in range(2):
+        block = np.take(arr, dp_i, axis=k)
+        assert {dev_to_slice[id(d)] for d in block.ravel()} == {dp_i}
+
+
+def test_validation_errors():
+    devices = jax.devices()[:8]
+    with pytest.raises(ValueError, match="not divisible by slices"):
+        slice_groups(devices, 3)
+    with pytest.raises(ValueError, match="dp or fsdp divisible"):
+        # dp=1, fsdp=1: nothing can absorb the cross-slice axis
+        build_mesh(MeshConfig(dp=1, fsdp=1, tp=4, sp=2, slices=2),
+                   devices=devices)
+    with pytest.raises(ValueError, match="dp or fsdp divisible"):
+        # dp=3 not divisible by 2 slices and fsdp=1
+        MeshConfig(dp=3, tp=2, slices=2).dcn_axis()
+
+
+def test_train_step_on_two_slice_mesh():
+    """The VERDICT done-criterion: a 2×4 'two-slice' mesh forms and trains
+    one real sharded step (ZeRO over fsdp riding the DCN axis, tp inside a
+    slice)."""
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    t = Trainer(
+        "bert",
+        mesh_config=MeshConfig(dp=1, fsdp=2, tp=2, sp=2, slices=2),
+        devices=jax.devices()[:8],
+    )
+    assert dict(t.mesh.shape)["fsdp"] == 2
+    from tensorflowonspark_tpu.models import bert
+
+    batch = bert.example_batch(t.config, batch_size=4, seq_len=16)
+    loss1 = t.step(batch)
+    loss2 = t.step(batch)
+    assert np.isfinite(float(np.asarray(loss1).mean()))
+    # the step optimizes: same repeated batch, loss must not increase wildly
+    assert float(np.asarray(loss2).mean()) <= float(
+        np.asarray(loss1).mean()) * 1.5

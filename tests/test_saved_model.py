@@ -1,0 +1,295 @@
+"""Self-describing exports (saved_model.py): SavedModel-parity round trips.
+
+Reference behavior being mirrored: a TF SavedModel bundles graph + weights +
+signature, and every serving path (``pipeline.py::TFModel``, the Scala
+inference API) resolves tensors from the artifact alone (SURVEY.md §2.1
+pipeline row, §3.4).  These tests prove the StableHLO-based equivalent: a
+model **not in the zoo** is exported once and then served by
+``load_forward``, ``TFModel.transform``, and ``infer_embed`` with no model
+code importable.
+
+NOTE on numerics: comparisons are against the *jitted* forward, not the
+eager one — XLA:CPU's jit matmul path differs from eager by ~1e-2 on this
+host (bf16-accelerated oneDNN), and jax.export goes through jit.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import compat, infer_embed, saved_model
+
+
+def _toy_forward():
+    """A forward that exists only inside this test module — NOT a zoo entry."""
+    import jax.numpy as jnp
+
+    def fwd(state, batch):
+        p = state["params"]
+        h = jnp.tanh(batch["x"] @ p["w"] + p["b"])
+        return {"score": h.sum(axis=-1), "hidden": h}
+
+    return fwd
+
+
+def _toy_state(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"params": {"w": rng.randn(5, 3).astype(np.float32),
+                       "b": rng.randn(3).astype(np.float32)}}
+
+
+def _jit_expect(fwd, state, x):
+    import jax
+
+    return {k: np.asarray(v)
+            for k, v in jax.jit(fwd)(state, {"x": x}).items()}
+
+
+def test_export_forward_polymorphic_roundtrip(tmp_path):
+    fwd, state = _toy_forward(), _toy_state()
+    d = str(tmp_path / "exp")
+    compat.export_saved_model(
+        state, d, forward_fn=fwd,
+        example_batch={"x": np.zeros((2, 5), np.float32)})
+    assert saved_model.has_forward(d)
+
+    fn, sig = saved_model.load_forward(d)
+    assert sig["format"] == saved_model.FORMAT
+    assert sig["batch"] == "polymorphic"
+    # any batch size serves against the polymorphic artifact
+    for n in (1, 4, 7):
+        x = np.random.RandomState(n).randn(n, 5).astype(np.float32)
+        out = fn(state, {"x": x})
+        expect = _jit_expect(fwd, state, x)
+        np.testing.assert_allclose(
+            np.asarray(out["score"]), expect["score"], atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(out["hidden"]), expect["hidden"], atol=1e-6)
+
+
+def test_signature_records_io(tmp_path):
+    fwd, state = _toy_forward(), _toy_state()
+    d = str(tmp_path / "exp")
+    saved_model.export_forward(
+        fwd, state, {"x": np.zeros((2, 5), np.float32)}, d,
+        model_name="custom")
+    sig = saved_model.read_signature(d)
+    assert sig["model_name"] == "custom"
+    assert sig["inputs"] == [
+        {"name": "x", "shape": [None, 5], "dtype": "float32"}]
+    out_names = {o["name"] for o in sig["outputs"]}
+    assert out_names == {"score", "hidden"}
+    assert "cpu" in sig["platforms"]
+
+
+def test_fixed_batch_export_chunk_pads(tmp_path):
+    fwd, state = _toy_forward(), _toy_state()
+    d = str(tmp_path / "exp")
+    saved_model.export_forward(
+        fwd, state, {"x": np.zeros((4, 5), np.float32)}, d,
+        poly_batch=False)
+    fn, sig = saved_model.load_forward(d)
+    assert sig["batch"] == 4
+    # 7 rows against a fixed-4 artifact: two chunks, tail padded + sliced
+    for n in (2, 4, 7):
+        x = np.random.RandomState(n).randn(n, 5).astype(np.float32)
+        out = fn(state, {"x": x})
+        expect = _jit_expect(fwd, state, x)
+        assert np.asarray(out["score"]).shape == (n,)
+        np.testing.assert_allclose(
+            np.asarray(out["score"]), expect["score"], atol=1e-6)
+
+
+def test_weights_only_export_has_no_forward(tmp_path):
+    d = str(tmp_path / "exp")
+    compat.export_saved_model(_toy_state(), d)
+    assert not saved_model.has_forward(d)
+    with pytest.raises(FileNotFoundError):
+        saved_model.read_signature(d)
+    with pytest.raises(FileNotFoundError):
+        saved_model.load_forward(d)
+
+
+def test_export_forward_requires_example_batch(tmp_path):
+    with pytest.raises(ValueError, match="example_batch"):
+        compat.export_saved_model(
+            _toy_state(), str(tmp_path / "e"), forward_fn=_toy_forward())
+
+
+def test_wrap_state_forward_arities():
+    calls = []
+
+    def plain(params, batch):
+        calls.append(("plain", params))
+        return batch["x"]
+
+    def stateful(params, collections, batch):
+        calls.append(("stateful", params, collections))
+        return batch["x"]
+
+    stateful.stateful = True
+
+    serve = saved_model.wrap_state_forward(plain)
+    serve({"params": {"w": 1}}, {"x": 0})
+    assert calls[-1] == ("plain", {"w": 1})
+    serve({"w": 2}, {"x": 0})  # bare params pytree
+    assert calls[-1] == ("plain", {"w": 2})
+
+    serve_s = saved_model.wrap_state_forward(stateful)
+    serve_s({"params": {"w": 1}, "collections": {"bn": 3}}, {"x": 0})
+    assert calls[-1] == ("stateful", {"w": 1}, {"bn": 3})
+    serve_s({"params": {"w": 1}}, {"x": 0})  # collections default to {}
+    assert calls[-1] == ("stateful", {"w": 1}, {})
+
+
+# ---------------------------------------------------------------------------
+# Serving paths: infer_embed (the JNI endpoint) and TFModel.transform
+# ---------------------------------------------------------------------------
+
+
+def test_infer_embed_serves_self_describing_export(tmp_path):
+    fwd, state = _toy_forward(), _toy_state()
+    d = str(tmp_path / "exp")
+    compat.export_saved_model(
+        state, d, forward_fn=fwd,
+        example_batch={"x": np.zeros((2, 5), np.float32)})
+    h = infer_embed.load(d)  # note: NO model_name
+    try:
+        assert infer_embed.input_names(h) == "x"
+        x = np.random.RandomState(1).randn(6, 5).astype(np.float32)
+        infer_embed.set_input(h, "x", x.tobytes(), (6, 5), 0)
+        infer_embed.run(h)
+        assert infer_embed.output_shape(h) == (6,)
+        got = np.frombuffer(infer_embed.get_output(h), np.float32)
+        np.testing.assert_allclose(
+            got, _jit_expect(fwd, state, x)["score"], atol=1e-6)
+    finally:
+        infer_embed.close(h)
+
+
+def test_infer_embed_weights_only_needs_model_name(tmp_path):
+    d = str(tmp_path / "exp")
+    compat.export_saved_model(_toy_state(), d)
+    with pytest.raises(ValueError, match="weights-only"):
+        infer_embed.load(d)
+
+
+def test_tfmodel_transform_serves_non_zoo_export(tmp_path):
+    """TFModel.transform with NO model_name and NO predict_fn — the forward
+    comes entirely from the artifact (VERDICT r3 item 1's done-criterion)."""
+    from tensorflowonspark_tpu.pipeline import TFModel
+    from tensorflowonspark_tpu.sparkapi import LocalSparkContext
+    from tensorflowonspark_tpu.sparkapi.sql import LocalSparkSession
+
+    fwd, state = _toy_forward(), _toy_state()
+    d = str(tmp_path / "exp")
+    compat.export_saved_model(
+        state, d, forward_fn=fwd,
+        example_batch={"x": np.zeros((2, 5), np.float32)})
+
+    sc = LocalSparkContext("local-cluster[2,1,1024]", "saved-model-test")
+    try:
+        spark = LocalSparkSession(sc)
+        x = np.random.RandomState(3).randn(10, 5).astype(np.float32)
+        df = spark.createDataFrame(
+            [(x[i].tolist(),) for i in range(10)], ["x"]).repartition(2)
+        model = (TFModel()
+                 .setExportDir(d)
+                 .setBatchSize(4)
+                 .setInputMapping({"x": "x"})
+                 .setOutputMapping({"score": "score", "hidden": "hidden"}))
+        out = model.transform(df).collect()
+        assert len(out) == 10
+        got = np.asarray(sorted(float(r.score) for r in out), np.float32)
+        expect = np.asarray(
+            sorted(_jit_expect(fwd, state, x)["score"]), np.float32)
+        np.testing.assert_allclose(got, expect, atol=1e-5)
+    finally:
+        sc.stop()
+
+
+def test_explicit_predict_fn_beats_serialized_forward(tmp_path):
+    """A user's predict_fn is explicit intent: it must win over the
+    artifact's serialized forward (which wins over model_name)."""
+    from tensorflowonspark_tpu.pipeline import _RunModel
+
+    fwd, state = _toy_forward(), _toy_state()
+    d = str(tmp_path / "exp")
+    compat.export_saved_model(
+        state, d, forward_fn=fwd,
+        example_batch={"x": np.zeros((2, 5), np.float32)})
+
+    def custom(params, batch):
+        return {"score": np.full(len(batch["x"]), 42.0, np.float32)}
+
+    rm = _RunModel(export_dir=d, model_name=None, predict_fn=custom,
+                   batch_size=4, input_mapping={"x": "x"},
+                   output_mapping=None, columns=["x"])
+    rows = [{"x": [0.0] * 5} for _ in range(3)]
+    out = list(rm(iter(rows)))
+    assert [float(r["score"]) for r in out] == [42.0, 42.0, 42.0]
+
+
+_EXPORTER_SCRIPT = r"""
+import sys
+import numpy as np
+sys.path.insert(0, {repo!r})
+from tensorflowonspark_tpu import util
+util.ensure_jax_platform()  # same backend as the serving test process
+import jax.numpy as jnp
+from tensorflowonspark_tpu import compat
+
+# a private model only this subprocess ever knows about
+def secret_model(state, batch):
+    z = batch["feat"] @ state["params"]["proj"]
+    return {{"out": jnp.maximum(z, 0.0).mean(axis=-1)}}
+
+rng = np.random.RandomState(42)
+state = {{"params": {{"proj": rng.randn(8, 4).astype(np.float32)}}}}
+compat.export_saved_model(
+    state, {export_dir!r}, forward_fn=secret_model,
+    example_batch={{"feat": np.zeros((2, 8), np.float32)}})
+
+# record what serving must reproduce
+import jax
+x = rng.randn(5, 8).astype(np.float32)
+expect = np.asarray(jax.jit(secret_model)(state, {{"feat": x}})["out"])
+np.savez({npz!r}, x=x, expect=expect)
+"""
+
+
+def test_serving_without_model_code(tmp_path):
+    """Export in a subprocess whose model code this process NEVER imports;
+    serve here from the artifact alone — the full SavedModel-parity proof."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    export_dir = str(tmp_path / "exp")
+    npz = str(tmp_path / "io.npz")
+    script = tmp_path / "exporter.py"
+    script.write_text(_EXPORTER_SCRIPT.format(
+        repo=repo, export_dir=export_dir, npz=npz))
+    subprocess.run([sys.executable, str(script)], check=True,
+                   capture_output=True, timeout=300)
+
+    data = np.load(npz)
+    # path 1: raw load_forward
+    from tensorflowonspark_tpu import ckpt
+
+    state = ckpt.load_pytree(os.path.join(export_dir, "model"))
+    fn, sig = saved_model.load_forward(export_dir)
+    assert [i["name"] for i in sig["inputs"]] == ["feat"]
+    out = np.asarray(fn(state, {"feat": data["x"]})["out"])
+    np.testing.assert_allclose(out, data["expect"], atol=1e-6)
+    # path 2: the JNI endpoint
+    h = infer_embed.load(export_dir)
+    try:
+        infer_embed.set_input(
+            h, "feat", data["x"].tobytes(), data["x"].shape, 0)
+        infer_embed.run(h)
+        got = np.frombuffer(infer_embed.get_output(h), np.float32)
+        np.testing.assert_allclose(got, data["expect"], atol=1e-6)
+    finally:
+        infer_embed.close(h)
