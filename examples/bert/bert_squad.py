@@ -52,13 +52,26 @@ def map_fun(args, ctx):
         train_mode=True,
         input_mapping=["input_ids", "token_type_ids", "attention_mask",
                        "start_positions", "end_positions"],
+        prefetch=2,  # double-buffer: stage batch N+1 while N trains
     )
+
+    def stage(batch):
+        # dtype fix + device_put with the step's mesh shardings, executed in
+        # the feed's pipeline thread so H2D overlaps compute;
+        # trainer.step passes pre-sharded batches through untouched.
+        # Short tail batches (partition end) stay on host: the train loop
+        # drops them, and their size may not divide the dp×fsdp world.
+        if batch["input_ids"].shape[0] != args.batch_size:
+            return batch
+        return trainer.shard(
+            {k: v.astype(np.int32) for k, v in batch.items()})
+
     loss, steps = None, 0
     while not feed.should_stop():
-        batch = feed.next_batch(args.batch_size)
+        batch = feed.next_batch(args.batch_size, device_put=stage)
         if not batch or batch["input_ids"].shape[0] != args.batch_size:
             continue
-        loss = trainer.step({k: v.astype(np.int32) for k, v in batch.items()})
+        loss = trainer.step(batch)
         steps += 1
     ctx.mgr.set("final_loss", float(loss) if loss is not None else None)
     ctx.mgr.set("steps", steps)

@@ -39,17 +39,29 @@ def train_fun(args, ctx):
     config = dataclasses.replace(config, table_lr=args.lr * 10.0)
     trainer = Trainer("wide_deep", config=config, learning_rate=args.lr)
     feed = ctx.get_data_feed(train_mode=True,
-                             input_mapping=["dense", "cat", "label"])
-    loss, steps = None, 0
-    while not feed.should_stop():
-        batch = feed.next_batch(args.batch_size)
-        if not batch or batch["dense"].shape[0] != args.batch_size:
-            continue
-        loss = trainer.step({
+                             input_mapping=["dense", "cat", "label"],
+                             prefetch=2)
+
+    def stage(batch):
+        # dtype fix + device_put with the step's mesh shardings in the
+        # feed's pipeline thread (H2D overlaps compute); trainer.step
+        # passes pre-sharded batches through untouched.  Short tail
+        # batches (partition end) stay on host: the train loop drops
+        # them, and their size may not divide the dp×fsdp world.
+        if batch["dense"].shape[0] != args.batch_size:
+            return batch
+        return trainer.shard({
             "dense": batch["dense"].astype(np.float32),
             "cat": batch["cat"].astype(np.int32),
             "label": batch["label"].astype(np.int32),
         })
+
+    loss, steps = None, 0
+    while not feed.should_stop():
+        batch = feed.next_batch(args.batch_size, device_put=stage)
+        if not batch or batch["dense"].shape[0] != args.batch_size:
+            continue
+        loss = trainer.step(batch)
         steps += 1
     ctx.mgr.set("final_loss", float(loss) if loss is not None else None)
     ctx.mgr.set("steps", steps)

@@ -37,7 +37,10 @@ def map_fun(args, ctx):
     import jax.numpy as jnp
     import numpy as np
 
-    feed = ctx.get_data_feed(train_mode=True, input_mapping=["image", "label"])
+    # prefetch=2: the feed's pipeline thread assembles + device_puts the
+    # next batch while the current one trains (double-buffered H2D)
+    feed = ctx.get_data_feed(train_mode=True, input_mapping=["image", "label"],
+                             prefetch=2)
 
     def init(key):
         k1, k2 = jax.random.split(key)

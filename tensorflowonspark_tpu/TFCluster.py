@@ -50,27 +50,76 @@ class TFCluster:
         self._thread = bootstrap_thread
         self._thread_error: list[BaseException] = []
         self.num_executors = cluster_meta["num_executors"]
+        #: last snapshot seen per node — keeps a finished node's final
+        #: numbers visible after its manager dies (marked "stale")
+        self._last_node_metrics: dict[str, dict] = {}
+        #: (wall_time, aggregate) samples appended by the train-time poller
+        self.metrics_history: list[tuple[float, dict]] = []
 
     # -- data plane --------------------------------------------------------
 
     def train(self, dataRDD, num_epochs: int = 1, feed_timeout: float = 600.0,
-              qname: str = "input") -> None:
+              qname: str = "input", metrics_interval: float = 30.0) -> None:
         """Feed an RDD through the cluster for ``num_epochs``.
 
         Reference anchor: ``TFCluster.py::TFCluster.train`` (it re-submits
         the RDD once per epoch; each partition lands on an executor and is
         pushed into the co-located node's queue).
+
+        While feeding, a driver-side poller samples :meth:`metrics` every
+        ``metrics_interval`` seconds into :attr:`metrics_history` (and an
+        INFO log line), so long jobs have live observability instead of a
+        single end-of-run snapshot.  ``metrics_interval=0`` disables it.
         """
         if self.input_mode is not InputMode.SPARK:
             raise RuntimeError("train(dataRDD) requires InputMode.SPARK")
         self._check_bootstrap_error()
-        for epoch in range(num_epochs):
-            logger.info("feeding epoch %d/%d", epoch + 1, num_epochs)
-            dataRDD.foreachPartition(
-                TFSparkNode.train(self.cluster_info, self.cluster_meta,
-                                  feed_timeout, qname)
-            )
-            self._check_bootstrap_error()
+        poller = self._start_metrics_poller(metrics_interval)
+        try:
+            for epoch in range(num_epochs):
+                logger.info("feeding epoch %d/%d", epoch + 1, num_epochs)
+                dataRDD.foreachPartition(
+                    TFSparkNode.train(self.cluster_info, self.cluster_meta,
+                                      feed_timeout, qname)
+                )
+                self._check_bootstrap_error()
+        finally:
+            if poller is not None:
+                poller()
+
+    def _start_metrics_poller(self, interval: float):
+        """Background sampling of :meth:`metrics` into
+        :attr:`metrics_history`; returns a stop() callable (None when
+        disabled)."""
+        if not interval or interval <= 0:
+            return None
+        import threading
+        import time as _time
+
+        stop = threading.Event()
+
+        def poll() -> None:
+            while not stop.wait(interval):
+                try:
+                    agg = self.metrics()
+                except Exception as e:  # observability must not kill train
+                    logger.warning("metrics poll failed: %s", e)
+                    continue
+                self.metrics_history.append((_time.time(), agg))
+                logger.info(
+                    "cluster metrics: %s nodes, %s examples/sec, loss %s",
+                    agg.get("num_reporting"),
+                    agg.get("total_examples_per_sec"), agg.get("mean_loss"))
+
+        t = threading.Thread(target=poll, daemon=True,
+                             name="tfos-metrics-poller")
+        t.start()
+
+        def stopper() -> None:
+            stop.set()
+            t.join(timeout=5.0)
+
+        return stopper
 
     def train_stream(self, dstream, feed_timeout: float = 600.0,
                      qname: str = "input") -> None:
@@ -191,7 +240,13 @@ class TFCluster:
                 logger.warning("metrics: node %s unreachable: %s", name, e)
                 snap = None
             if snap:
-                per_node[name] = snap
+                per_node[name] = dict(snap)
+                self._last_node_metrics[name] = dict(snap)
+            elif name in self._last_node_metrics:
+                # node finished / manager gone: keep its final numbers
+                # visible rather than silently dropping the node
+                per_node[name] = {**self._last_node_metrics[name],
+                                  "stale": True}
         return metrics_lib.aggregate(per_node)
 
     def tensorboard_url(self, timeout: float = 0.0) -> str | None:

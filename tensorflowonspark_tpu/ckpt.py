@@ -52,14 +52,27 @@ def save_pytree(state: Any, path: str) -> str:
 def load_pytree(path: str, target: Any | None = None) -> Any:
     """Restore a pytree saved by :func:`save_pytree`.
 
-    Without ``target``, returns nested dicts/arrays; with ``target`` (a pytree
-    of like-shaped arrays), restores into that structure.
+    Without ``target``, returns nested dicts of **numpy** arrays — restoring
+    as device arrays would need the sharding recorded at save time, which
+    references the *writer's* topology and fails on any other (a CPU-mesh
+    export served on a TPU chip, the cross-platform serving path).  Numpy is
+    topology-agnostic; consumers ``device_put`` with their own shardings.
+    With ``target`` (a pytree of like-shaped arrays), restores into that
+    structure/placement.
     """
     import orbax.checkpoint as ocp
 
     path = _canonical(path)
     if target is None:
-        return _checkpointer().restore(path)
+        import jax
+        import numpy as np
+
+        ckptr = _checkpointer()
+        meta_tree = ckptr.metadata(path).item_metadata.tree
+        restore_args = jax.tree.map(
+            lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta_tree)
+        return ckptr.restore(
+            path, args=ocp.args.PyTreeRestore(restore_args=restore_args))
     return _checkpointer().restore(path, args=ocp.args.PyTreeRestore(item=target))
 
 

@@ -99,14 +99,31 @@ class MetricsReporter:
 
 
 def aggregate(node_metrics: dict[str, dict[str, Any]]) -> dict[str, Any]:
-    """Cluster-level rollup of per-node snapshots (driver side)."""
+    """Cluster-level rollup of per-node snapshots (driver side).
+
+    ``mean_loss`` is weighted by each node's ``total_examples`` (nodes that
+    processed more data count proportionally; falls back to an unweighted
+    mean when no node reports example counts).  Nodes marked ``stale``
+    (finished/unreachable, last snapshot retained by ``TFCluster.metrics``)
+    keep contributing to the loss but are excluded from the live
+    ``total_examples_per_sec`` sum.
+    """
     totals = [m.get("examples_per_sec") for m in node_metrics.values()
-              if m and m.get("examples_per_sec")]
-    losses = [m.get("loss") for m in node_metrics.values()
-              if m and m.get("loss") is not None]
+              if m and m.get("examples_per_sec") and not m.get("stale")]
+    weighted = [(m["loss"], m.get("total_examples") or 0)
+                for m in node_metrics.values()
+                if m and m.get("loss") is not None]
+    mean_loss = None
+    if weighted:
+        wsum = sum(w for _, w in weighted)
+        if wsum > 0:
+            mean_loss = sum(l * w for l, w in weighted) / wsum
+        else:
+            mean_loss = sum(l for l, _ in weighted) / len(weighted)
+        mean_loss = round(mean_loss, 6)
     return {
         "nodes": node_metrics,
         "num_reporting": len(node_metrics),
         "total_examples_per_sec": round(sum(totals), 2) if totals else None,
-        "mean_loss": round(sum(losses) / len(losses), 6) if losses else None,
+        "mean_loss": mean_loss,
     }

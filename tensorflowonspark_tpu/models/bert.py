@@ -158,6 +158,16 @@ def make_model(config: Config, mesh=None):
         otherwise — identical numerics either way (tested).  Dense masked
         attention only (ring/sp attention belongs to the layered variant).
 
+        **pp × tp composition**: qkv/out weights are head-major
+        (``(L, H, 3, heads, head_dim)`` / ``(L, heads, head_dim, H)``) and
+        the MLP ffn dim carries ``"mlp"``, so inside the pipeline's
+        shard_map each tp rank holds ``heads/tp`` heads and ``mlp_dim/tp``
+        ffn columns (``param_specs``), computes its partial attention/MLP
+        output, and the block ``lax.psum``s the row-sharded matmul results
+        over ``tp`` — Megatron-style TP inside each GPipe stage.  In the
+        sequential (no-pp-mesh) path the same code runs global-view and
+        GSPMD inserts the collectives from the storage shardings.
+
         Deliberately a functional twin of :class:`Block` rather than
         ``nn.scan(Block)``: nn.scan owns the execution (sequential) and
         hides its stacked params from ``pipeline_apply``, which needs them
@@ -170,6 +180,8 @@ def make_model(config: Config, mesh=None):
 
         @nn.compact
         def __call__(self, x, mask):
+            from jax.sharding import PartitionSpec as P
+
             from tensorflowonspark_tpu.parallel.pipeline_parallel import (
                 pipeline_apply,
             )
@@ -187,20 +199,47 @@ def make_model(config: Config, mesh=None):
                 )
 
             w = {
-                "qkv_w": par("qkv_w", (H, 3 * H), ("embed", "mlp"), normal),
-                "qkv_b": par("qkv_b", (3 * H,), (None,), zeros),
-                "out_w": par("out_w", (H, H), ("mlp", "embed"), normal),
+                "qkv_w": par("qkv_w", (H, 3, nh, hd),
+                             ("embed", None, "heads", "kv"), normal),
+                "qkv_b": par("qkv_b", (3, nh, hd), (None, "heads", "kv"),
+                             zeros),
+                "out_w": par("out_w", (nh, hd, H), ("heads", "kv", "embed"),
+                             normal),
                 "out_b": par("out_b", (H,), (None,), zeros),
                 "ln1_s": par("ln1_s", (H,), (None,), ones),
                 "ln1_b": par("ln1_b", (H,), (None,), zeros),
                 "mlp_in_w": par("mlp_in_w", (H, M), ("embed", "mlp"), normal),
-                "mlp_in_b": par("mlp_in_b", (M,), (None,), zeros),
+                "mlp_in_b": par("mlp_in_b", (M,), ("mlp",), zeros),
                 "mlp_out_w": par("mlp_out_w", (M, H), ("mlp", "embed"),
                                  normal),
                 "mlp_out_b": par("mlp_out_b", (H,), (None,), zeros),
                 "ln2_s": par("ln2_s", (H,), (None,), ones),
                 "ln2_b": par("ln2_b", (H,), (None,), zeros),
             }
+            #: shard_map specs for the pipeline path: pp on the stage dim,
+            #: tp on heads/ffn — MUST mirror the logical axes above
+            #: ("heads"/"mlp" → tp in mesh.DEFAULT_RULES)
+            pipeline_specs = {
+                "qkv_w": P("pp", None, None, "tp", None),
+                "qkv_b": P("pp", None, "tp", None),
+                "out_w": P("pp", "tp", None, None),
+                "out_b": P("pp", None),
+                "ln1_s": P("pp", None),
+                "ln1_b": P("pp", None),
+                "mlp_in_w": P("pp", None, "tp"),
+                "mlp_in_b": P("pp", "tp"),
+                "mlp_out_w": P("pp", "tp", None),
+                "mlp_out_b": P("pp", None),
+                "ln2_s": P("pp", None),
+                "ln2_b": P("pp", None),
+            }
+
+            n_pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+            use_pipeline = n_pp > 1 and n_pp == config.pp_stages
+            # tp collectives are hand-written ONLY inside the pipeline's
+            # shard_map; the sequential path is global-view (GSPMD)
+            tp_world = (mesh.shape.get("tp", 1)
+                        if (mesh is not None and use_pipeline) else 1)
 
             def layer_norm(h, scale, bias):
                 h32 = h.astype(jnp.float32)
@@ -210,27 +249,32 @@ def make_model(config: Config, mesh=None):
                         * scale + bias).astype(dtype)
 
             def block(lw, h, m):
-                b, s = h.shape[0], h.shape[1]
-                qkv = (h @ lw["qkv_w"].astype(dtype)
-                       + lw["qkv_b"].astype(dtype))
-                q, k, v = jnp.split(qkv, 3, axis=-1)
-                q = q.reshape(b, s, nh, hd)
-                k = k.reshape(b, s, nh, hd)
-                v = v.reshape(b, s, nh, hd)
+                # local head count: nh/tp inside the pipeline shard_map
+                hd_ = lw["qkv_w"].shape[-1]
+                qkv = jnp.einsum(
+                    "bsh,hknd->bsknd", h, lw["qkv_w"].astype(dtype)
+                ) + lw["qkv_b"].astype(dtype)
+                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (B,S,N,D)
                 sc = jnp.einsum(
-                    "bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                    "bqnd,bknd->bnqk", q.astype(jnp.float32),
                     k.astype(jnp.float32)
-                ) * (1.0 / math.sqrt(hd))
+                ) * (1.0 / math.sqrt(hd_))
                 sc = jnp.where(m[:, None, None, :], sc, -1e30)
                 p = jax.nn.softmax(sc, axis=-1)
-                o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(dtype), v)
-                o = o.reshape(b, s, H)
-                o = o @ lw["out_w"].astype(dtype) + lw["out_b"].astype(dtype)
+                o = jnp.einsum("bnqk,bknd->bqnd", p.astype(dtype), v)
+                # row-sharded output projection: each tp rank contributes
+                # its heads' partial sum; bias added AFTER the reduce
+                o = jnp.einsum("bqnd,ndh->bqh", o, lw["out_w"].astype(dtype))
+                if tp_world > 1:
+                    o = jax.lax.psum(o, "tp")
+                o = o + lw["out_b"].astype(dtype)
                 h = layer_norm(h + o, lw["ln1_s"], lw["ln1_b"])
                 y = nn.gelu(h @ lw["mlp_in_w"].astype(dtype)
                             + lw["mlp_in_b"].astype(dtype))
-                y = (y @ lw["mlp_out_w"].astype(dtype)
-                     + lw["mlp_out_b"].astype(dtype))
+                y = y @ lw["mlp_out_w"].astype(dtype)
+                if tp_world > 1:
+                    y = jax.lax.psum(y, "tp")
+                y = y + lw["mlp_out_b"].astype(dtype)
                 return layer_norm(h + y, lw["ln2_s"], lw["ln2_b"])
 
             # per-layer rematerialization in BOTH execution paths (finer
@@ -244,14 +288,17 @@ def make_model(config: Config, mesh=None):
                 h, _ = jax.lax.scan(body, h, sp)
                 return h
 
-            n_pp = mesh.shape.get("pp", 1) if mesh is not None else 1
-            if n_pp > 1 and n_pp == config.pp_stages:
+            if use_pipeline:
                 staged = jax.tree_util.tree_map(
                     lambda l: l.reshape((n_pp, L // n_pp) + l.shape[1:]), w
                 )
+                staged_specs = {
+                    k: P("pp", None, *s[1:]) for k, s in pipeline_specs.items()
+                }
                 return pipeline_apply(
                     stage_fn, staged, x, mesh=mesh,
                     n_microbatches=config.pp_microbatches, aux=mask,
+                    param_specs=staged_specs,
                 )
             return stage_fn(w, x, mask)
 
@@ -283,15 +330,18 @@ def make_model(config: Config, mesh=None):
         if mesh is not None and mesh.shape.get("sp", 1) > 1:
             raise ValueError(
                 "pp_stages > 1 uses dense attention; combine pp with "
-                "dp/fsdp, not sp (ring attention belongs to the layered "
+                "dp/fsdp/tp, not sp (ring attention belongs to the layered "
                 "variant)"
             )
-        if mesh is not None and mesh.shape.get("tp", 1) > 1:
-            raise ValueError(
-                "pp_stages > 1 does not shard over tp (the pipeline "
-                "stage_fn has no internal tp collectives — tp ranks would "
-                "silently replicate); combine pp with dp/fsdp"
-            )
+        mesh_tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+        if mesh_tp > 1:
+            # pp×tp: each tp rank takes heads/tp heads and mlp_dim/tp ffn
+            # columns inside every pipeline stage (StackedEncoder psums)
+            if config.heads % mesh_tp or config.mlp_dim % mesh_tp:
+                raise ValueError(
+                    f"pp×tp needs heads ({config.heads}) and mlp_dim "
+                    f"({config.mlp_dim}) divisible by tp={mesh_tp}"
+                )
         mesh_pp = mesh.shape.get("pp", 1) if mesh is not None else 1
         if mesh_pp > 1 and mesh_pp != config.pp_stages:
             raise ValueError(

@@ -24,6 +24,10 @@ TPU-first choices:
   Both were profiled on the bench chip; dense wins there because XLA's
   scatter lowering serializes (~20 ms per 106k-row scatter), sparse wins
   wherever scatters are fast — see BENCH_NOTES.md for the numbers.
+  The two modes diverge numerically on batches with duplicate ids (dense
+  squares the summed duplicate grads, sparse sums the squared
+  per-occurrence grads into the accumulator) — see the
+  ``Config.table_update`` comment.
 - :func:`make_sharded_train_step` is the model-supplied custom step the
   ``Trainer`` picks up; it composes with the generic machinery through
   ``parallel.train.compile_step`` (same shardings, donation, active mesh).
@@ -52,6 +56,13 @@ class Config:
     # "sparse": embedding.sparse_adagrad_update touches only gathered rows —
     #   O(batch) HBM traffic, the right mode where scatters are fast
     #   (CPU; SparseCore-class hardware).
+    # NOT numerically identical when a batch repeats an id: dense sums the
+    # duplicates' grads BEFORE squaring into the AdaGrad accumulator (the
+    # gather VJP pre-reduces), sparse accumulates each occurrence's
+    # squared grad separately — so switching modes changes the training
+    # trajectory on duplicate-heavy data, not just the speed.  Both are
+    # legitimate AdaGrad variants (combined- vs per-occurrence
+    # accumulation); pick one per run and keep it.
     table_update: str = "dense"
 
     @classmethod
@@ -114,6 +125,14 @@ def make_model(config: Config, mesh=None):
                     lambda: jnp.zeros((config.total_buckets,), jnp.float32),
                 )
 
+            if (emb_rows is None) != (wide_rows is None):
+                raise ValueError(
+                    "emb_rows and wide_rows must be passed together (the "
+                    "sparse train step pre-gathers BOTH) or both omitted "
+                    f"(the model gathers); got emb_rows="
+                    f"{'set' if emb_rows is not None else 'None'}, "
+                    f"wide_rows={'set' if wide_rows is not None else 'None'}"
+                )
             if emb_rows is None:
                 ids = fold_ids(cat, config)
                 emb_rows = jnp.take(deep_table.value, ids, axis=0)  # (B,26,E)

@@ -254,6 +254,12 @@ def shard_batch(mesh, batch, sequence_axes: dict[str, int] | None = None):
 
     ``sequence_axes`` optionally maps leaf path names (dict keys) to the axis
     that should be sharded over ``sp``.
+
+    Idempotent: a leaf that is already a committed ``jax.Array`` with the
+    target sharding passes through untouched, so ``Trainer.step`` accepts
+    batches pre-staged by a double-buffered feed (``DataFeed(prefetch=…,
+    device_put=trainer.shard)``) without re-sharding them on the critical
+    path.
     """
     import jax
 
@@ -262,9 +268,11 @@ def shard_batch(mesh, batch, sequence_axes: dict[str, int] | None = None):
     def _put(path, leaf):
         name = path[-1].key if path and hasattr(path[-1], "key") else None
         sa = seq.get(name)
-        return jax.device_put(
-            leaf, batch_sharding(mesh, getattr(leaf, "ndim", 0), sa)
-        )
+        target = batch_sharding(mesh, getattr(leaf, "ndim", 0), sa)
+        if isinstance(leaf, jax.Array) and getattr(
+                leaf, "sharding", None) == target:
+            return leaf  # pre-staged by the feed's pipeline thread
+        return jax.device_put(leaf, target)
 
     return jax.tree_util.tree_map_with_path(_put, batch)
 

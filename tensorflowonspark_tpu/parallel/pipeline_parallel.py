@@ -59,6 +59,7 @@ def pipeline_apply(
     axis: str = "pp",
     remat: bool = False,
     aux=None,
+    param_specs: Any = None,
 ):
     """GPipe forward over ``mesh.shape[axis]`` stages; differentiable.
 
@@ -79,8 +80,18 @@ def pipeline_apply(
     Composes with data parallelism: each microbatch's batch dim is sharded
     over ``(dp, fsdp)``, so a ``dp×pp`` mesh pipelines ``dp`` disjoint data
     shards concurrently (the per-microbatch batch must divide the
-    data-parallel world).  ``tp``/``sp`` are free for ``stage_fn``'s own
-    internal collectives.
+    data-parallel world).
+
+    Composes with tensor parallelism: pass ``param_specs`` — a pytree of
+    ``PartitionSpec`` matching ``stage_params`` (leading dim ``axis``, plus
+    e.g. ``"tp"`` on head/ffn dims) — and the stage weights arrive inside
+    the schedule already tp-sharded; ``stage_fn`` then runs Megatron-style
+    with its own ``lax.psum(..., "tp")`` after row-sharded matmuls (the
+    composition ``models/bert.py::StackedEncoder`` implements and
+    ``tests/test_models.py`` pins against the sequential run).  Default
+    ``param_specs=None`` replicates stage weights over every non-``pp``
+    axis, as before.  ``sp`` remains free for ``stage_fn``'s own sequence
+    collectives.
 
     Returns the pipelined equivalent of applying all stages sequentially.
     """
@@ -184,10 +195,12 @@ def pipeline_apply(
     aux_spec = jax.tree_util.tree_map(
         lambda _: P(None, data_spec), aux_operand
     )
+    if param_specs is None:
+        param_specs = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
     sm = _shard_map(
         _ranked,
         mesh,
-        in_specs=(P(axis), P(None, data_spec), aux_spec),
+        in_specs=(param_specs, P(None, data_spec), aux_spec),
         out_specs=P(None, data_spec),
     )
     out = sm(stage_params, micro, aux_operand)  # (M, B/M, ...) global view

@@ -373,7 +373,7 @@ def parquet_batches(
                             )
                         for name, col in zip(rb.schema.names, rb.columns):
                             pending.setdefault(name, []).append(
-                                np.asarray(col)
+                                _column_to_numpy(path, name, col)
                             )
                         count += rb.num_rows
                         while count >= batch_size:
@@ -390,6 +390,54 @@ def parquet_batches(
                 yield _stage(batch)
 
     yield from _prefetched(batch_gen, prefetch)
+
+
+def _column_to_numpy(path: str, name: str, col) -> np.ndarray:
+    """One Arrow column → a dense numeric numpy array.
+
+    ``np.asarray`` on a list-typed or null-bearing Arrow column silently
+    yields ``dtype=object``, which only fails much later at
+    ``device_put``/jnp conversion — so convert deliberately: scalar columns
+    via ``to_numpy``; fixed-length list columns (the ``array<T>`` vectors
+    ``dfutil.saveAsParquet`` writes, e.g. criteo ``cat``) stack to
+    ``(N, k)``; nulls and ragged lists fail loudly with the file and
+    column named.
+    """
+    import pyarrow as pa
+
+    if col.null_count:
+        raise ValueError(
+            f"{path}: column {name!r} has {col.null_count} null values — "
+            "fill or drop them before the TPU feed (object arrays cannot "
+            "be device_put)"
+        )
+    t = col.type
+    if pa.types.is_fixed_size_list(t):
+        flat = col.flatten()
+        if flat.null_count:
+            raise ValueError(
+                f"{path}: column {name!r} has null list elements")
+        k = t.list_size
+        return flat.to_numpy(zero_copy_only=False).reshape(len(col), k)
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        offsets = col.offsets.to_numpy(zero_copy_only=False)
+        lengths = np.diff(offsets)
+        if len(lengths) and not (lengths == lengths[0]).all():
+            raise ValueError(
+                f"{path}: column {name!r} is a ragged list column "
+                f"(lengths {lengths.min()}..{lengths.max()}); TPU batches "
+                "need rectangular arrays — pad it at write time"
+            )
+        values = col.values
+        if values.null_count:
+            raise ValueError(
+                f"{path}: column {name!r} has null list elements")
+        k = int(lengths[0]) if len(lengths) else 0
+        flat = values.to_numpy(zero_copy_only=False)
+        # offsets may not start at 0 for a sliced array
+        flat = flat[offsets[0]:offsets[0] + len(col) * k]
+        return flat.reshape(len(col), k)
+    return col.to_numpy(zero_copy_only=False)
 
 
 def _slice_batch(pending: dict[str, list[np.ndarray]], count: int,

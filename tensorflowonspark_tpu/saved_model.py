@@ -302,7 +302,13 @@ def load_forward(export_dir: str):
 def _fixed_batch_caller(exported, fixed: int) -> Callable:
     """Serve arbitrary batch sizes against a fixed-batch artifact by
     chunking to ``fixed`` rows (zero-padding the tail) and slicing the
-    concatenated outputs back to the true length."""
+    concatenated outputs back to the true length.
+
+    Only output leaves whose leading dim equals the exported batch size are
+    per-example and get concatenated/sliced; batch-independent leaves (a
+    scalar temperature, a fixed-size table) are taken from the first chunk
+    as-is.
+    """
     import jax
     import numpy as np
 
@@ -319,10 +325,14 @@ def _fixed_batch_caller(exported, fixed: int) -> Callable:
                         part.ndim - 1)
                     part = np.pad(part, pad)
                 chunk[k] = part
-            outs.append(exported.call(state, chunk))
-        out = jax.tree.map(
-            lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0),
-            *outs)
-        return jax.tree.map(lambda x: np.asarray(x)[:n], out)
+            outs.append(
+                jax.tree.map(np.asarray, exported.call(state, chunk)))
+
+        def merge(*xs):
+            if xs[0].ndim == 0 or xs[0].shape[0] != fixed:
+                return xs[0]  # batch-independent output leaf
+            return np.concatenate(xs, axis=0)[:n]
+
+        return jax.tree.map(merge, *outs)
 
     return fn

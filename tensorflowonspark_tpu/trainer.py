@@ -81,10 +81,16 @@ class Trainer:
         self.forward_fn = self.module_lib.make_forward_fn(self.model, self.config)
 
         # example batch sized to the data-parallel world so the compiled
-        # shardings divide evenly for any mesh (dp*fsdp may be odd)
+        # shardings divide evenly for any mesh (dp*fsdp may be odd); a
+        # pipelined model additionally splits the batch into microbatches,
+        # each of which must still divide the data-parallel world
         data_world = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
-        example = self.module_lib.example_batch(self.config,
-                                                batch_size=2 * data_world)
+        micro = 1
+        if (getattr(self.config, "pp_stages", 0) or 0) > 1 and \
+                self.mesh.shape.get("pp", 1) > 1:
+            micro = max(1, getattr(self.config, "pp_microbatches", 1))
+        example = self.module_lib.example_batch(
+            self.config, batch_size=max(2, micro) * data_world)
         init_args = _model_inputs(example)
 
         # abstract init → shardings from flax partitioning metadata.

@@ -133,6 +133,64 @@ def test_save_parquet_decimal_column(tmp_path):
         sc.stop()
 
 
+def test_parquet_batches_list_columns_stack_rectangular(tmp_path):
+    """array<T> columns (dfutil.saveAsParquet's criteo-style cat vectors)
+    must come back as (N, k) numeric arrays, not dtype=object (ADVICE r3)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "part-r-00000.parquet")
+    cats = [[i, i + 1, i + 2] for i in range(9)]
+    pq.write_table(
+        pa.table({
+            "cat": pa.array(cats, type=pa.list_(pa.int32())),
+            "vec": pa.array([[float(i)] * 4 for i in range(9)],
+                            type=pa.list_(pa.float32())),
+            "label": np.arange(9, dtype=np.int64),
+        }),
+        path, row_group_size=4)  # multiple row groups → sliced list arrays
+    batches = list(readers.parquet_batches([path], batch_size=4))
+    assert [len(b["label"]) for b in batches] == [4, 4, 1]
+    for b in batches:
+        assert b["cat"].dtype == np.int32 and b["cat"].ndim == 2
+        assert b["cat"].shape[1] == 3
+        assert b["vec"].dtype == np.float32 and b["vec"].shape[1] == 4
+    np.testing.assert_array_equal(batches[0]["cat"][1], [1, 2, 3])
+    np.testing.assert_array_equal(batches[2]["cat"][0], [8, 9, 10])
+
+
+def test_parquet_batches_ragged_and_null_columns_fail_loudly(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    ragged = str(tmp_path / "ragged.parquet")
+    pq.write_table(
+        pa.table({"cat": pa.array([[1, 2], [3]], type=pa.list_(pa.int32()))}),
+        ragged)
+    with pytest.raises(ValueError, match="ragged"):
+        list(readers.parquet_batches([ragged], batch_size=2, prefetch=0))
+
+    nulls = str(tmp_path / "nulls.parquet")
+    pq.write_table(
+        pa.table({"x": pa.array([1.0, None, 3.0], type=pa.float32())}),
+        nulls)
+    with pytest.raises(ValueError, match="null"):
+        list(readers.parquet_batches([nulls], batch_size=2, prefetch=0))
+
+
+def test_parquet_batches_fixed_size_list_column(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "fixed.parquet")
+    vals = pa.array(np.arange(12, dtype=np.float32))
+    pq.write_table(
+        pa.table({"v": pa.FixedSizeListArray.from_arrays(vals, 3)}), path)
+    (batch,) = list(readers.parquet_batches([path], batch_size=4))
+    assert batch["v"].shape == (4, 3) and batch["v"].dtype == np.float32
+    np.testing.assert_array_equal(batch["v"][2], [6.0, 7.0, 8.0])
+
+
 def test_parquet_batches_schema_drift_raises(tmp_path):
     import pyarrow as pa
     import pyarrow.parquet as pq

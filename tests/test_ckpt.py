@@ -35,3 +35,22 @@ def test_export_saved_model_shim(tmp_path):
     out = compat.export_saved_model({"w": np.ones(4)}, str(tmp_path / "exp"))
     restored = ckpt.load_pytree(out)
     _tree_close(restored["w"], np.ones(4))
+
+
+def test_targetless_restore_is_topology_agnostic(tmp_path):
+    """load_pytree without a target must return numpy, NOT device arrays
+    pinned to the writer's sharding — a checkpoint written on one topology
+    (8-device CPU mesh) must restore on any other (the single TPU chip a
+    serving process sees).  Restoring with the recorded sharding raises
+    orbax's 'sharding ... Got None' on a foreign topology."""
+    import jax
+
+    sharded = jax.device_put(
+        np.arange(16.0).reshape(8, 2),
+        jax.sharding.NamedSharding(
+            jax.sharding.Mesh(np.asarray(jax.devices()[:8]), ("d",)),
+            jax.sharding.PartitionSpec("d")))
+    path = ckpt.save_pytree({"w": sharded}, str(tmp_path / "ck"))
+    restored = ckpt.load_pytree(path)
+    assert type(restored["w"]) is np.ndarray
+    _tree_close(restored["w"], np.arange(16.0).reshape(8, 2))

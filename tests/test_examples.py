@@ -92,10 +92,12 @@ def test_inception_spark_example_synthetic(capsys):
 
 
 def test_bert_squad_example_pipeline_parallel(capsys):
-    """--pp 2: the GPipe stacked trunk through the full cluster path."""
+    """--pp 2 --tp 2: the GPipe stacked trunk with stage-internal Megatron
+    tp through the full cluster path (pp×tp composition, VERDICT r3 #3)."""
     mod = _load("bert", "bert_squad")
     mod.main(["--cluster_size", "2", "--epochs", "1", "--tiny",
               "--num_samples", "64", "--batch_size", "8",
-              "--seq_len", "32", "--pp", "2", "--pp_microbatches", "2"])
+              "--seq_len", "32", "--pp", "2", "--tp", "2",
+              "--pp_microbatches", "2"])
     out = capsys.readouterr().out
-    assert "'pp': 2" in out
+    assert "'pp': 2" in out and "'tp': 2" in out

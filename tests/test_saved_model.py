@@ -104,6 +104,32 @@ def test_fixed_batch_export_chunk_pads(tmp_path):
             np.asarray(out["score"]), expect["score"], atol=1e-6)
 
 
+def test_fixed_batch_handles_batch_independent_outputs(tmp_path):
+    """Scalar / non-per-example outputs must survive the fixed-batch
+    chunking path instead of crashing np.concatenate or being mis-sliced."""
+    import jax.numpy as jnp
+
+    def fwd(state, batch):
+        h = batch["x"] @ state["params"]["w"]
+        return {"score": h.sum(axis=-1),
+                "temperature": jnp.float32(2.5),
+                "bias_vec": state["params"]["b"]}  # fixed (3,), not batch
+
+    state = _toy_state()
+    d = str(tmp_path / "exp")
+    saved_model.export_forward(
+        fwd, state, {"x": np.zeros((4, 5), np.float32)}, d,
+        poly_batch=False)
+    fn, sig = saved_model.load_forward(d)
+    assert sig["batch"] == 4
+    x = np.random.RandomState(0).randn(7, 5).astype(np.float32)
+    out = fn(state, {"x": x})
+    assert np.asarray(out["score"]).shape == (7,)
+    assert float(out["temperature"]) == 2.5
+    np.testing.assert_allclose(np.asarray(out["bias_vec"]),
+                               state["params"]["b"], atol=1e-6)
+
+
 def test_weights_only_export_has_no_forward(tmp_path):
     d = str(tmp_path / "exp")
     compat.export_saved_model(_toy_state(), d)
