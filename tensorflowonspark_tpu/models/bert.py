@@ -93,13 +93,18 @@ def make_model(config: Config, mesh=None):
                 o = sharded_attn(q, k, v, kv_mask=mask)
             else:
                 scale = 1.0 / math.sqrt(d)
+                # scores on the MXU: bf16 multiply, f32 accumulate
+                # (preferred_element_type) — an explicit f32 upcast here
+                # risks the chip's slow multi-pass f32 matmul path
                 s_ = jnp.einsum(
-                    "bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                    k.astype(jnp.float32)
+                    "bqhd,bkhd->bhqk", q.astype(dtype), k.astype(dtype),
+                    preferred_element_type=jnp.float32,
                 ) * scale
                 s_ = jnp.where(mask[:, None, None, :], s_, -1e30)
                 p = jax.nn.softmax(s_, axis=-1)
-                o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(dtype), v)
+                o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(dtype), v,
+                               preferred_element_type=jnp.float32
+                               ).astype(dtype)
             o = o.reshape(b, s, h * d)
             return nn.DenseGeneral(
                 config.hidden, axis=-1, dtype=dtype, name="out",
@@ -255,13 +260,17 @@ def make_model(config: Config, mesh=None):
                     "bsh,hknd->bsknd", h, lw["qkv_w"].astype(dtype)
                 ) + lw["qkv_b"].astype(dtype)
                 q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (B,S,N,D)
+                # same MXU policy as the layered Block: bf16 multiply with
+                # f32 accumulation, not an explicit f32-upcast matmul
                 sc = jnp.einsum(
-                    "bqnd,bknd->bnqk", q.astype(jnp.float32),
-                    k.astype(jnp.float32)
+                    "bqnd,bknd->bnqk", q, k,
+                    preferred_element_type=jnp.float32,
                 ) * (1.0 / math.sqrt(hd_))
                 sc = jnp.where(m[:, None, None, :], sc, -1e30)
                 p = jax.nn.softmax(sc, axis=-1)
-                o = jnp.einsum("bnqk,bknd->bqnd", p.astype(dtype), v)
+                o = jnp.einsum("bnqk,bknd->bqnd", p.astype(dtype), v,
+                               preferred_element_type=jnp.float32
+                               ).astype(dtype)
                 # row-sharded output projection: each tp rank contributes
                 # its heads' partial sum; bias added AFTER the reduce
                 o = jnp.einsum("bqnd,ndh->bqh", o, lw["out_w"].astype(dtype))

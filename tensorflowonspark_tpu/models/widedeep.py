@@ -48,7 +48,13 @@ class Config:
     hash_buckets: int = 100_000  # per categorical feature
     embed_dim: int = 32
     hidden: tuple = (1024, 512, 256)
+    # f32 everywhere: an in-process A/B on the bench chip measured bf16 MLP
+    # compute at parity with f32 (22.6-22.9 ms/step all variants — the step
+    # is scatter/table-bound, BENCH_NOTES.md), so bf16's precision cost
+    # buys nothing here; tables especially must stay f32 (AdaGrad's late
+    # small updates fall below bf16's ~3 decimal digits)
     dtype: str = "float32"
+    table_dtype: str = "float32"
     table_lr: float = 0.01  # AdaGrad rate for wide+embedding tables
     # "dense": table grads via the gather's VJP, full-table AdaGrad pass —
     #   measured fastest on chips whose scatter lowering is serialized
@@ -90,6 +96,7 @@ def make_model(config: Config, mesh=None):
     import jax.numpy as jnp
 
     dtype = jnp.dtype(config.dtype)
+    table_dtype = jnp.dtype(getattr(config, "table_dtype", "float32"))
 
     class WideDeep(nn.Module):
         """``__call__(dense, cat)`` gathers internally (init / eval path);
@@ -102,12 +109,12 @@ def make_model(config: Config, mesh=None):
                 "embedding", "deep",
                 lambda: nn.initializers.normal(stddev=0.01)(
                     self.make_rng("params"),
-                    (config.total_buckets, config.embed_dim), dtype,
+                    (config.total_buckets, config.embed_dim), table_dtype,
                 ),
             )
             wide_table = self.variable(
                 "embedding", "wide",
-                lambda: jnp.zeros((config.total_buckets,), jnp.float32),
+                lambda: jnp.zeros((config.total_buckets,), table_dtype),
             )
             # per-row AdaGrad accumulators for the sparse engine; created at
             # init so they ride the same collections/checkpoint machinery,

@@ -38,7 +38,11 @@ def _block_attn(q, k, v, m, l, o, q_start, k_start, causal, scale,
     """
     import jax.numpy as jnp
 
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    # MXU policy: multiply in the inputs' dtype (bf16 for bf16 models),
+    # accumulate f32 — an explicit f32-upcast matmul hits the chip's slow
+    # multi-pass f32 path (see BENCH_NOTES.md round 4)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if causal:
         q_pos = q_start + jnp.arange(q.shape[1])
         k_pos = k_start + jnp.arange(k.shape[1])
@@ -54,7 +58,8 @@ def _block_attn(q, k, v, m, l, o, q_start, k_start, causal, scale,
         p = jnp.where(kv_mask[:, None, None, :], p, 0.0)
     correction = jnp.exp(m - m_new)
     l_new = l * correction + p.sum(axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
     o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
     return m_new, l_new, o_new
 
@@ -83,14 +88,16 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     m0 = jnp.full((b, h, sq), NEG_INF, dtype=jnp.float32)
     l0 = jnp.zeros((b, h, sq), dtype=jnp.float32)
     o0 = jnp.zeros(q.shape, dtype=jnp.float32)
-    qf = q.astype(jnp.float32)
     masked = kv_mask is not None  # trace-time: unmasked ring carries/permutes
     # no mask and skips the mask wheres entirely (packed fast path)
 
     def body(i, carry):
         m, l, o, kb, vb, maskb = carry
         src = (rank - i) % n
-        m, l, o = _block_attn(qf, kb.astype(jnp.float32), vb.astype(jnp.float32),
+        # blocks stay in the model dtype end-to-end: the score matmul
+        # accumulates f32 via preferred_element_type (_block_attn), with
+        # no per-hop f32 upcast of the arriving block
+        m, l, o = _block_attn(q, kb, vb,
                               m, l, o, rank * sq, src * sk, causal, scale,
                               kv_mask=maskb if masked else None)
         kb = lax.ppermute(kb, axis_name, perm)
@@ -130,8 +137,8 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
         return x.reshape(b, sq * n, h // n, d)
 
     qg, kg, vg = a2a_fwd(q), a2a_fwd(k), a2a_fwd(v)
-    s = jnp.einsum("bqhd,bkhd->bhqk", qg.astype(jnp.float32),
-                   kg.astype(jnp.float32)) * scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", qg, kg,
+                   preferred_element_type=jnp.float32) * scale
     if causal:
         pos = jnp.arange(sq * n)
         s = jnp.where((pos[:, None] >= pos[None, :])[None, None], s, NEG_INF)
@@ -143,7 +150,8 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
         s = jnp.where(mask_g[:, None, None, :], s, NEG_INF)
     p = jnp.exp(s - s.max(axis=-1, keepdims=True))
     p = p / p.sum(axis=-1, keepdims=True)
-    og = jnp.einsum("bhqk,bkhd->bqhd", p, vg.astype(jnp.float32))
+    og = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vg.dtype), vg,
+                    preferred_element_type=jnp.float32)
     if kv_mask is not None:
         # query rows with NO visible key (all-padding, or causal window
         # fully padded) output 0, matching ring_attention (l = 0 there);
@@ -215,8 +223,8 @@ def local_attention(q, k, v, causal: bool = False, scale: float | None = None,
 
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if causal:
         sq, sk = q.shape[1], k.shape[1]
         mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
@@ -225,7 +233,8 @@ def local_attention(q, k, v, causal: bool = False, scale: float | None = None,
         s = jnp.where(kv_mask[:, None, None, :].astype(bool), s, NEG_INF)
     p = jnp.exp(s - s.max(axis=-1, keepdims=True))
     p = p / p.sum(axis=-1, keepdims=True)
-    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
     if kv_mask is not None:
         # query rows with NO visible key output 0, matching ring_attention
         # (causal ∧ kv_mask compose via s; see ulysses_attention)
