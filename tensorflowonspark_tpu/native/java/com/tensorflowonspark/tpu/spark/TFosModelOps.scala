@@ -1,0 +1,49 @@
+package com.tensorflowonspark.tpu.spark
+
+import org.apache.spark.sql.{DataFrame, Dataset, Row}
+
+// JavaConverters (not jdk.CollectionConverters): compiles on both the
+// Scala 2.12 and 2.13 Spark distributions
+import scala.collection.JavaConverters._
+
+/** Scala-facing sugar over [[TFosModel]] — the literal shape of the
+  * reference's Scala inference API (SURVEY.md §2.2 row 1): pure-Scala Spark
+  * jobs score TPU-framework exports DataFrame-in/DataFrame-out with no
+  * Python process.
+  *
+  * {{{
+  * import com.tensorflowonspark.tpu.spark.TFosModelOps._
+  *
+  * val scored: DataFrame = df.scoreWith(
+  *   exportDir = "/models/export",          // "" modelName = self-describing
+  *   inputMapping = Map("pixels" -> "image"),
+  *   batchSize = 512)
+  * }}}
+  *
+  * Build: scalac with Spark >= 3.4 jars + the compiled Java classes on the
+  * classpath (see ../../../README.md); deployment needs
+  * `libtfos_infer_jni.so` on `java.library.path` and the framework on
+  * `PYTHONPATH` on every executor.
+  */
+object TFosModelOps {
+
+  implicit class RichDataFrame(private val df: Dataset[Row]) extends AnyVal {
+
+    /** Batched inference over every row; returns one `array<float>` column
+      * (`outputColumn`) holding the model's first declared output. */
+    def scoreWith(
+        exportDir: String,
+        inputMapping: Map[String, String],
+        modelName: String = "",
+        batchSize: Int = 512,
+        inputTypes: Map[String, String] = Map.empty,
+        outputColumn: String = "prediction"): DataFrame = {
+      val model = new TFosModel(exportDir, modelName)
+        .setBatchSize(batchSize)
+        .setInputMapping(inputMapping.asJava)
+        .setOutputColumn(outputColumn)
+      inputTypes.foreach { case (k, v) => model.setInputType(k, v) }
+      model.transform(df)
+    }
+  }
+}

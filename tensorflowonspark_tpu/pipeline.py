@@ -536,7 +536,11 @@ def get_meta_graph_def(export_dir: str, tag_set: str = "serve") -> dict:
 
     Reference anchor: ``pipeline.py::get_meta_graph_def`` (SavedModel
     MetaGraphDef lookup).  The pytree-checkpoint equivalent of a signature:
-    what tensors the export contains.
+    what tensors the export contains — plus, for self-describing exports,
+    the serving signature itself (input/output names, dtypes, shapes)
+    under the reserved ``"__signature__"`` key, the MetaGraphDef's
+    signature_def equivalent.  Every other entry is a
+    ``{"shape", "dtype"}`` leaf record.
     """
     del tag_set  # parity only
     import os
@@ -544,7 +548,7 @@ def get_meta_graph_def(export_dir: str, tag_set: str = "serve") -> dict:
     import jax
     import numpy as np
 
-    from tensorflowonspark_tpu import ckpt
+    from tensorflowonspark_tpu import ckpt, saved_model
 
     path = export_dir
     model_sub = os.path.join(path, "model")
@@ -558,6 +562,16 @@ def get_meta_graph_def(export_dir: str, tag_set: str = "serve") -> dict:
         )
         leaf = np.asarray(leaf)
         flat[name] = {"shape": tuple(leaf.shape), "dtype": str(leaf.dtype)}
+    try:
+        signature = saved_model.read_signature(export_dir)
+    except FileNotFoundError:
+        return flat  # weights-only export: leaf listing is all there is
+    if "__signature__" in flat:  # a (pathological) leaf of that name wins
+        logger.warning(
+            "export %s has a '__signature__' leaf; omitting the serving "
+            "signature from get_meta_graph_def", export_dir)
+    else:
+        flat["__signature__"] = signature
     return flat
 
 

@@ -30,9 +30,17 @@ def _read(rel):
 
 
 def test_sources_ship_in_tree():
-    for rel in _CORE_SOURCES + [_SPARK_SOURCE]:
+    for rel in _CORE_SOURCES + [_SPARK_SOURCE,
+                                os.path.join("spark", "TFosModelOps.scala")]:
         assert os.path.exists(os.path.join(_PKG, rel)), rel
     assert os.path.exists(os.path.join(_JAVA_ROOT, "README.md"))
+
+
+def test_scala_sugar_delegates_to_the_java_adapter():
+    src = _read(os.path.join("spark", "TFosModelOps.scala"))
+    for needle in ("new TFosModel(exportDir, modelName)", "scoreWith",
+                   "setInputMapping(inputMapping.asJava)", "transform(df)"):
+        assert needle in src, f"TFosModelOps.scala missing {needle!r}"
 
 
 def test_native_declarations_match_jni_exports():

@@ -101,6 +101,24 @@ def test_validation_errors():
         MeshConfig(dp=3, tp=2, slices=2).dcn_axis()
 
 
+def test_two_slice_mesh_composes_with_pp_tp():
+    """The full stack at once: two slices (dp over DCN) × GPipe pipeline ×
+    stage-internal Megatron tp, one train step."""
+    import dataclasses
+
+    from tensorflowonspark_tpu.models import bert
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    cfg = dataclasses.replace(bert.Config.tiny(), pp_stages=2,
+                              pp_microbatches=2)
+    t = Trainer("bert", config=cfg,
+                mesh_config=MeshConfig(dp=2, pp=2, tp=2, slices=2),
+                devices=jax.devices()[:8])
+    batch = bert.example_batch(cfg, batch_size=8, seq_len=16)
+    losses = [float(np.asarray(t.step(batch)).mean()) for _ in range(3)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+
+
 def test_train_step_on_two_slice_mesh():
     """The VERDICT done-criterion: a 2×4 'two-slice' mesh forms and trains
     one real sharded step (ZeRO over fsdp riding the DCN axis, tp inside a

@@ -146,6 +146,23 @@ def test_export_forward_requires_example_batch(tmp_path):
             _toy_state(), str(tmp_path / "e"), forward_fn=_toy_forward())
 
 
+def test_get_meta_graph_def_carries_signature(tmp_path):
+    """SavedModel MetaGraphDef parity: the export description includes the
+    serving signature for self-describing exports."""
+    from tensorflowonspark_tpu.pipeline import get_meta_graph_def
+
+    fwd, state = _toy_forward(), _toy_state()
+    d = str(tmp_path / "exp")
+    compat.export_saved_model(
+        state, d, forward_fn=fwd,
+        example_batch={"x": np.zeros((2, 5), np.float32)})
+    meta = get_meta_graph_def(d)
+    assert meta["params/w"] == {"shape": (5, 3), "dtype": "float32"}
+    sig = meta["__signature__"]
+    assert sig["inputs"][0]["name"] == "x"
+    assert {o["name"] for o in sig["outputs"]} == {"score", "hidden"}
+
+
 def test_wrap_state_forward_arities():
     calls = []
 
