@@ -237,9 +237,18 @@ class Trainer:
                 self.config, batch_size=2).items()
             if k not in label_keys
         }
+        # Serialize a MESH-FREE rebuild of the forward, not self.forward_fn:
+        # the training model may close over the mesh (ring attention under
+        # sp>1, the GPipe shard_map under pp>1) and jax.export of those
+        # collective paths hangs/fails — and serving is single-device
+        # semantics anyway.  Params are layout-identical across the two
+        # builds (same module, mesh only changes execution strategy).
+        serve_model = self.module_lib.make_model(self.config)
+        serve_forward = self.module_lib.make_forward_fn(
+            serve_model, self.config)
         return compat.export_saved_model(
             state, export_dir,
-            forward_fn=saved_model.wrap_state_forward(self.forward_fn),
+            forward_fn=saved_model.wrap_state_forward(serve_forward),
             example_batch=example, model_name=self.model_name)
 
     def restore(self, path: str) -> None:

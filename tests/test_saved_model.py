@@ -305,6 +305,45 @@ np.savez({npz!r}, x=x, expect=expect)
 """
 
 
+def test_export_from_parallel_trainers_serves_single_device(tmp_path):
+    """A model TRAINED on a collective-bearing mesh (GPipe pp×tp; ring
+    attention sp) must export a mesh-free forward and serve single-device
+    with parity against the mesh predict — jax.export cannot serialize the
+    training-time shard_map, so Trainer.export rebuilds without the mesh."""
+    import dataclasses
+
+    import jax
+
+    from tensorflowonspark_tpu import ckpt
+    from tensorflowonspark_tpu.models import bert
+    from tensorflowonspark_tpu.parallel import MeshConfig
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    pp_cfg = dataclasses.replace(bert.Config.tiny(), pp_stages=2,
+                                 pp_microbatches=2)
+    cases = [
+        ("pp_tp", pp_cfg, MeshConfig(dp=2, pp=2, tp=2)),
+        ("sp_ring", bert.Config.tiny(), MeshConfig(dp=2, sp=2, tp=2)),
+    ]
+    for name, cfg, mc in cases:
+        t = Trainer("bert", config=cfg, mesh_config=mc,
+                    devices=jax.devices()[:8])
+        batch = bert.example_batch(cfg, batch_size=4)
+        t.step(batch)
+        d = str(tmp_path / name)
+        t.export(d)
+        fn, sig = saved_model.load_forward(d)
+        assert sig["batch"] == "polymorphic", name
+        state = ckpt.load_pytree(os.path.join(d, "model"))
+        serving = {k: v for k, v in batch.items()
+                   if k not in {"start_positions", "end_positions"}}
+        s_served, _ = fn(state, serving)
+        s_mesh, _ = t.predict(batch)
+        np.testing.assert_allclose(
+            np.asarray(s_served), np.asarray(s_mesh),
+            rtol=2e-4, atol=2e-4, err_msg=name)
+
+
 def test_serving_without_model_code(tmp_path):
     """Export in a subprocess whose model code this process NEVER imports;
     serve here from the artifact alone — the full SavedModel-parity proof."""
