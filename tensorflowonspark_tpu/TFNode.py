@@ -337,8 +337,17 @@ def start_cluster_server(ctx, num_gpus: int = 1, rdma: bool = False):
     return (None, None)
 
 
-def export_saved_model(sess_or_state, export_dir: str, *_a, **_kw) -> str:
-    """Reference-parity passthrough to :func:`compat.export_saved_model`."""
+def export_saved_model(sess_or_state, export_dir: str, *_a, **kwargs) -> str:
+    """Reference-parity passthrough to :func:`compat.export_saved_model`.
+
+    Keyword arguments (``forward_fn``/``example_batch``/``model_name`` for
+    self-describing exports) pass through; legacy positional TF arguments
+    are accepted and ignored.
+    """
+    import inspect
+
     from tensorflowonspark_tpu import compat
 
-    return compat.export_saved_model(sess_or_state, export_dir)
+    accepted = inspect.signature(compat.export_saved_model).parameters
+    known = {k: v for k, v in kwargs.items() if k in accepted}
+    return compat.export_saved_model(sess_or_state, export_dir, **known)

@@ -336,3 +336,94 @@ def _fixed_batch_caller(exported, fixed: int) -> Callable:
         return jax.tree.map(merge, *outs)
 
     return fn
+
+
+# ---------------------------------------------------------------------------
+# CLI — the `saved_model_cli show|run` parity surface
+# ---------------------------------------------------------------------------
+
+
+def _cli(argv=None) -> int:
+    """``python -m tensorflowonspark_tpu.saved_model show|run ...``
+
+    Reference parity: TF users inspect and smoke-test a SavedModel with
+    ``saved_model_cli show --dir D`` / ``saved_model_cli run``; this is the
+    same surface for this framework's exports.
+    """
+    import argparse
+    import json as _json
+    import sys as _sys
+
+    from tensorflowonspark_tpu import util
+
+    p = argparse.ArgumentParser(prog="tensorflowonspark_tpu.saved_model")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    p_show = sub.add_parser("show", help="print the export's signature and "
+                                         "weight leaves")
+    p_show.add_argument("--dir", required=True)
+    p_run = sub.add_parser("run", help="feed .npz inputs through the "
+                                       "serialized forward")
+    p_run.add_argument("--dir", required=True)
+    p_run.add_argument("--inputs", required=True,
+                       help=".npz whose arrays are keyed by input name")
+    p_run.add_argument("--outputs", default=None,
+                       help="optional .npz path to write outputs to")
+    args = p.parse_args(argv)
+
+    util.ensure_jax_platform()
+    if args.cmd == "show":
+        from tensorflowonspark_tpu.pipeline import get_meta_graph_def
+
+        meta = get_meta_graph_def(args.dir)
+        sig = meta.pop("__signature__", None)
+        if sig is None:
+            print("weights-only export (no serialized forward); leaves:")
+        else:
+            print(_json.dumps(sig, indent=1))
+            print("weight leaves:")
+        for name, rec in meta.items():
+            print(f"  {name}: {rec['dtype']}{list(rec['shape'])}")
+        return 0
+
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu import ckpt
+
+    try:
+        fn, sig = load_forward(args.dir)
+    except FileNotFoundError:
+        print(f"{args.dir} is a weights-only export (no serialized "
+              "forward) — `run` needs a self-describing export; serve it "
+              "through TFModel with model_name/predict_fn instead",
+              file=_sys.stderr)
+        return 2
+    state = ckpt.load_pytree(_join(args.dir, "model"))
+    with np.load(args.inputs) as z:
+        batch = {k: z[k] for k in z.files}
+    out = fn(state, batch)
+    if isinstance(out, Mapping):
+        # flatten nested dicts to the signature's "/"-joined leaf names
+        arrays = {}
+        for keypath, leaf in jax.tree_util.tree_flatten_with_path(out)[0]:
+            name = "/".join(
+                str(getattr(k, "key", getattr(k, "idx", k)))
+                for k in keypath)
+            arrays[name] = np.asarray(leaf)
+    else:
+        # tuple/array outputs: name leaves from the signature's order
+        arrays = {o["name"]: np.asarray(leaf) for o, leaf in
+                  zip(sig["outputs"], jax.tree_util.tree_leaves(out))}
+    for k, v in arrays.items():
+        print(f"{k}: {v.dtype}{list(v.shape)} "
+              f"first={np.ravel(v)[:4].tolist()}")
+    if args.outputs:
+        np.savez(args.outputs, **arrays)
+        print(f"wrote {args.outputs}")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess
+    import sys
+
+    sys.exit(_cli())

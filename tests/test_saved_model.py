@@ -277,6 +277,41 @@ def test_explicit_predict_fn_beats_serialized_forward(tmp_path):
     assert [float(r["score"]) for r in out] == [42.0, 42.0, 42.0]
 
 
+def test_saved_model_cli_show_and_run(tmp_path):
+    """`python -m tensorflowonspark_tpu.saved_model show|run` — the
+    saved_model_cli parity surface — against a real export."""
+    fwd, state = _toy_forward(), _toy_state()
+    d = str(tmp_path / "exp")
+    compat.export_saved_model(
+        state, d, forward_fn=fwd,
+        example_batch={"x": np.zeros((2, 5), np.float32)})
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    show = subprocess.run(
+        [sys.executable, "-m", "tensorflowonspark_tpu.saved_model",
+         "show", "--dir", d],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo)
+    assert show.returncode == 0, show.stderr[-2000:]
+    assert '"format": "tfos-stablehlo-v1"' in show.stdout
+    assert "params/w: float32[5, 3]" in show.stdout
+
+    x = np.random.RandomState(5).randn(3, 5).astype(np.float32)
+    np.savez(tmp_path / "in.npz", x=x)
+    out_npz = str(tmp_path / "out.npz")
+    run = subprocess.run(
+        [sys.executable, "-m", "tensorflowonspark_tpu.saved_model",
+         "run", "--dir", d, "--inputs", str(tmp_path / "in.npz"),
+         "--outputs", out_npz],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo)
+    assert run.returncode == 0, run.stderr[-2000:]
+    with np.load(out_npz) as z:
+        np.testing.assert_allclose(
+            z["score"], _jit_expect(fwd, state, x)["score"], atol=1e-6)
+
+
 _EXPORTER_SCRIPT = r"""
 import sys
 import numpy as np
