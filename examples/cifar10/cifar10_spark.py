@@ -60,9 +60,11 @@ def map_fun(args, ctx):
             "label": np.int32(ex["label"][1][0]),
         }
 
-    # file-level sharding: every node takes a strided slice of part files
+    # file-level sharding: every node takes a strided slice of part files —
+    # strided by executor_id, NOT task_index (under master_node="chief" the
+    # chief and worker:0 share task_index 0 and would collide on a shard)
     shard = readers.shard_files(os.path.join(args.data_dir, "part-*"),
-                                ctx.task_index, ctx.num_workers)
+                                ctx.executor_id, ctx.num_workers)
     loss, steps = None, 0
     for batch in readers.tfrecord_batches(
         shard,

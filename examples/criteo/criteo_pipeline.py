@@ -71,6 +71,58 @@ def train_fun(args, ctx):
         trainer.export(ctx.absolute_path(args.export_dir))
 
 
+def parquet_train_fun(args, ctx):
+    """InputMode.TENSORFLOW trainer: each node reads its shard of the
+    Parquet part files through the columnar Arrow→HBM path
+    (``readers.parquet_batches`` — row groups → column buffers →
+    double-buffered ``device_put``), no Spark feed anywhere."""
+    from tensorflowonspark_tpu import util
+
+    util.ensure_jax_platform()
+    import dataclasses
+
+    import numpy as np
+
+    from tensorflowonspark_tpu import readers
+    from tensorflowonspark_tpu.models import widedeep
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    config = widedeep.Config.tiny() if args.tiny else widedeep.Config()
+    config = dataclasses.replace(config, table_lr=args.lr * 10.0)
+    trainer = Trainer("wide_deep", config=config, learning_rate=args.lr)
+
+    # the same (unresolved) path the driver wrote to — resolving only on
+    # the read side would diverge from the writer under a remote defaultFS;
+    # strided by executor_id, NOT task_index (chief and worker:0 share
+    # task_index 0 under master_node="chief")
+    shard = readers.shard_files(
+        args.parquet_dir + "/part-*.parquet",
+        ctx.executor_id, ctx.num_workers)
+
+    def stage(batch):
+        # drop_remainder=True means only exact-batch_size batches reach
+        # this stager
+        assert batch["dense"].shape[0] == args.batch_size
+        return trainer.shard({
+            "dense": batch["dense"].astype(np.float32),
+            "cat": batch["cat"].astype(np.int32),
+            "label": batch["label"].astype(np.int32),
+        })
+
+    loss, steps = None, 0
+    for batch in readers.parquet_batches(
+            shard, args.batch_size, num_epochs=args.epochs,
+            drop_remainder=True, prefetch=2, device_put=stage):
+        loss = trainer.step(batch)
+        steps += 1
+    ctx.mgr.set("final_loss",
+                float(np.asarray(loss).mean()) if loss is not None else None)
+    ctx.mgr.set("steps", steps)
+    ctx.mgr.set("shard_files", len(shard))
+    if ctx.job_name == "chief":
+        trainer.export(ctx.absolute_path(args.export_dir))
+
+
 def synth_criteo(n: int, buckets: int, seed: int = 0):
     """Criteo-shaped rows with a learnable click signal."""
     import numpy as np
@@ -99,6 +151,11 @@ def main(argv=None):
     p.add_argument("--tiny", action="store_true", default=True)
     p.add_argument("--full", dest="tiny", action="store_false")
     p.add_argument("--master", default=None)
+    p.add_argument("--input", choices=["spark", "parquet"], default="spark",
+                   help="spark: estimator feed through the cluster queues; "
+                        "parquet: save the DataFrame as Parquet and train "
+                        "InputMode.TENSORFLOW over the columnar path")
+    p.add_argument("--parquet_dir", default="/tmp/criteo_parquet")
     args = p.parse_args(argv)
 
     from tensorflowonspark_tpu.models import widedeep
@@ -117,13 +174,41 @@ def main(argv=None):
         synth_criteo(args.num_samples, buckets), ["dense", "cat", "label"]
     ).repartition(args.cluster_size)
 
-    est = (TFEstimator(train_fun, tf_args=args)
-           .setClusterSize(args.cluster_size)
-           .setBatchSize(args.batch_size)
-           .setEpochs(args.epochs)
-           .setExportDir(args.export_dir)
-           .setModelName("wide_deep"))
-    model = est.fit(df)
+    if args.input == "parquet":
+        # columnar acceptance path: DataFrame → Parquet part files (written
+        # from the executors) → InputMode.TENSORFLOW nodes reading their
+        # file shards through readers.parquet_batches → same export
+        import shutil
+
+        from tensorflowonspark_tpu import TFCluster, dfutil, fs
+        from tensorflowonspark_tpu.pipeline import TFModel
+
+        if "://" in args.parquet_dir:
+            # remote dirs can't be rmtree'd from here; stale part files
+            # would silently mix with (or schema-clash against) this run's
+            stale = fs.glob(args.parquet_dir + "/part-*.parquet")
+            if stale:
+                raise SystemExit(
+                    f"--parquet_dir {args.parquet_dir} already holds "
+                    f"{len(stale)} part files; remove them first")
+        else:
+            shutil.rmtree(args.parquet_dir, ignore_errors=True)
+        dfutil.saveAsParquet(df, args.parquet_dir)
+        cluster = TFCluster.run(
+            sc, parquet_train_fun, args, num_executors=args.cluster_size,
+            input_mode=TFCluster.InputMode.TENSORFLOW, master_node="chief")
+        cluster.shutdown(grace_secs=120)
+        model = (TFModel(tf_args=args)
+                 .setExportDir(args.export_dir)
+                 .setModelName("wide_deep"))
+    else:
+        est = (TFEstimator(train_fun, tf_args=args)
+               .setClusterSize(args.cluster_size)
+               .setBatchSize(args.batch_size)
+               .setEpochs(args.epochs)
+               .setExportDir(args.export_dir)
+               .setModelName("wide_deep"))
+        model = est.fit(df)
 
     scored = (model
               .setBatchSize(256)

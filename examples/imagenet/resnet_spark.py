@@ -67,8 +67,11 @@ def map_fun(args, ctx):
         for _ in range(args.steps):
             loss = trainer.step(device_batch)
     else:
+        # stride by executor_id, NOT task_index: under master_node="chief"
+        # the chief and worker:0 both have task_index 0 and would read the
+        # same shard while another went unread
         shard = readers.shard_files(os.path.join(args.data_dir, "part-*"),
-                                    ctx.task_index, ctx.num_workers)
+                                    ctx.executor_id, ctx.num_workers)
         for batch in readers.tfrecord_batches(
             shard,
             args.batch_size,

@@ -41,12 +41,39 @@ def test_cifar10_tfrecord_example(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "steps=" in out and "shard=" in out
     assert "examples/sec" in out  # metrics hook aggregated on the driver
+    # the two nodes' file shards must be DISJOINT and cover every part
+    # file — under master_node="chief" a task_index-based stride gave the
+    # chief and worker:0 the same shard (both index 0) and dropped a shard
+    import ast
+    import re
+
+    shards = [ast.literal_eval(m) for m in re.findall(r"shard=(\[[^]]*\])",
+                                                      out)]
+    assert len(shards) == 2
+    assert not (set(shards[0]) & set(shards[1])), shards
+    assert len(set(shards[0]) | set(shards[1])) == len(
+        list((tmp_path / "tfr").glob("part-*")))
 
 
 def test_criteo_pipeline_example(tmp_path, capsys):
     mod = _load("criteo", "criteo_pipeline")
     mod.main(["--cluster_size", "2", "--epochs", "2",
               "--num_samples", "512", "--batch_size", "64",
+              "--export_dir", str(tmp_path / "export")])
+    out = capsys.readouterr().out
+    assert "scored 512 rows" in out
+
+
+def test_criteo_parquet_columnar_example(tmp_path, capsys):
+    """--input parquet: the acceptance config over the Arrow→HBM columnar
+    path (DataFrame → Parquet part files → InputMode.TENSORFLOW nodes
+    reading file shards via readers.parquet_batches → self-describing
+    export → transform)."""
+    mod = _load("criteo", "criteo_pipeline")
+    mod.main(["--cluster_size", "2", "--epochs", "2",
+              "--num_samples", "512", "--batch_size", "64",
+              "--input", "parquet",
+              "--parquet_dir", str(tmp_path / "parquet"),
               "--export_dir", str(tmp_path / "export")])
     out = capsys.readouterr().out
     assert "scored 512 rows" in out
