@@ -23,6 +23,9 @@ Public surface mirrors the reference package:
 - :mod:`tensorflowonspark_tpu.pipeline` — Spark ML ``TFEstimator``/``TFModel``.
 - :mod:`tensorflowonspark_tpu.dfutil` — DataFrame↔TFRecord conversion.
 - :mod:`tensorflowonspark_tpu.TFParallel` — independent single-node runs.
+- :mod:`tensorflowonspark_tpu.saved_model` — self-describing exports
+  (weights + StableHLO forward + signature; ``python -m
+  tensorflowonspark_tpu.saved_model show|run`` for inspection).
 """
 
 __version__ = "0.1.0"
