@@ -140,6 +140,36 @@ def test_weights_only_export_has_no_forward(tmp_path):
         saved_model.load_forward(d)
 
 
+def test_corrupt_artifacts_fail_loudly(tmp_path):
+    """Damaged exports must raise promptly and clearly — never hang or
+    serve garbage (the artifact-layer sibling of the control plane's
+    hostile-peer tests)."""
+    fwd, state = _toy_forward(), _toy_state()
+    d = str(tmp_path / "exp")
+    compat.export_saved_model(
+        state, d, forward_fn=fwd,
+        example_batch={"x": np.zeros((2, 5), np.float32)})
+    fdir = os.path.join(d, "saved_forward")
+
+    # truncated serialized forward
+    with open(os.path.join(fdir, "forward.bin"), "rb") as f:
+        blob = f.read()
+    with open(os.path.join(fdir, "forward.bin"), "wb") as f:
+        f.write(blob[: len(blob) // 3])
+    with pytest.raises(Exception):
+        saved_model.load_forward(d)
+
+    # invalid signature JSON
+    with open(os.path.join(fdir, "forward.bin"), "wb") as f:
+        f.write(blob)  # restore the forward
+    with open(os.path.join(fdir, "signature.json"), "wb") as f:
+        f.write(b"{not json")
+    with pytest.raises(ValueError):  # json.JSONDecodeError is a ValueError
+        saved_model.read_signature(d)
+    with pytest.raises(ValueError):
+        saved_model.load_forward(d)
+
+
 def test_export_forward_requires_example_batch(tmp_path):
     with pytest.raises(ValueError, match="example_batch"):
         compat.export_saved_model(
