@@ -297,6 +297,8 @@ def run(
     num_chips_per_executor: int | None = None,
     feed_chunk: int = 256,
     default_fs: str | None = None,
+    health_probe: bool | None = None,
+    health_probe_timeout: float = 60.0,
 ) -> TFCluster:
     """Launch the accelerator cluster on Spark executors.
 
@@ -311,6 +313,11 @@ def run(
     - ``master_node`` names the chief job (e.g. ``"chief"``); executor 0
       takes that role.  ``eval_node=True`` makes the last executor an
       ``evaluator`` (excluded from the training mesh).
+    - ``health_probe``: slice-health check at rendezvous (SURVEY §5 TPU
+      plan).  ``None`` (default) probes only on executors that claimed real
+      chips; a wedged chip becomes a fast bootstrap failure naming the sick
+      executor instead of a silent mesh hang.  See
+      :mod:`tensorflowonspark_tpu.health`.
     """
     if num_executors is None:
         num_executors = getattr(sc, "defaultParallelism", 1)
@@ -366,6 +373,8 @@ def run(
         "feed_chunk": feed_chunk,
         "default_fs": default_fs or "file://",
         "reservation_timeout": reservation_timeout,
+        "health_probe": health_probe,
+        "health_probe_timeout": health_probe_timeout,
     }
 
     node_fn = TFSparkNode.run(map_fun, tf_args, cluster_meta, tensorboard, log_dir)
@@ -390,6 +399,10 @@ def run(
 
     deadline = _time.monotonic() + reservation_timeout
     while True:
+        sick = server.kv_get("health_error")
+        if sick:
+            server.stop()
+            raise RuntimeError(f"node failed chip health probe: {sick}")
         if thread_error:
             server.stop()
             raise RuntimeError("cluster bootstrap failed") from thread_error[0]

@@ -32,7 +32,8 @@ import signal
 import time
 from typing import Any, Callable, Iterator
 
-from tensorflowonspark_tpu import TFManager, chip_info, marker, reservation, util
+from tensorflowonspark_tpu import (TFManager, chip_info, health, marker,
+                                   reservation, util)
 
 logger = logging.getLogger(__name__)
 
@@ -250,6 +251,28 @@ class _MapFn:
         }
 
         client = reservation.Client(tuple(meta["server_addr"]), meta["auth_token"])
+
+        # slice-health check at rendezvous (SURVEY §5 failure-detection TPU
+        # plan): a wedged chip must become a fast, attributed bootstrap
+        # failure here — if it registers, the first collective hangs the
+        # whole mesh with nothing shorter than feed_timeout to notice
+        if health.should_probe(meta, chips):
+            probe_err = health.probe_chip_health(
+                meta.get("health_probe_timeout", health.DEFAULT_TIMEOUT_S)
+            )
+            if probe_err:
+                msg = (f"executor {executor_id} ({job_name}:{task_index}) "
+                       f"failed chip health probe at rendezvous: {probe_err}")
+                try:  # name the sick executor on the driver's rendezvous kv
+                    client.put("health_error", msg)
+                except Exception:
+                    pass
+                try:
+                    mgr.get_queue("error").put(msg)
+                except Exception:
+                    pass
+                raise RuntimeError(msg)
+
         # executor 0 publishes the jax.distributed coordinator address before
         # registering, so every node can read it after the barrier
         if executor_id == 0:

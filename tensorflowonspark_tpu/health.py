@@ -1,0 +1,94 @@
+"""Chip/slice health probe at rendezvous.
+
+SURVEY.md §5 (failure detection, TPU plan): "same restart-from-checkpoint
+model, plus **slice-health check at rendezvous**".  The reference's only
+bootstrap defense was the reservation timeout
+(``tensorflowonspark/reservation.py::Client.await_reservations``) — enough
+for a node that never starts, useless for a node whose accelerator is
+*wedged*: on this hardware a broken tunnel chip accepts dispatches and never
+completes them (the round-4 outage), so such a node registers successfully
+and then hangs the whole mesh at the first collective, with nothing shorter
+than ``feed_timeout`` to notice.
+
+The probe runs a tiny jit'd matmul **in a watchdogged spawned subprocess**
+and requires the bytes back on the host (``device_get`` — readiness acks
+alone are not proof on remote backends).  A hang or crash turns into a fast,
+attributed bootstrap failure: the node publishes the failure on the
+rendezvous kv blackboard and raises, so the driver's
+:func:`tensorflowonspark_tpu.TFCluster.run` wait loop aborts naming the sick
+executor instead of timing out anonymously.
+
+The subprocess matters twice over: it provides the watchdog (a wedged device
+op cannot be interrupted in-process), and it keeps the bootstrap task's own
+process free of any JAX/TPU runtime state — the trainer process must be the
+first to own the chips (SURVEY §7 hard part (a)).
+
+Env knobs:
+
+- ``TFOS_HEALTH_PROBE`` — force-enable ("1") or disable ("0") regardless of
+  chip count.  Default: probe only when real chips were claimed (a CPU-only
+  bootstrap has nothing to wedge, keeping healthy-path overhead at zero).
+- ``TFOS_HEALTH_PROBE_HANG`` — test hook: the probe child sleeps forever,
+  simulating the wedged chip (see ``tests/test_cluster.py``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_TIMEOUT_S = 60.0
+
+
+def _probe_child() -> None:
+    """Child body: touch the device and prove a matmul completes."""
+    if os.environ.get("TFOS_HEALTH_PROBE_HANG"):
+        time.sleep(3600)  # simulated wedge (never returns inside the watchdog)
+    from tensorflowonspark_tpu import util
+
+    util.ensure_jax_platform()
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((128, 128), jnp.bfloat16)
+    y = jax.jit(lambda a: (a @ a).sum())(x)
+    float(jax.device_get(y))  # the bytes, not an ack
+
+
+def probe_chip_health(timeout_s: float = DEFAULT_TIMEOUT_S) -> str | None:
+    """Run the watchdogged probe; return ``None`` if healthy, else a reason.
+
+    Uses the *spawn* context (fork would clone any JAX threads the executor
+    holds) and SIGKILLs the child on timeout — a wedged device op ignores
+    gentler signals.
+    """
+    import multiprocessing
+
+    ctx = multiprocessing.get_context("spawn")
+    p = ctx.Process(target=_probe_child, name="tfos-health-probe", daemon=True)
+    t0 = time.monotonic()
+    p.start()
+    p.join(timeout_s)
+    if p.is_alive():
+        p.kill()
+        p.join(5.0)
+        return (f"device health probe hung for {timeout_s}s "
+                "(chip/slice wedged?)")
+    if p.exitcode != 0:
+        return f"device health probe crashed (exit code {p.exitcode})"
+    logger.info("chip health probe passed in %.1fs", time.monotonic() - t0)
+    return None
+
+
+def should_probe(cluster_meta: dict, chips: list) -> bool:
+    """Decide whether this bootstrap should probe (see module docstring)."""
+    env = os.environ.get("TFOS_HEALTH_PROBE")
+    if env is not None:
+        return env not in ("0", "", "false", "no")
+    configured = cluster_meta.get("health_probe")
+    if configured is not None:
+        return bool(configured)
+    return bool(chips)

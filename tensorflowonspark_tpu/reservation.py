@@ -187,6 +187,11 @@ class Server:
             )
         return self.reservations.get()
 
+    def kv_get(self, key: str, default: Any = None) -> Any:
+        """In-process read of the kv blackboard (driver side — no socket)."""
+        with self._kv_lock:
+            return self._kv.get(key, default)
+
     def stop(self) -> None:
         self._stop.set()
         if self._listener is not None:

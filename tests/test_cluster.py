@@ -264,6 +264,59 @@ def test_streaming_feed_and_graceful_ssc_stop(sc):
         assert mgr.get("final_loss") < 1.0
 
 
+def test_wedged_chip_fails_bootstrap_fast_and_named(monkeypatch):
+    """Slice-health check at rendezvous (SURVEY §5 TPU plan, VERDICT r4 #2):
+    a wedged chip — simulated by the probe child sleeping forever — must
+    become a fast bootstrap failure on the driver that NAMES the sick
+    executor, not a silent mesh hang bounded only by feed_timeout."""
+    monkeypatch.setenv("TFOS_HEALTH_PROBE", "1")
+    monkeypatch.setenv("TFOS_HEALTH_PROBE_HANG", "1")
+    ctx = LocalSparkContext("local-cluster[2,1,1024]", "health-wedge-test")
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError,
+                           match=r"executor \d .*health probe.*hung"):
+            TFCluster.run(sc=ctx, map_fun=linear_train_fun, tf_args=None,
+                          num_executors=2, reservation_timeout=120,
+                          health_probe_timeout=3.0)
+        # attributed failure arrived via the kv fast-path, well inside the
+        # reservation timeout
+        assert time.monotonic() - t0 < 60
+    finally:
+        ctx.stop()
+
+
+def test_healthy_probe_passes_and_cluster_trains(monkeypatch):
+    """Force-enabled probe on a healthy backend: bootstrap proceeds and the
+    cluster still trains end-to-end (probe leaves no residue)."""
+    monkeypatch.setenv("TFOS_HEALTH_PROBE", "1")
+    monkeypatch.delenv("TFOS_HEALTH_PROBE_HANG", raising=False)
+    ctx = LocalSparkContext("local-cluster[2,1,1024]", "health-ok-test")
+    try:
+        cluster = TFCluster.run(sc=ctx, map_fun=linear_train_fun, tf_args=None,
+                                num_executors=2, health_probe_timeout=90.0)
+        data = _make_regression_data(n=256)
+        cluster.train(ctx.parallelize(data, 2), num_epochs=2, feed_timeout=120)
+        cluster.shutdown(grace_secs=30)
+        authkey = bytes.fromhex(cluster.cluster_meta["authkey_hex"])
+        for meta in cluster.cluster_info:
+            mgr = TFManager.connect(tuple(meta["addr"]), authkey)
+            assert mgr.get("state") == "finished"
+    finally:
+        ctx.stop()
+
+
+def test_probe_skipped_when_no_chips():
+    """Default policy: zero claimed chips (the CPU test substrate) → no
+    probe, zero bootstrap overhead (healthy-path requirement)."""
+    from tensorflowonspark_tpu import health
+
+    assert health.should_probe({"health_probe": None}, chips=[]) is False
+    assert health.should_probe({"health_probe": None}, chips=[0]) is True
+    assert health.should_probe({"health_probe": True}, chips=[]) is True
+    assert health.should_probe({"health_probe": False}, chips=[0]) is False
+
+
 def test_train_requires_spark_mode(sc):
     cluster = TFCluster.run(sc, tf_mode_fun, tf_args=None, num_executors=2,
                             input_mode=TFCluster.InputMode.TENSORFLOW)
