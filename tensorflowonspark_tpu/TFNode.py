@@ -348,6 +348,28 @@ def export_saved_model(sess_or_state, export_dir: str, *_a, **kwargs) -> str:
 
     from tensorflowonspark_tpu import compat
 
+    # Only the documented legacy-TF keywords may be dropped silently; any
+    # other unknown kwarg (a typo like ``exmaple_batch``) must fail loudly
+    # rather than quietly producing a weights-only export.
+    legacy_tf_kwargs = {
+        "signatures", "tag_set", "signature_def_key", "as_text",
+        "clear_devices", "strip_default_attrs", "serving_input_receiver_fn",
+    }
     accepted = inspect.signature(compat.export_saved_model).parameters
-    known = {k: v for k, v in kwargs.items() if k in accepted}
+    known, dropped = {}, []
+    for k, v in kwargs.items():
+        if k in accepted:
+            known[k] = v
+        elif k in legacy_tf_kwargs:
+            logger.info("export_saved_model: ignoring legacy TF kwarg %r", k)
+        else:
+            dropped.append(k)
+    if dropped:
+        # declaration order: skip the two positionals, keep the real kwargs
+        kwarg_names = list(accepted)[2:]
+        raise TypeError(
+            f"export_saved_model got unexpected keyword argument(s) "
+            f"{sorted(dropped)}; accepted: {kwarg_names} plus "
+            f"legacy TF kwargs {sorted(legacy_tf_kwargs)}"
+        )
     return compat.export_saved_model(sess_or_state, export_dir, **known)

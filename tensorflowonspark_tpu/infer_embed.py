@@ -13,6 +13,12 @@ pattern TF-Java used with libtensorflow.
 The call protocol mirrors TF-Java's ``Session.Runner``: ``load`` →
 ``set_input``×N → ``run`` → ``get_output``.  All state lives in an integer
 handle registry so the C side never holds Python object pointers.
+
+Multi-output models serve every named output: after ``run``,
+``output_count``/``output_name`` enumerate the flattened output names (the
+signature's declared order first) and ``output_shape``/``get_output`` accept
+a name (``""`` = the first declared output, the original single-output
+convention).
 """
 
 from __future__ import annotations
@@ -98,7 +104,7 @@ def load(export_dir: str, model_name: str = "") -> int:
             "input_names": input_names,
             "output_order": output_order,
             "inputs": {},
-            "output": None,
+            "outputs": None,  # ordered {name: float32 array} after run()
         }
     logger.info("infer_embed: loaded %s as handle %d (inputs %s)",
                 export_dir, h, input_names)
@@ -122,30 +128,88 @@ def set_input(handle: int, name: str, data: bytes, shape: tuple,
     st["inputs"][name] = arr
 
 
+def _flatten_named(out) -> dict[str, np.ndarray]:
+    """Model output (array | tuple | nested dict) → ordered {name: float32}.
+
+    Names follow the export signature's convention
+    (``saved_model._leaf_name``): '/'-joined dict-key paths for nested
+    dicts — so a model returning ``{"a": {"b": x}}`` serves output
+    ``a/b`` — positional ``output_i`` for bare arrays, stringified indices
+    for tuple members.  Mapping insertion order is preserved (JAX's own
+    flatten sorts dict keys, which would lose the authored "first declared
+    output" the C ABI's single-output convention depends on).
+    """
+    from collections.abc import Mapping as _Mapping
+
+    named: dict[str, np.ndarray] = {}
+
+    def rec(prefix: tuple, val) -> None:
+        if isinstance(val, _Mapping):
+            for k, v in val.items():
+                rec(prefix + (str(k),), v)
+        elif isinstance(val, (list, tuple)):
+            for i, v in enumerate(val):
+                rec(prefix + (str(i),), v)
+        else:
+            name = "/".join(prefix) if prefix else f"output_{len(named)}"
+            named[name] = np.asarray(val, dtype=np.float32)
+
+    rec((), out)
+    return named
+
+
 def run(handle: int) -> None:
     st = _HANDLES[handle]
     missing = [n for n in st["input_names"] if n not in st["inputs"]]
     if missing:
         raise ValueError(f"inputs not set before run: {missing}")
     out = st["fn"](st["params"], dict(st["inputs"]))
-    if isinstance(out, dict):  # multi-output models: first *declared* output
-        order = st.get("output_order")
-        out = out[order[0]] if order else next(iter(out.values()))
-    st["output"] = np.asarray(out, dtype=np.float32)
+    named = _flatten_named(out)
+    order = st.get("output_order")
+    if order:
+        # the signature's declared order wins; anything it doesn't name
+        # (shouldn't happen, but never drop data) trails in flatten order
+        ordered = {n: named[n] for n in order if n in named}
+        ordered.update((n, v) for n, v in named.items() if n not in ordered)
+        named = ordered
+    st["outputs"] = named
     st["inputs"] = {}
 
 
-def output_shape(handle: int) -> tuple:
-    out = _HANDLES[handle]["output"]
-    if out is None:
+def _resolve_output(handle: int, name: str = "") -> np.ndarray:
+    st = _HANDLES[handle]
+    outputs = st.get("outputs")
+    if not outputs:
         raise ValueError("run() has not produced an output")
-    return tuple(out.shape)
+    if name == "":
+        return next(iter(outputs.values()))  # first *declared* output
+    if name not in outputs:
+        raise KeyError(
+            f"unknown output {name!r}; model outputs are {list(outputs)}")
+    return outputs[name]
 
 
-def get_output(handle: int) -> bytes:
-    out = _HANDLES[handle]["output"]
-    if out is None:
+def output_count(handle: int) -> int:
+    return len(_HANDLES[handle].get("outputs") or ())
+
+
+def output_name(handle: int, index: int) -> str:
+    outputs = _HANDLES[handle].get("outputs")
+    if not outputs:
         raise ValueError("run() has not produced an output")
+    names = list(outputs)
+    if not 0 <= index < len(names):
+        raise IndexError(f"output index {index} out of range "
+                         f"({len(names)} outputs)")
+    return names[index]
+
+
+def output_shape(handle: int, name: str = "") -> tuple:
+    return tuple(_resolve_output(handle, name).shape)
+
+
+def get_output(handle: int, name: str = "") -> bytes:
+    out = _resolve_output(handle, name)
     return np.ascontiguousarray(out, dtype=np.float32).tobytes()
 
 

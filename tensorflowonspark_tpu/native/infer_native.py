@@ -122,6 +122,20 @@ def _load():
                 lib.tfos_infer_get_output.restype = i64
                 lib.tfos_infer_get_output.argtypes = [
                     i64, ctypes.POINTER(ctypes.c_float), i64]
+                lib.tfos_infer_output_count.restype = ctypes.c_int
+                lib.tfos_infer_output_count.argtypes = [i64]
+                lib.tfos_infer_output_name.restype = i64
+                lib.tfos_infer_output_name.argtypes = [
+                    i64, ctypes.c_int, ctypes.c_char_p, i64]
+                lib.tfos_infer_output_rank_named.restype = ctypes.c_int
+                lib.tfos_infer_output_rank_named.argtypes = [
+                    i64, ctypes.c_char_p]
+                lib.tfos_infer_output_shape_named.restype = ctypes.c_int
+                lib.tfos_infer_output_shape_named.argtypes = [
+                    i64, ctypes.c_char_p, i64p]
+                lib.tfos_infer_get_output_named.restype = i64
+                lib.tfos_infer_get_output_named.argtypes = [
+                    i64, ctypes.c_char_p, ctypes.POINTER(ctypes.c_float), i64]
                 lib.tfos_infer_close.restype = ctypes.c_int
                 lib.tfos_infer_close.argtypes = [i64]
             except OSError as e:
@@ -186,20 +200,40 @@ class Session:
         if self._lib.tfos_infer_run(self._h) != 0:
             raise RuntimeError(self._err())
 
-    def output(self) -> np.ndarray:
-        rank = self._lib.tfos_infer_output_rank(self._h)
+    def output(self, name: str = "") -> np.ndarray:
+        """The named output of the last run ("" = first declared output)."""
+        cname = name.encode()
+        rank = self._lib.tfos_infer_output_rank_named(self._h, cname)
         if rank < 0:
             raise RuntimeError(self._err())
         shape = (ctypes.c_int64 * max(rank, 1))()
-        if self._lib.tfos_infer_output_shape(self._h, shape) != 0:
+        if self._lib.tfos_infer_output_shape_named(self._h, cname,
+                                                   shape) != 0:
             raise RuntimeError(self._err())
         dims = tuple(shape[i] for i in range(rank))
         n = int(np.prod(dims)) if dims else 1
         buf = (ctypes.c_float * n)()
-        got = self._lib.tfos_infer_get_output(self._h, buf, n)
+        got = self._lib.tfos_infer_get_output_named(self._h, cname, buf, n)
         if got < 0:
             raise RuntimeError(self._err())
         return np.ctypeslib.as_array(buf).reshape(dims).copy()
+
+    def output_names(self) -> list[str]:
+        """Names of every output of the last run, declared order first."""
+        count = self._lib.tfos_infer_output_count(self._h)
+        if count < 0:
+            raise RuntimeError(self._err())
+        names = []
+        for i in range(count):
+            buf = ctypes.create_string_buffer(512)
+            if self._lib.tfos_infer_output_name(self._h, i, buf, 512) < 0:
+                raise RuntimeError(self._err())
+            names.append(buf.value.decode())
+        return names
+
+    def outputs(self) -> dict[str, np.ndarray]:
+        """Every named output of the last run (the DataFrame-out shape)."""
+        return {name: self.output(name) for name in self.output_names()}
 
     def predict(self, array: np.ndarray, name: str = "") -> np.ndarray:
         """Single-input convenience: set_input → run → output."""

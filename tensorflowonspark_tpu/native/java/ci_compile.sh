@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Deployment-side CI lane for the JVM adapter sources (VERDICT r4 item 3:
+# "the .java/.scala sources have never been through a compiler" — this is
+# the lane that puts them through one wherever a JDK exists).
+#
+#   ./ci_compile.sh            # core classes (no Spark needed) + jar
+#   SPARK_HOME=... ./ci_compile.sh   # + the Spark DataFrame adapter
+#
+# Exits non-zero on any compile error.  tests/test_jvm_adapter.py runs the
+# same compiles in-process when javac/scalac are on PATH.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+command -v javac >/dev/null || { echo "javac not found" >&2; exit 3; }
+out=build/classes
+rm -rf "$out" && mkdir -p "$out"
+
+echo "== core (Spark-free) =="
+javac -Werror -d "$out" \
+  com/tensorflowonspark/tpu/TFosInference.java \
+  com/tensorflowonspark/tpu/TFRecordCodec.java \
+  com/tensorflowonspark/tpu/TFosSession.java
+
+if [[ -n "${SPARK_HOME:-}" && -d "$SPARK_HOME/jars" ]]; then
+  echo "== spark adapter =="
+  javac -Werror -d "$out" -cp "$SPARK_HOME/jars/*:$out" \
+    com/tensorflowonspark/tpu/spark/TFosModel.java
+  if command -v scalac >/dev/null; then
+    echo "== scala sugar =="
+    scalac -d "$out" -classpath "$SPARK_HOME/jars/*:$out" \
+      com/tensorflowonspark/tpu/spark/TFosModelOps.scala
+  else
+    echo "scalac not found; skipping TFosModelOps.scala" >&2
+  fi
+else
+  echo "SPARK_HOME not set; skipping the Spark adapter" >&2
+fi
+
+jar cf build/tfos-jvm.jar -C "$out" com
+echo "OK: build/tfos-jvm.jar"

@@ -45,11 +45,23 @@ public final class TFosInference {
   /** Execute the compiled forward on all staged inputs. */
   public static native void run(long h);
 
-  /** Shape of the float32 output produced by the last run. */
+  /** Shape of the model's first declared output after the last run. */
   public static native long[] outputShape(long h);
 
-  /** The output tensor, flattened row-major. */
+  /** The first declared output tensor, flattened row-major. */
   public static native float[] getOutput(long h);
+
+  /** Number of outputs the last run produced (multi-output models). */
+  public static native int outputCount(long h);
+
+  /** Name of output {@code index} (signature's declared order first). */
+  public static native String outputName(long h, int index);
+
+  /** Shape of the named output ({@code ""} = first declared output). */
+  public static native long[] outputShapeNamed(long h, String name);
+
+  /** The named output tensor, flattened row-major. */
+  public static native float[] getOutputNamed(long h, String name);
 
   /** Release the handle's model state. */
   public static native void close(long h);

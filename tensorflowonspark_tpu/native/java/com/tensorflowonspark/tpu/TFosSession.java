@@ -74,16 +74,40 @@ public final class TFosSession implements AutoCloseable {
     staged.clear();
   }
 
-  /** Shape of the float32 output of the last {@link #run()}. */
+  /** Shape of the first declared output of the last {@link #run()}. */
   public long[] outputShape() {
     ensureOpen();
     return TFosInference.outputShape(handle);
   }
 
-  /** The output of the last {@link #run()}, flattened row-major. */
+  /** The first declared output of the last {@link #run()}, row-major. */
   public float[] output() {
     ensureOpen();
     return TFosInference.getOutput(handle);
+  }
+
+  /** Names of every output of the last {@link #run()}, declared order
+   * first — the flattened names of the export's {@code signature.json}. */
+  public String[] outputNames() {
+    ensureOpen();
+    int n = TFosInference.outputCount(handle);
+    String[] names = new String[n];
+    for (int i = 0; i < n; i++) {
+      names[i] = TFosInference.outputName(handle, i);
+    }
+    return names;
+  }
+
+  /** Shape of the named output ({@code ""} = first declared output). */
+  public long[] outputShape(String name) {
+    ensureOpen();
+    return TFosInference.outputShapeNamed(handle, name);
+  }
+
+  /** The named output of the last {@link #run()}, flattened row-major. */
+  public float[] output(String name) {
+    ensureOpen();
+    return TFosInference.getOutputNamed(handle, name);
   }
 
   /** Single-input convenience: feed → run → output. */

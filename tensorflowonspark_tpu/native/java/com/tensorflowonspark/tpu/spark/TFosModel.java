@@ -64,6 +64,10 @@ public final class TFosModel implements Serializable {
   private LinkedHashMap<String, String> inputMapping = new LinkedHashMap<>();
   /** model input name → dtype: "float32" (default) | "int32" | "int64". */
   private LinkedHashMap<String, String> inputTypes = new LinkedHashMap<>();
+  /** model output name → df column (insertion order = column order).
+   * Empty = single-column mode: the first declared output lands in
+   * {@code outputColumn}. */
+  private LinkedHashMap<String, String> outputMapping = new LinkedHashMap<>();
   private String outputColumn = "prediction";
 
   public TFosModel(String exportDir, String modelName) {
@@ -86,22 +90,56 @@ public final class TFosModel implements Serializable {
     return this;
   }
 
-  /** Output DataFrame column name (the C ABI serves the model's first
-   * declared output; see the export's signature.json for its name). */
+  /** Single-column convenience: the model's first declared output lands in
+   * {@code col}.  For multi-output models prefer
+   * {@link #setOutputMapping(Map)}. */
   public TFosModel setOutputColumn(String col) {
     this.outputColumn = col;
     return this;
   }
 
+  /** Serve EVERY mapped output: model output name (a flattened name from
+   * the export's {@code signature.json}; nested dict outputs are
+   * '/'-joined, e.g. {@code "heads/start"}) → result DataFrame column.
+   * Mirrors the Python {@code TFModel.setOutputMapping}. */
+  public TFosModel setOutputMapping(Map<String, String> outputToCol) {
+    this.outputMapping = new LinkedHashMap<>(outputToCol);
+    return this;
+  }
+
   /** Schema of {@link #transform}'s result: one array&lt;float&gt; column
-   * per scored row (rank-1 outputs come back as length-1 arrays). */
+   * per mapped output — or the single {@code outputColumn} when no mapping
+   * was set (rank-1 outputs come back as length-1 arrays). */
   public StructType outputSchema() {
-    return new StructType(new StructField[] {
-        DataTypes.createStructField(
-            outputColumn,
-            DataTypes.createArrayType(DataTypes.FloatType, false),
-            false)
-    });
+    List<String> cols = outputColumns();
+    StructField[] fields = new StructField[cols.size()];
+    for (int i = 0; i < cols.size(); i++) {
+      fields[i] = DataTypes.createStructField(
+          cols.get(i),
+          DataTypes.createArrayType(DataTypes.FloatType, false),
+          false);
+    }
+    return new StructType(fields);
+  }
+
+  private List<String> outputColumns() {
+    if (outputMapping.isEmpty()) {
+      List<String> single = new ArrayList<>(1);
+      single.add(outputColumn);
+      return single;
+    }
+    return new ArrayList<>(outputMapping.values());
+  }
+
+  /** Model output names to fetch, aligned with {@link #outputColumns}:
+   * {@code ""} = first declared output (single-column mode). */
+  private List<String> outputNames() {
+    if (outputMapping.isEmpty()) {
+      List<String> single = new ArrayList<>(1);
+      single.add("");
+      return single;
+    }
+    return new ArrayList<>(outputMapping.keySet());
   }
 
   /** Score every row of {@code df}; embarrassingly parallel per partition
@@ -160,7 +198,8 @@ public final class TFosModel implements Serializable {
 
       private Iterator<Row> scoreBatch(List<Row> batch) {
         int n = batch.size();
-        float[] flat;
+        List<String> names = outputNames();
+        float[][] flats = new float[names.size()][];
         // The session protocol (feed* -> run -> output) is stateful and the
         // cache shares one session per export across an executor's task
         // threads (spark.executor.cores > 1): serialize the sequence so
@@ -174,16 +213,22 @@ public final class TFosModel implements Serializable {
             feedColumn(sess, input, dtype, batch, ci);
           }
           sess.run();
-          flat = sess.output();
+          for (int o = 0; o < names.size(); o++) {
+            flats[o] = sess.output(names.get(o));
+          }
         }
-        int per = n == 0 ? 0 : flat.length / n;
         List<Row> out = new ArrayList<>(n);
         for (int r = 0; r < n; r++) {
-          Float[] slice = new Float[per];
-          for (int j = 0; j < per; j++) {
-            slice[j] = flat[r * per + j];
+          Object[] cells = new Object[names.size()];
+          for (int o = 0; o < names.size(); o++) {
+            int per = n == 0 ? 0 : flats[o].length / n;
+            Float[] slice = new Float[per];
+            for (int j = 0; j < per; j++) {
+              slice[j] = flats[o][r * per + j];
+            }
+            cells[o] = slice;
           }
-          out.add(RowFactory.create((Object) slice));
+          out.add(RowFactory.create(cells));
         }
         return out.iterator();
       }

@@ -29,19 +29,29 @@ object TFosModelOps {
 
   implicit class RichDataFrame(private val df: Dataset[Row]) extends AnyVal {
 
-    /** Batched inference over every row; returns one `array<float>` column
-      * (`outputColumn`) holding the model's first declared output. */
+    /** Batched inference over every row.  With `outputMapping` set, every
+      * mapped model output (flattened signature name → column) becomes an
+      * `array<float>` column; otherwise the single `outputColumn` holds the
+      * model's first declared output. */
     def scoreWith(
         exportDir: String,
         inputMapping: Map[String, String],
         modelName: String = "",
         batchSize: Int = 512,
         inputTypes: Map[String, String] = Map.empty,
-        outputColumn: String = "prediction"): DataFrame = {
+        outputColumn: String = "prediction",
+        outputMapping: Map[String, String] = Map.empty): DataFrame = {
       val model = new TFosModel(exportDir, modelName)
         .setBatchSize(batchSize)
         .setInputMapping(inputMapping.asJava)
         .setOutputColumn(outputColumn)
+      if (outputMapping.nonEmpty) {
+        // Column/name alignment is guaranteed (TFosModel copies into one
+        // LinkedHashMap that both names and columns derive from), but a
+        // plain scala Map loses literal order above 4 entries — pass a
+        // scala.collection.immutable.ListMap to pin column order.
+        model.setOutputMapping(outputMapping.asJava)
+      }
       inputTypes.foreach { case (k, v) => model.setInputType(k, v) }
       model.transform(df)
     }

@@ -251,6 +251,10 @@ typedef void (*FnSetInputL)(JNIEnv *, jclass, jlong, jstring, jlongArray,
 typedef void (*FnRun)(JNIEnv *, jclass, jlong);
 typedef jlongArray (*FnOutShape)(JNIEnv *, jclass, jlong);
 typedef jfloatArray (*FnGetOut)(JNIEnv *, jclass, jlong);
+typedef jint (*FnOutCount)(JNIEnv *, jclass, jlong);
+typedef jstring (*FnOutName)(JNIEnv *, jclass, jlong, jint);
+typedef jlongArray (*FnOutShapeNamed)(JNIEnv *, jclass, jlong, jstring);
+typedef jfloatArray (*FnGetOutNamed)(JNIEnv *, jclass, jlong, jstring);
 typedef void (*FnClose)(JNIEnv *, jclass, jlong);
 typedef jlong (*FnWriteRecords)(JNIEnv *, jclass, jstring, jbyteArray,
                                 jlongArray);
@@ -322,6 +326,14 @@ int main(int argc, char **argv) {
           "Java_com_tensorflowonspark_tpu_TFosInference_outputShape")
   RESOLVE(jget, FnGetOut,
           "Java_com_tensorflowonspark_tpu_TFosInference_getOutput")
+  RESOLVE(jcount, FnOutCount,
+          "Java_com_tensorflowonspark_tpu_TFosInference_outputCount")
+  RESOLVE(jname, FnOutName,
+          "Java_com_tensorflowonspark_tpu_TFosInference_outputName")
+  RESOLVE(jshapen, FnOutShapeNamed,
+          "Java_com_tensorflowonspark_tpu_TFosInference_outputShapeNamed")
+  RESOLVE(jgetn, FnGetOutNamed,
+          "Java_com_tensorflowonspark_tpu_TFosInference_getOutputNamed")
   RESOLVE(jclose, FnClose,
           "Java_com_tensorflowonspark_tpu_TFosInference_close")
   RESOLVE(jwrite, FnWriteRecords,
@@ -382,6 +394,43 @@ int main(int argc, char **argv) {
   double sum = 0.0;
   for (jfloat v : outo->floats) sum += v;
   std::printf("JNIOK n=%lld sum=%.6f\n", (long long)n_out, sum);
+
+  // --- named multi-output accessors: enumerate and fetch EVERY output ---
+  jint count = jcount(&env, nullptr, h);
+  CHECK(!g_pending && count >= 1, "outputCount must be >= 1");
+  for (jint i = 0; i < count; i++) {
+    jstring jn = jname(&env, nullptr, h, i);
+    CHECK(!g_pending && jn != nullptr, "outputName must succeed");
+    std::string oname = as(jn)->str;
+    jlongArray nshape = jshapen(&env, nullptr, h, mk_string(oname.c_str()));
+    CHECK(!g_pending && nshape != nullptr, "outputShapeNamed must succeed");
+    jlong n_named = 1;
+    for (jlong d : as(nshape)->longs) n_named *= d;
+    jfloatArray nout = jgetn(&env, nullptr, h, mk_string(oname.c_str()));
+    CHECK(!g_pending && nout != nullptr, "getOutputNamed must succeed");
+    FakeObj *no = as(nout);
+    CHECK((jlong)no->floats.size() == n_named,
+          "getOutputNamed length must match outputShapeNamed");
+    double nsum = 0.0;
+    for (jfloat v : no->floats) nsum += v;
+    std::printf("JNI_NAMED name=%s n=%lld sum=%.6f\n", oname.c_str(),
+                (long long)n_named, nsum);
+    if (i == 0) {
+      // "" and the first declared name are the same output (the original
+      // single-output protocol is a view of the multi-output one)
+      CHECK(no->floats.size() == outo->floats.size() &&
+                memcmp(no->floats.data(), outo->floats.data(),
+                       no->floats.size() * sizeof(jfloat)) == 0,
+            "first named output must equal getOutput");
+    }
+  }
+  // unknown-name error path
+  jgetn(&env, nullptr, h, mk_string("no_such_output"));
+  CHECK(take_exception("unknown output"),
+        "getOutputNamed(bad name) must throw with the python error text");
+  // out-of-range index error path
+  jname(&env, nullptr, h, count + 7);
+  CHECK(take_exception(nullptr), "outputName(out of range) must throw");
 
   // --- run-before-input error path on a fresh stale state ---
   jrun(&env, nullptr, h);  // inputs were consumed by the previous run

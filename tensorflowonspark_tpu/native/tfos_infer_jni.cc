@@ -15,6 +15,10 @@
 //     public static native void  run(long h);
 //     public static native long[]  outputShape(long h);
 //     public static native float[] getOutput(long h);
+//     public static native int     outputCount(long h);
+//     public static native String  outputName(long h, int index);
+//     public static native long[]  outputShapeNamed(long h, String name);
+//     public static native float[] getOutputNamed(long h, String name);
 //     public static native void  close(long h);
 //   }
 //   public final class TFRecordCodec {
@@ -53,6 +57,11 @@ int tfos_infer_run(int64_t);
 int tfos_infer_output_rank(int64_t);
 int tfos_infer_output_shape(int64_t, int64_t *);
 int64_t tfos_infer_get_output(int64_t, float *, int64_t);
+int tfos_infer_output_count(int64_t);
+int64_t tfos_infer_output_name(int64_t, int, char *, int64_t);
+int tfos_infer_output_rank_named(int64_t, const char *);
+int tfos_infer_output_shape_named(int64_t, const char *, int64_t *);
+int64_t tfos_infer_get_output_named(int64_t, const char *, float *, int64_t);
 int tfos_infer_close(int64_t);
 // libtfrecord.so
 long tfr_write(const char *, const unsigned char *, const unsigned long long *,
@@ -178,6 +187,70 @@ Java_com_tensorflowonspark_tpu_TFosInference_getOutput(JNIEnv *env, jclass,
   for (int64_t d : dims) n *= d;
   std::vector<float> buf(n);
   if (tfos_infer_get_output(h, buf.data(), n) < 0) {
+    throw_last_error(env);
+    return nullptr;
+  }
+  jfloatArray out = env->NewFloatArray((jsize)n);
+  env->SetFloatArrayRegion(out, 0, (jsize)n, buf.data());
+  return out;
+}
+
+// -- named multi-output accessors (every output, not just the first) --------
+
+JNIEXPORT jint JNICALL
+Java_com_tensorflowonspark_tpu_TFosInference_outputCount(JNIEnv *env, jclass,
+                                                         jlong h) {
+  int n = tfos_infer_output_count(h);
+  if (n < 0) throw_last_error(env);
+  return (jint)n;
+}
+
+JNIEXPORT jstring JNICALL
+Java_com_tensorflowonspark_tpu_TFosInference_outputName(JNIEnv *env, jclass,
+                                                        jlong h, jint index) {
+  char buf[512];
+  if (tfos_infer_output_name(h, (int)index, buf, sizeof(buf)) < 0) {
+    throw_last_error(env);
+    return nullptr;
+  }
+  return env->NewStringUTF(buf);
+}
+
+JNIEXPORT jlongArray JNICALL
+Java_com_tensorflowonspark_tpu_TFosInference_outputShapeNamed(
+    JNIEnv *env, jclass, jlong h, jstring name) {
+  Utf n(env, name);
+  int rank = tfos_infer_output_rank_named(h, n.c);
+  if (rank < 0) {
+    throw_last_error(env);
+    return nullptr;
+  }
+  std::vector<int64_t> dims(rank);
+  if (tfos_infer_output_shape_named(h, n.c, dims.data()) != 0) {
+    throw_last_error(env);
+    return nullptr;
+  }
+  jlongArray out = env->NewLongArray(rank);
+  std::vector<jlong> jdims(dims.begin(), dims.end());
+  env->SetLongArrayRegion(out, 0, rank, jdims.data());
+  return out;
+}
+
+JNIEXPORT jfloatArray JNICALL
+Java_com_tensorflowonspark_tpu_TFosInference_getOutputNamed(
+    JNIEnv *env, jclass, jlong h, jstring name) {
+  Utf nm(env, name);
+  int rank = tfos_infer_output_rank_named(h, nm.c);
+  if (rank < 0) {
+    throw_last_error(env);
+    return nullptr;
+  }
+  std::vector<int64_t> dims(rank);
+  tfos_infer_output_shape_named(h, nm.c, dims.data());
+  int64_t n = 1;
+  for (int64_t d : dims) n *= d;
+  std::vector<float> buf(n);
+  if (tfos_infer_get_output_named(h, nm.c, buf.data(), n) < 0) {
     throw_last_error(env);
     return nullptr;
   }

@@ -437,6 +437,16 @@ def _column_to_numpy(path: str, name: str, col) -> np.ndarray:
         # offsets may not start at 0 for a sliced array
         flat = flat[offsets[0]:offsets[0] + len(col) * k]
         return flat.reshape(len(col), k)
+    if not (pa.types.is_floating(t) or pa.types.is_integer(t)
+            or pa.types.is_boolean(t)):
+        # string/binary/temporal scalars come back dtype=object from
+        # to_numpy — the exact deferred device_put failure this helper
+        # exists to prevent
+        raise ValueError(
+            f"{path}: column {name!r} has non-numeric type {t} — encode it "
+            "to a numeric dtype before the TPU feed (object arrays cannot "
+            "be device_put)"
+        )
     return col.to_numpy(zero_copy_only=False)
 
 

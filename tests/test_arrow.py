@@ -178,6 +178,22 @@ def test_parquet_batches_ragged_and_null_columns_fail_loudly(tmp_path):
         list(readers.parquet_batches([nulls], batch_size=2, prefetch=0))
 
 
+def test_parquet_batches_string_column_fails_loudly(tmp_path):
+    """ADVICE r4: a string scalar column would come back dtype=object from
+    to_numpy — exactly the deferred device_put failure _column_to_numpy
+    exists to prevent; it must raise naming the file and column."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    p = str(tmp_path / "strings.parquet")
+    pq.write_table(
+        pa.table({"x": pa.array([1.0, 2.0], type=pa.float32()),
+                  "label": pa.array(["cat", "dog"])}),
+        p)
+    with pytest.raises(ValueError, match="label.*non-numeric"):
+        list(readers.parquet_batches([p], batch_size=2, prefetch=0))
+
+
 def test_parquet_batches_fixed_size_list_column(tmp_path):
     import pyarrow as pa
     import pyarrow.parquet as pq

@@ -163,6 +163,85 @@ def test_jni_glue_serves_self_describing_export(tmp_path):
     assert abs(got - expected) < 1e-3 * max(1.0, abs(expected))
 
 
+@pytest.fixture(scope="module")
+def two_output_export(tmp_path_factory):
+    """A self-describing export whose forward returns TWO named outputs
+    (plus a nested path) — the multi-output JVM serving fixture."""
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import compat
+
+    rng = np.random.default_rng(7)
+    state = {"params": {"w": rng.normal(size=(6, 3)).astype(np.float32)}}
+
+    def forward(st, batch):
+        z = batch["x"] @ st["params"]["w"]
+        return {"embedding": z,
+                "stats": {"norm": jnp.sum(z * z, axis=-1)}}
+
+    d = str(tmp_path_factory.mktemp("multiout") / "export")
+    example = {"x": np.zeros((2, 6), np.float32)}
+    compat.export_saved_model(state, d, forward_fn=forward,
+                              example_batch=example)
+    return d, state, forward
+
+
+@pytest.mark.skipif(not infer_native.available(),
+                    reason="native toolchain unavailable")
+def test_ctypes_named_multi_output(two_output_export):
+    """VERDICT r4 item 3: every named output served through the C ABI —
+    including the '/'-joined nested name — matching the python forward."""
+    d, state, forward = two_output_export
+    x = np.arange(4 * 6, dtype=np.float32).reshape(4, 6) * 0.1
+    sess = infer_native.Session(d, "")
+    try:
+        sess.set_input("x", x)
+        sess.run()
+        names = sess.output_names()
+        assert names == ["embedding", "stats/norm"]
+        outs = sess.outputs()
+        # "" resolves to the FIRST DECLARED output (dict insertion order,
+        # not jax's sorted flatten order)
+        first = sess.output("")
+    finally:
+        sess.close()
+    import jax
+
+    expected = jax.tree.map(np.asarray, forward(state, {"x": x}))
+    np.testing.assert_allclose(outs["embedding"], expected["embedding"],
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(outs["stats/norm"],
+                               expected["stats"]["norm"],
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(first, outs["embedding"])
+
+
+def test_jni_glue_serves_named_outputs(two_output_export, tmp_path):
+    """The fake-JVM harness enumerates outputCount/outputName and fetches
+    BOTH named outputs; their sums match the python forward numerically
+    (VERDICT r4 item 3 done-criterion)."""
+    import jax
+
+    d, state, forward = two_output_export
+    proc = _run_harness(d, "", 4, 6, tmp_path)
+    assert proc.returncode == 0, (proc.stdout + "\n" + proc.stderr)[-3000:]
+    assert "JNI_HARNESS_PASS" in proc.stdout
+
+    x = ((np.arange(4 * 6, dtype=np.float32) % 97) * 0.01).reshape(4, 6)
+    expected = jax.tree.map(np.asarray, forward(state, {"x": x}))
+    sums = {}
+    for line in proc.stdout.splitlines():
+        if line.startswith("JNI_NAMED "):
+            fields = dict(kv.split("=", 1) for kv in line.split()[1:])
+            sums[fields["name"]] = float(fields["sum"])
+    assert set(sums) == {"embedding", "stats/norm"}
+    for name, exp in (("embedding", expected["embedding"]),
+                      ("stats/norm", expected["stats"]["norm"])):
+        exp_sum = float(exp.sum())
+        assert abs(sums[name] - exp_sum) < 1e-3 * max(1.0, abs(exp_sum)), (
+            name, sums[name], exp_sum)
+
+
 def test_jni_library_exports_expected_symbols():
     lib = infer_native.jni_library()
     if lib is None:
