@@ -160,8 +160,15 @@ def make_model(config: Config, mesh=None):
         leaf carries a leading layer dim annotated ``"stage"`` (→ ``pp``).
         Executed as a GPipe pipeline (``parallel.pipeline_parallel``) when
         the mesh has ``pp == config.pp_stages`` ranks, as a ``lax.scan``
-        otherwise — identical numerics either way (tested).  Dense masked
-        attention only (ring/sp attention belongs to the layered variant).
+        otherwise — identical numerics either way (tested).
+
+        **pp × sp composition**: the sequence stays sharded over ``sp``
+        inside the pipeline (``pipeline_apply(seq_axis="sp")``) and each
+        block runs :func:`parallel.ring_attention.ring_attention` directly
+        over the bound ``sp`` axis — K/V blocks (and the key-padding mask)
+        ``ppermute`` around the ring while microbatches flow through the
+        GPipe stages, so long-context and pipelining compose
+        (``tests/test_models.py::test_bert_pp_composes_with_sp_ring_attention``).
 
         **pp × tp composition**: qkv/out weights are head-major
         (``(L, H, 3, heads, head_dim)`` / ``(L, heads, head_dim, H)``) and
@@ -241,9 +248,16 @@ def make_model(config: Config, mesh=None):
 
             n_pp = mesh.shape.get("pp", 1) if mesh is not None else 1
             use_pipeline = n_pp > 1 and n_pp == config.pp_stages
-            # tp collectives are hand-written ONLY inside the pipeline's
+            # tp/sp collectives are hand-written ONLY inside the pipeline's
             # shard_map; the sequential path is global-view (GSPMD)
             tp_world = (mesh.shape.get("tp", 1)
+                        if (mesh is not None and use_pipeline) else 1)
+            # pp×sp: the sequence stays sharded over sp inside the GPipe
+            # schedule (pipeline_apply(seq_axis="sp")) and attention runs
+            # the K/V ring directly — the sp axis is bound inside the
+            # pipeline's shard_map, so ring_attention's ppermute/psum work
+            # without their own shard_map wrapper
+            sp_world = (mesh.shape.get("sp", 1)
                         if (mesh is not None and use_pipeline) else 1)
 
             def layer_norm(h, scale, bias):
@@ -260,17 +274,31 @@ def make_model(config: Config, mesh=None):
                     "bsh,hknd->bsknd", h, lw["qkv_w"].astype(dtype)
                 ) + lw["qkv_b"].astype(dtype)
                 q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (B,S,N,D)
-                # same MXU policy as the layered Block: bf16 multiply with
-                # f32 accumulation, not an explicit f32-upcast matmul
-                sc = jnp.einsum(
-                    "bqnd,bknd->bnqk", q, k,
-                    preferred_element_type=jnp.float32,
-                ) * (1.0 / math.sqrt(hd_))
-                sc = jnp.where(m[:, None, None, :], sc, -1e30)
-                p = jax.nn.softmax(sc, axis=-1)
-                o = jnp.einsum("bnqk,bknd->bqnd", p.astype(dtype), v,
-                               preferred_element_type=jnp.float32
-                               ).astype(dtype)
+                if sp_world > 1:
+                    # pp×sp: h/m are LOCAL sequence blocks; K/V (and the
+                    # key-padding mask) ppermute around the sp ring with a
+                    # flash-style online softmax — same kernel as the
+                    # layered model's long-context path
+                    from tensorflowonspark_tpu.parallel import (
+                        ring_attention as ra,
+                    )
+
+                    o = ra.ring_attention(
+                        q, k, v, axis_name="sp", kv_mask=m.astype(bool)
+                    ).astype(dtype)
+                else:
+                    # same MXU policy as the layered Block: bf16 multiply
+                    # with f32 accumulation, not an explicit f32-upcast
+                    # matmul
+                    sc = jnp.einsum(
+                        "bqnd,bknd->bnqk", q, k,
+                        preferred_element_type=jnp.float32,
+                    ) * (1.0 / math.sqrt(hd_))
+                    sc = jnp.where(m[:, None, None, :], sc, -1e30)
+                    p = jax.nn.softmax(sc, axis=-1)
+                    o = jnp.einsum("bnqk,bknd->bqnd", p.astype(dtype), v,
+                                   preferred_element_type=jnp.float32
+                                   ).astype(dtype)
                 # row-sharded output projection: each tp rank contributes
                 # its heads' partial sum; bias added AFTER the reduce
                 o = jnp.einsum("bqnd,ndh->bqh", o, lw["out_w"].astype(dtype))
@@ -307,7 +335,7 @@ def make_model(config: Config, mesh=None):
                 return pipeline_apply(
                     stage_fn, staged, x, mesh=mesh,
                     n_microbatches=config.pp_microbatches, aux=mask,
-                    param_specs=staged_specs,
+                    param_specs=staged_specs, seq_axis="sp",
                 )
             return stage_fn(w, x, mask)
 
@@ -335,12 +363,6 @@ def make_model(config: Config, mesh=None):
             raise ValueError(
                 f"layers={config.layers} not divisible by "
                 f"pp_stages={config.pp_stages}"
-            )
-        if mesh is not None and mesh.shape.get("sp", 1) > 1:
-            raise ValueError(
-                "pp_stages > 1 uses dense attention; combine pp with "
-                "dp/fsdp/tp, not sp (ring attention belongs to the layered "
-                "variant)"
             )
         mesh_tp = mesh.shape.get("tp", 1) if mesh is not None else 1
         if mesh_tp > 1:

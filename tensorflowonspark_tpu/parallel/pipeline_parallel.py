@@ -60,6 +60,7 @@ def pipeline_apply(
     remat: bool = False,
     aux=None,
     param_specs: Any = None,
+    seq_axis: str | None = None,
 ):
     """GPipe forward over ``mesh.shape[axis]`` stages; differentiable.
 
@@ -90,8 +91,16 @@ def pipeline_apply(
     composition ``models/bert.py::StackedEncoder`` implements and
     ``tests/test_models.py`` pins against the sequential run).  Default
     ``param_specs=None`` replicates stage weights over every non-``pp``
-    axis, as before.  ``sp`` remains free for ``stage_fn``'s own sequence
-    collectives.
+    axis, as before.
+
+    Composes with sequence parallelism: pass ``seq_axis="sp"`` and dim 1 of
+    the activation (and of every rank≥2 aux leaf — e.g. an attention mask)
+    stays SHARDED over that axis inside the schedule — each pp rank's
+    buffer holds a local sequence block, and ``stage_fn`` runs its own
+    sequence collectives (ring attention's K/V ``ppermute``, a ``pmean``)
+    over the bound axis.  This is how ring attention runs INSIDE pipeline
+    stages (``models/bert.py::StackedEncoder`` with ``pp×sp``); with
+    ``seq_axis=None`` the sequence is replicated across sp ranks as before.
 
     Returns the pipelined equivalent of applying all stages sequentially.
     """
@@ -147,6 +156,15 @@ def pipeline_apply(
         )
     data_spec = data_axes if len(data_axes) > 1 else (
         data_axes[0] if data_axes else None)
+    seq_spec = (seq_axis if seq_axis and seq_axis in mesh.axis_names
+                and mesh.shape[seq_axis] > 1 else None)
+    if seq_spec is not None:
+        if x.ndim < 2 or x.shape[1] % mesh.shape[seq_spec]:
+            raise ValueError(
+                f"seq_axis={seq_axis!r}: activation dim 1 "
+                f"({'missing' if x.ndim < 2 else x.shape[1]}) must divide "
+                f"the axis size {mesh.shape[seq_spec]}"
+            )
 
     def _ranked(params, micro_in, aux_in):
         # inside shard_map: leaves have leading dim 1 (this rank's stage)
@@ -192,16 +210,26 @@ def pipeline_apply(
 
     # no-aux is the empty pytree: same shard_map shape either way
     aux_operand = aux_micro if aux_micro is not None else ()
+    # aux leaves whose dim after the batch IS the sequence (size matches the
+    # activation's seq length, e.g. an attention mask (B, S)) shard it over
+    # seq_axis alongside the activation; every other aux leaf — per-example
+    # scalars, non-sequence features of any rank — stays data-sharded only
+    # (blindly sharding dim 2 would silently split a (B, K) feature)
+    seq_len = x.shape[1] if (seq_spec is not None and x.ndim >= 2) else None
     aux_spec = jax.tree_util.tree_map(
-        lambda _: P(None, data_spec), aux_operand
+        lambda leaf: (P(None, data_spec, seq_spec)
+                      if (seq_len is not None and leaf.ndim >= 3
+                          and leaf.shape[2] == seq_len)
+                      else P(None, data_spec)),
+        aux_operand,
     )
     if param_specs is None:
         param_specs = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
     sm = _shard_map(
         _ranked,
         mesh,
-        in_specs=(param_specs, P(None, data_spec), aux_spec),
-        out_specs=P(None, data_spec),
+        in_specs=(param_specs, P(None, data_spec, seq_spec), aux_spec),
+        out_specs=P(None, data_spec, seq_spec),
     )
     out = sm(stage_params, micro, aux_operand)  # (M, B/M, ...) global view
     return out.reshape((x.shape[0],) + out.shape[2:])
