@@ -26,6 +26,9 @@ Public surface mirrors the reference package:
 - :mod:`tensorflowonspark_tpu.saved_model` — self-describing exports
   (weights + StableHLO forward + signature; ``python -m
   tensorflowonspark_tpu.saved_model show|run`` for inspection).
+- :mod:`tensorflowonspark_tpu.health` — slice-health check at rendezvous
+  (watchdogged device probe; a wedged chip fails bootstrap fast and
+  attributed instead of hanging the mesh).
 """
 
 __version__ = "0.1.0"
