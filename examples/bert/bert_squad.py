@@ -112,7 +112,9 @@ def main(argv=None):
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--pp", type=int, default=1,
-                   help="pipeline stages (GPipe trunk; not with --sp > 1)")
+                   help="pipeline stages (GPipe trunk; composes with "
+                        "--tp and --sp — ring attention runs inside "
+                        "pipeline stages when --sp > 1)")
     p.add_argument("--pp_microbatches", type=int, default=4)
     p.add_argument("--num_samples", type=int, default=512)
     p.add_argument("--model_dir", default=None)

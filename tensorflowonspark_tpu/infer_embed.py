@@ -19,6 +19,11 @@ Multi-output models serve every named output: after ``run``,
 signature's declared order first) and ``output_shape``/``get_output`` accept
 a name (``""`` = the first declared output, the original single-output
 convention).
+
+Dtype contract: every output is served as **float32** (the C ABI's buffer
+type, matching TF-Java's float fetch convention).  Integer outputs above
+2^24 would lose exactness — emit such values as float from the model, or
+serve through the Python ``TFModel`` path, which preserves dtypes.
 """
 
 from __future__ import annotations

@@ -35,6 +35,10 @@ class Config:
     type_vocab: int = 2
     dtype: str = "bfloat16"
     remat: bool = False  # jax.checkpoint each layer: FLOPs for HBM
+    # sequence-parallel attention implementation when the mesh has sp > 1:
+    # "ring" (K/V ppermute, O(seq/sp) memory — long-context default) or
+    # "ulysses" (all_to_all head re-shard; needs local heads % sp == 0)
+    sp_impl: str = "ring"
     # pipeline parallelism: > 1 switches the encoder trunk to STACKED layer
     # params (leading "stage" dim sharded over pp) run as a GPipe microbatch
     # schedule when the mesh has that many pp ranks, a lax.scan otherwise
@@ -70,7 +74,8 @@ def make_model(config: Config, mesh=None):
     if use_ring:
         from tensorflowonspark_tpu.parallel import ring_attention as ra
 
-        sharded_attn = ra.make_sharded_attention(mesh, causal=False, impl="ring")
+        sharded_attn = ra.make_sharded_attention(mesh, causal=False,
+                                                 impl=config.sp_impl)
 
     def dense(features, axes, name=None):
         return nn.DenseGeneral(
@@ -278,7 +283,9 @@ def make_model(config: Config, mesh=None):
                     # pp×sp: h/m are LOCAL sequence blocks; K/V (and the
                     # key-padding mask) ppermute around the sp ring with a
                     # flash-style online softmax — same kernel as the
-                    # layered model's long-context path
+                    # layered model's long-context path.  Always the ring:
+                    # ulysses' all_to_all does not lower inside the
+                    # pipeline's nested scan (validated at construction)
                     from tensorflowonspark_tpu.parallel import (
                         ring_attention as ra,
                     )
@@ -358,6 +365,22 @@ def make_model(config: Config, mesh=None):
             logits = jnp.where(mask[:, :, None], logits, -1e30)
             return logits[..., 0], logits[..., 1]  # start, end: (B, S)
 
+    if config.sp_impl not in ("ring", "ulysses"):
+        raise ValueError(
+            f"sp_impl must be 'ring' or 'ulysses', got {config.sp_impl!r}")
+    if (mesh is not None and mesh.shape.get("sp", 1) > 1
+            and config.sp_impl == "ulysses"):
+        if config.pp_stages > 1 and mesh.shape.get("pp", 1) > 1:
+            raise ValueError(
+                "sp_impl='ulysses' is unsupported inside the GPipe trunk: "
+                "all_to_all does not lower inside the pipeline's nested "
+                "scan (XLA verifier rejects the reshard) — pp×sp uses "
+                "sp_impl='ring' (the long-context-preferred kernel)")
+        if config.heads % mesh.shape["sp"]:
+            raise ValueError(
+                f"ulysses sequence parallelism needs heads "
+                f"({config.heads}) divisible by sp={mesh.shape['sp']}; "
+                "use sp_impl='ring' or adjust heads")
     if config.pp_stages > 1:
         if config.layers % config.pp_stages:
             raise ValueError(

@@ -312,6 +312,42 @@ def test_bert_pp_composes_with_sp_ring_attention():
                                                                       losses)
 
 
+def test_bert_layered_sp_impl_selectable():
+    """Config(sp_impl=...) picks the sequence-parallel kernel on the
+    layered path: ulysses (all_to_all head re-shard) must match the dense
+    dp-only run like ring does; inside the GPipe trunk ulysses is a clean
+    construction-time error (all_to_all does not lower in the nested
+    scan)."""
+    import dataclasses
+
+    import pytest as _pytest
+
+    from tensorflowonspark_tpu.models import bert
+    from tensorflowonspark_tpu.parallel import build_mesh
+
+    cfg = dataclasses.replace(bert.Config.tiny(), sp_impl="ulysses")
+    batch = bert.example_batch(cfg, batch_size=8, seq_len=16)
+    t_ref = Trainer("bert", config=bert.Config.tiny(),
+                    mesh_config=MeshConfig(dp=8), seed=2)
+    t_u = Trainer("bert", config=cfg, mesh_config=MeshConfig(dp=2, sp=4),
+                  seed=2)
+    s_u, e_u = t_u.predict(batch)
+    s_r, e_r = t_ref.predict(batch)
+    np.testing.assert_allclose(np.asarray(s_u), np.asarray(s_r),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(e_u), np.asarray(e_r),
+                               rtol=2e-4, atol=2e-4)
+
+    with _pytest.raises(ValueError, match="unsupported inside the GPipe"):
+        bert.make_model(
+            dataclasses.replace(bert.Config.tiny(), pp_stages=2,
+                                sp_impl="ulysses"),
+            mesh=build_mesh(MeshConfig(pp=2, sp=2, dp=2)))
+    with _pytest.raises(ValueError, match="ring' or 'ulysses"):
+        bert.make_model(dataclasses.replace(bert.Config.tiny(),
+                                            sp_impl="flash"))
+
+
 def test_bert_pp_tp_divisibility_validation():
     import dataclasses
 
