@@ -128,3 +128,15 @@ def test_bert_squad_example_pipeline_parallel(capsys):
               "--pp_microbatches", "2"])
     out = capsys.readouterr().out
     assert "'pp': 2" in out and "'tp': 2" in out
+
+
+def test_bert_squad_example_pp_with_sp(capsys):
+    """--pp 2 --sp 2: ring attention inside pipeline stages through the
+    full cluster path (pp×sp composition, VERDICT r4 #5)."""
+    mod = _load("bert", "bert_squad")
+    mod.main(["--cluster_size", "2", "--epochs", "1", "--tiny",
+              "--num_samples", "64", "--batch_size", "8",
+              "--seq_len", "32", "--pp", "2", "--sp", "2",
+              "--pp_microbatches", "2"])
+    out = capsys.readouterr().out
+    assert "'pp': 2" in out and "'sp': 2" in out
