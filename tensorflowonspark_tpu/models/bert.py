@@ -39,6 +39,15 @@ class Config:
     # "ring" (K/V ppermute, O(seq/sp) memory — long-context default) or
     # "ulysses" (all_to_all head re-shard; needs local heads % sp == 0)
     sp_impl: str = "ring"
+    # Mixture-of-Experts (parallel/moe.py): > 0 replaces the dense MLP of
+    # every ``moe_every``-th layer with ``moe_experts`` expert FFNs,
+    # expert-parallel over the mesh's ``ep`` axis (Switch top-1 routing,
+    # load-balance aux loss weighted ``moe_aux_weight``).  Layered trunk
+    # only (combine with dp/fsdp/tp/sp; not with pp_stages).
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
     # pipeline parallelism: > 1 switches the encoder trunk to STACKED layer
     # params (leading "stage" dim sharded over pp) run as a GPipe microbatch
     # schedule when the mesh has that many pp ranks, a lax.scan otherwise
@@ -118,16 +127,50 @@ def make_model(config: Config, mesh=None):
                 ),
             )(o)
 
+    class MoEMLP(nn.Module):
+        """Expert-parallel FFN (Switch top-1) — see ``parallel/moe.py``.
+        Returns ``(y, aux_loss)``; the caller threads aux functionally so
+        init/inference stay collection-free."""
+
+        @nn.compact
+        def __call__(self, x):
+            from tensorflowonspark_tpu.parallel import moe
+
+            E, M, H = config.moe_experts, config.hidden, config.mlp_dim
+            normal = nn.initializers.normal(stddev=0.02)
+            zeros = nn.initializers.zeros_init()
+
+            def par(name, shape, init):
+                return self.param(
+                    name, nn.with_partitioning(init, moe.PARAM_AXES[name]),
+                    shape, jnp.float32)
+
+            p = {
+                "gate": par("gate", (M, E), normal),
+                "w_in": par("w_in", (E, M, H), normal),
+                "b_in": par("b_in", (E, H), zeros),
+                "w_out": par("w_out", (E, H, M), normal),
+                "b_out": par("b_out", (E, M), zeros),
+            }
+            return moe.moe_ffn(
+                x, p, capacity_factor=config.moe_capacity_factor)
+
     class Block(nn.Module):
+        moe: bool = False
+
         @nn.compact
         def __call__(self, x, mask):
             y = Attention(name="attention")(x, mask)
             x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + y).astype(dtype)
-            y = dense(config.mlp_dim, ("embed", "mlp"), name="mlp_in")(x)
-            y = nn.gelu(y)
-            y = dense(config.hidden, ("mlp", "embed"), name="mlp_out")(y)
+            if self.moe:
+                y, aux = MoEMLP(name="moe_mlp")(x)
+            else:
+                y = dense(config.mlp_dim, ("embed", "mlp"), name="mlp_in")(x)
+                y = nn.gelu(y)
+                y = dense(config.hidden, ("mlp", "embed"), name="mlp_out")(y)
+                aux = jnp.zeros((), jnp.float32)
             x = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + y).astype(dtype)
-            return x
+            return x, aux
 
     class Embeddings(nn.Module):
         @nn.compact
@@ -348,26 +391,42 @@ def make_model(config: Config, mesh=None):
 
     class Bert(nn.Module):
         @nn.compact
-        def __call__(self, input_ids, token_type_ids, attention_mask):
+        def __call__(self, input_ids, token_type_ids, attention_mask,
+                     with_aux: bool = False):
             x = Embeddings(name="embeddings")(input_ids, token_type_ids)
             mask = attention_mask.astype(bool)
+            aux_total = jnp.zeros((), jnp.float32)
             if config.pp_stages > 1:
                 x = StackedEncoder(name="encoder")(x, mask)
             else:
-                block = Block
-                if config.remat:
-                    block = nn.remat(Block)
+                block_cls = nn.remat(Block) if config.remat else Block
                 for i in range(config.layers):
-                    x = block(name=f"layer_{i}")(x, mask)
+                    is_moe = (config.moe_experts > 0
+                              and (i + 1) % config.moe_every == 0)
+                    x, aux = block_cls(moe=is_moe, name=f"layer_{i}")(x, mask)
+                    aux_total = aux_total + aux
             # SQuAD span head: start/end logits per position
             span = dense((2,), ("embed", "classes"), name="span")(x)
             logits = span.astype(jnp.float32)
             logits = jnp.where(mask[:, :, None], logits, -1e30)
-            return logits[..., 0], logits[..., 1]  # start, end: (B, S)
+            start, end = logits[..., 0], logits[..., 1]  # (B, S)
+            if with_aux:  # MoE training: router load-balance loss rides out
+                return start, end, aux_total
+            return start, end
 
     if config.sp_impl not in ("ring", "ulysses"):
         raise ValueError(
             f"sp_impl must be 'ring' or 'ulysses', got {config.sp_impl!r}")
+    if config.moe_experts > 0:
+        if config.pp_stages > 1:
+            raise ValueError(
+                "MoE (moe_experts > 0) runs in the layered trunk; combine "
+                "ep with dp/fsdp/tp/sp, not pp_stages")
+        n_ep = mesh.shape.get("ep", 1) if mesh is not None else 1
+        if n_ep > 1 and config.moe_experts % n_ep:
+            raise ValueError(
+                f"moe_experts ({config.moe_experts}) must be divisible by "
+                f"the mesh's ep axis ({n_ep})")
     if (mesh is not None and mesh.shape.get("sp", 1) > 1
             and config.sp_impl == "ulysses"):
         if config.pp_stages > 1 and mesh.shape.get("pp", 1) > 1:
@@ -418,17 +477,24 @@ def make_loss_fn(module, config: Config):
     import optax
 
     def loss_fn(params, batch):
-        start, end = module.apply(
-            {"params": params}, batch["input_ids"], batch["token_type_ids"],
-            batch["attention_mask"],
-        )
+        if config.moe_experts > 0:
+            start, end, aux = module.apply(
+                {"params": params}, batch["input_ids"],
+                batch["token_type_ids"], batch["attention_mask"], True,
+            )
+        else:
+            start, end = module.apply(
+                {"params": params}, batch["input_ids"],
+                batch["token_type_ids"], batch["attention_mask"],
+            )
+            aux = 0.0
         l_s = optax.softmax_cross_entropy_with_integer_labels(
             start, batch["start_positions"]
         )
         l_e = optax.softmax_cross_entropy_with_integer_labels(
             end, batch["end_positions"]
         )
-        return jnp.mean(l_s + l_e) / 2.0
+        return jnp.mean(l_s + l_e) / 2.0 + config.moe_aux_weight * aux
 
     return loss_fn
 

@@ -32,8 +32,10 @@ from typing import Any, Sequence
 logger = logging.getLogger(__name__)
 
 # Canonical axis order.  dp outermost (rides DCN across slices if needed);
-# sp/tp innermost (highest-bandwidth ICI neighbours).
-AXES = ("dp", "fsdp", "pp", "sp", "tp")
+# sp/tp innermost (highest-bandwidth ICI neighbours); ep between the data
+# axes and the model axes (expert all_to_alls want ICI but tolerate more
+# hops than tp/sp).
+AXES = ("dp", "fsdp", "ep", "pp", "sp", "tp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,6 +55,7 @@ class MeshConfig:
 
     dp: int = -1
     fsdp: int = 1
+    ep: int = 1  # expert parallelism (parallel/moe.py)
     pp: int = 1
     sp: int = 1
     tp: int = 1
@@ -293,6 +296,7 @@ DEFAULT_RULES: tuple[tuple[str, Any], ...] = (
     ("classes", None),
     ("conv_kernel", None),
     ("stage", "pp"),       # stacked pipeline-stage dim (pipeline_parallel.py)
+    ("expert", "ep"),      # MoE expert dim (parallel/moe.py)
 )
 
 
