@@ -31,6 +31,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 # Self-set targets (images|steps per sec per chip) — the reference published
@@ -103,8 +104,18 @@ _FALLBACK_TIMEOUT_S = 420
 #   3. one health verdict is shared across models: if the probe (or a
 #      primary attempt) reveals a hung accelerator, later models skip their
 #      primary instead of re-burning the timeout.
+# A fourth defense (round 5): the observed outage FLAPS — the chip came back
+# for a ~5-minute healthy window mid-wedge and wedged again — so the t=0
+# probe verdict is not final.  When the initial probe failed, the headline
+# run re-probes once between its two halves (the first model's CPU fallback
+# has burned a few minutes by then); a green second verdict wins wide_deep a
+# real on-chip number instead of inheriting a stale degraded stamp.  A hung
+# PRIMARY after a green probe is different evidence — tiny probe ops succeed
+# while real work hangs — so that verdict is NOT retried.
 # Env knobs exist so CI can simulate the outage (see tests/test_bench.py):
-#   TFOS_BENCH_SIMULATE_HANG=1  → accelerator-path children sleep forever
+#   TFOS_BENCH_SIMULATE_HANG=N  → the first N accelerator-path children
+#     sleep forever (N=big → permanent wedge; N=1 → flapping chip whose
+#     probe hangs once); forced-CPU children always run
 #   TFOS_BENCH_WALL_BUDGET_S / TFOS_BENCH_PROBE_TIMEOUT_S → shrink budgets
 _PROBE_TIMEOUT_S = int(os.environ.get("TFOS_BENCH_PROBE_TIMEOUT_S", "60"))
 _WALL_BUDGET_S = int(os.environ.get("TFOS_BENCH_WALL_BUDGET_S", "660"))
@@ -130,7 +141,45 @@ class _Deadline:
 
 
 def _simulate_hang_requested(force_cpu: bool) -> bool:
-    return bool(os.environ.get("TFOS_BENCH_SIMULATE_HANG")) and not force_cpu
+    """First-N-children hang simulation (child side).
+
+    ``TFOS_BENCH_SIMULATE_HANG=N``: the first N accelerator-path children of
+    this bench invocation hang; later ones run normally — modelling both the
+    permanent wedge (N ≥ number of children) and the round-5 flapping chip
+    (N=1: the probe hangs, the mid-run re-probe finds the chip back).
+    Sequential children share a parent-created counter file; without one
+    (child invoked directly), every accelerator child hangs.
+    """
+    raw = os.environ.get("TFOS_BENCH_SIMULATE_HANG") or ""
+    try:
+        n = int(raw or 0)
+    except ValueError:
+        # legacy truthy style ("true", "yes"): preserve the old semantics —
+        # EVERY accelerator child hangs (permanent wedge), not just one
+        n = sys.maxsize
+    if not n or force_cpu:
+        return False
+    counter = os.environ.get("TFOS_BENCH_HANG_COUNTER_FILE")
+    if not counter:
+        return True
+    used = os.path.getsize(counter) if os.path.exists(counter) else 0
+    if used >= n:
+        return False
+    with open(counter, "ab") as f:
+        f.write(b"x")
+    return True
+
+
+def _setup_hang_counter() -> None:
+    """Parent side: create the shared counter file for first-N semantics."""
+    if (os.environ.get("TFOS_BENCH_SIMULATE_HANG")
+            and not os.environ.get("TFOS_BENCH_HANG_COUNTER_FILE")):
+        import atexit
+
+        fd, path = tempfile.mkstemp(prefix="tfos_bench_hang_")
+        os.close(fd)
+        os.environ["TFOS_BENCH_HANG_COUNTER_FILE"] = path
+        atexit.register(lambda: os.path.exists(path) and os.unlink(path))
 
 
 def _parse_args(argv=None):
@@ -473,10 +522,10 @@ def probe_device(args) -> dict:
     return {"platform": platform, "ok": True}
 
 
-def _probe_accelerator(deadline: "_Deadline") -> dict:
+def _probe_accelerator(deadline: "_Deadline", reserve_s: float = 0.0) -> dict:
     """Run the liveness probe in a subprocess under a short timeout."""
-    timeout_s = deadline.clip(_PROBE_TIMEOUT_S)
-    if timeout_s < _MIN_CHILD_S:
+    timeout_s = deadline.clip(_PROBE_TIMEOUT_S, reserve_s=reserve_s)
+    if timeout_s < min(_MIN_CHILD_S, _PROBE_TIMEOUT_S):
         return {"ok": False, "error": "wall budget exhausted before probe"}
     t0 = time.monotonic()
     result = _run_child(["--_probe"], timeout_s)
@@ -513,7 +562,7 @@ def _run_child(argv: list[str], timeout_s: float) -> dict | None:
 
 
 def _bench_one(model: str, args, deadline: _Deadline, health: dict,
-               fallbacks_owed: int = 1) -> dict:
+               fallbacks_owed: int = 1, reserve_extra_s: float = 0.0) -> dict:
     """Measure one model fail-soft: accelerator child → CPU child → stub.
 
     ``health`` is the run-wide accelerator verdict ({"ok": bool, "why": str});
@@ -521,7 +570,10 @@ def _bench_one(model: str, args, deadline: _Deadline, health: dict,
     straight to the CPU fallback instead of re-burning the primary timeout.
     ``fallbacks_owed`` counts CPU fallbacks still possibly needed in this
     invocation (this model's + later models'); that much wall clock is held
-    in reserve when sizing the primary child's timeout.
+    in reserve when sizing the primary child's timeout.  ``reserve_extra_s``
+    is additionally held back from BOTH children — the headline run uses it
+    to keep room for the mid-run re-probe, which would otherwise be starved
+    by a first-half fallback that legitimately runs long.
     """
     passthrough = [f"--model={model}", f"--warmup={args.warmup}"]
     if args.batch_size is not None:
@@ -533,7 +585,7 @@ def _bench_one(model: str, args, deadline: _Deadline, health: dict,
     if health.get("ok", True):
         timeout_s = deadline.clip(_PRIMARY_TIMEOUT_S,
                                   reserve_s=fallbacks_owed
-                                  * _FALLBACK_RESERVE_S)
+                                  * _FALLBACK_RESERVE_S + reserve_extra_s)
         if timeout_s < _MIN_CHILD_S:
             primary_error = "wall budget exhausted before primary attempt"
         else:
@@ -551,7 +603,7 @@ def _bench_one(model: str, args, deadline: _Deadline, health: dict,
           "using forced-CPU backend", file=sys.stderr)
     fb_timeout = deadline.clip(_FALLBACK_TIMEOUT_S,
                                reserve_s=(fallbacks_owed - 1)
-                               * _FALLBACK_RESERVE_S)
+                               * _FALLBACK_RESERVE_S + reserve_extra_s)
     fallback = (_run_child(passthrough + ["--_force-cpu"], fb_timeout)
                 if fb_timeout >= _MIN_CHILD_S
                 else {"_error": "wall budget exhausted before fallback"})
@@ -594,8 +646,10 @@ def main() -> None:
         print(json.dumps(measure(args)))
         return
 
+    _setup_hang_counter()
     deadline = _Deadline(_WALL_BUDGET_S)
     probe = _probe_accelerator(deadline)
+    probe_failed_at_start = not probe.get("ok")
     health = {"ok": bool(probe.get("ok")),
               "why": f"liveness probe failed: {probe.get('error', '?')}"}
     if not health["ok"]:
@@ -642,7 +696,23 @@ def main() -> None:
     # Headline run (driver invokes with no args): BOTH halves of
     # BASELINE.json::metric — "ResNet-50 images/sec/chip; Criteo wide&deep
     # steps/sec" — in the ONE json line, wide_deep under "secondary".
-    result = _bench_one("resnet50", args, deadline, health, fallbacks_owed=2)
+    # when a re-probe is owed (initial probe failed), hold its time back
+    # from the first half's children so a long CPU fallback can't starve it
+    reprobe_reserve = _PROBE_TIMEOUT_S if probe_failed_at_start else 0.0
+    result = _bench_one("resnet50", args, deadline, health, fallbacks_owed=2,
+                        reserve_extra_s=reprobe_reserve)
+    if probe_failed_at_start and not health["ok"]:
+        # the observed outage flaps: minutes-long healthy windows between
+        # wedges.  The first half's CPU fallback has burned a few minutes —
+        # ask again before conceding the second half too.
+        reprobe = _probe_accelerator(deadline,
+                                     reserve_s=_FALLBACK_RESERVE_S)
+        probe["reprobe"] = reprobe
+        if reprobe.get("ok"):
+            print("bench: accelerator came back on re-probe; wide_deep "
+                  "gets a primary attempt", file=sys.stderr)
+            health["ok"] = True
+            health["why"] = "accelerator healthy on re-probe"
     result["secondary"] = _bench_one("wide_deep", args, deadline, health)
     if not probe.get("ok"):
         result["probe"] = probe
