@@ -48,6 +48,9 @@ class Config:
     moe_every: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # routing-group size in tokens: capacity + aux apply per group, and the
+    # dispatch tensors stay linear in global tokens (moe.moe_ffn)
+    moe_group_size: int = 1024
     # pipeline parallelism: > 1 switches the encoder trunk to STACKED layer
     # params (leading "stage" dim sharded over pp) run as a GPipe microbatch
     # schedule when the mesh has that many pp ranks, a lax.scan otherwise
@@ -130,10 +133,12 @@ def make_model(config: Config, mesh=None):
     class MoEMLP(nn.Module):
         """Expert-parallel FFN (Switch top-1) — see ``parallel/moe.py``.
         Returns ``(y, aux_loss)``; the caller threads aux functionally so
-        init/inference stay collection-free."""
+        init/inference stay collection-free.  ``mask`` (B, S) keeps padding
+        tokens out of the router: they'd otherwise claim expert capacity
+        ahead of later sequences' real tokens and skew the aux loss."""
 
         @nn.compact
-        def __call__(self, x):
+        def __call__(self, x, mask):
             from tensorflowonspark_tpu.parallel import moe
 
             E, M, H = config.moe_experts, config.hidden, config.mlp_dim
@@ -153,7 +158,8 @@ def make_model(config: Config, mesh=None):
                 "b_out": par("b_out", (E, M), zeros),
             }
             return moe.moe_ffn(
-                x, p, capacity_factor=config.moe_capacity_factor)
+                x, p, capacity_factor=config.moe_capacity_factor,
+                token_mask=mask, group_size=config.moe_group_size)
 
     class Block(nn.Module):
         moe: bool = False
@@ -163,7 +169,7 @@ def make_model(config: Config, mesh=None):
             y = Attention(name="attention")(x, mask)
             x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + y).astype(dtype)
             if self.moe:
-                y, aux = MoEMLP(name="moe_mlp")(x)
+                y, aux = MoEMLP(name="moe_mlp")(x, mask)
             else:
                 y = dense(config.mlp_dim, ("embed", "mlp"), name="mlp_in")(x)
                 y = nn.gelu(y)

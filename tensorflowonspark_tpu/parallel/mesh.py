@@ -229,18 +229,23 @@ def replicated(mesh):
 
 
 def batch_spec(ndim: int, sequence_axis: int | None = None):
-    """PartitionSpec for a data batch: axis 0 over (dp, fsdp), optionally a
-    sequence axis over sp.
+    """PartitionSpec for a data batch: axis 0 over (dp, fsdp, ep),
+    optionally a sequence axis over sp.
 
     fsdp participates in the batch split because ZeRO shards state *across
     the data-parallel group* — dp and fsdp together form the data-parallel
     world (scaling-book recipe), they differ only in how parameters are
-    stored.
+    stored.  ep participates too (the standard expert-parallel layout):
+    outside MoE layers the ep group is just more data parallelism — NOT
+    sharding the batch over it would compute the whole non-expert trunk
+    redundantly on every ep group — while inside :func:`moe.moe_ffn` the
+    expert dim takes over and the batch→expert reshard lowers to the token
+    all_to_all over ``ep``.
     """
     import jax
 
     spec: list[Any] = [None] * ndim
-    spec[0] = ("dp", "fsdp")
+    spec[0] = ("dp", "fsdp", "ep")
     if sequence_axis is not None and ndim > sequence_axis:
         spec[sequence_axis] = "sp"
     return jax.sharding.PartitionSpec(*spec)
@@ -286,7 +291,7 @@ def shard_batch(mesh, batch, sequence_axes: dict[str, int] | None = None):
 #: Models in :mod:`tensorflowonspark_tpu.models` annotate their params with
 #: these logical names via ``flax.linen.with_partitioning``.
 DEFAULT_RULES: tuple[tuple[str, Any], ...] = (
-    ("batch", ("dp", "fsdp")),
+    ("batch", ("dp", "fsdp", "ep")),
     ("sequence", "sp"),
     ("embed", "fsdp"),      # model dim: ZeRO-shard storage when fsdp>1
     ("mlp", "tp"),          # hidden/ffn dim: tensor-parallel

@@ -51,13 +51,17 @@ def capacity_of(num_tokens: int, num_experts: int,
     return max(1, int(num_tokens * capacity_factor / num_experts))
 
 
-def top1_route(logits, capacity: int):
+def top1_route(logits, capacity: int, token_mask=None):
     """Switch top-1 routing → (dispatch, combine, aux_loss).
 
-    ``logits``: (T, E) float32 router scores.  Returns
+    ``logits``: (T, E) float32 router scores.  ``token_mask``: optional
+    (T,) 1.0/0.0 — masked-out (padding) tokens are NOT routed: they claim
+    no capacity slot (so a short sequence's pads can't crowd out a later
+    sequence's real tokens), produce zero output (the residual carries
+    them), and are excluded from the load-balance statistics.  Returns
 
     - ``dispatch``: (T, E, C) one-hot — token t occupies slot c of expert e
-      (all-zero row = dropped token),
+      (all-zero row = dropped or padding token),
     - ``combine``: ``dispatch`` scaled by the router probability,
     - ``aux``: the Switch load-balancing scalar (see module docstring).
     """
@@ -68,9 +72,12 @@ def top1_route(logits, capacity: int):
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     expert_idx = jnp.argmax(probs, axis=-1)                     # (T,)
     onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)   # (T, E)
+    if token_mask is not None:
+        onehot = onehot * token_mask.astype(jnp.float32)[:, None]
 
     # slot within the chosen expert: 0-based running count of earlier
-    # tokens routed to the same expert (token order = slot order)
+    # tokens routed to the same expert (token order = slot order; padding
+    # rows are all-zero in ``onehot`` and advance no counter)
     position = jnp.cumsum(onehot, axis=0) * onehot - onehot     # (T, E)
     keep = (position < capacity).astype(jnp.float32) * onehot
     slot = jax.nn.one_hot(
@@ -80,23 +87,51 @@ def top1_route(logits, capacity: int):
     gate_prob = jnp.sum(probs * onehot, axis=-1)                # (T,)
     combine = dispatch * gate_prob[:, None, None]
 
-    # load balance: fraction routed vs mean probability, per expert
-    f = onehot.mean(axis=0)                                     # (E,)
-    p = probs.mean(axis=0)                                      # (E,)
+    # load balance: fraction routed vs mean probability, per expert —
+    # means over REAL tokens only
+    if token_mask is None:
+        n_real = jnp.float32(t)
+        f = onehot.sum(axis=0) / n_real                         # (E,)
+        p = probs.mean(axis=0)                                  # (E,)
+    else:
+        tm = token_mask.astype(jnp.float32)
+        n_real = jnp.maximum(tm.sum(), 1.0)
+        f = onehot.sum(axis=0) / n_real
+        p = (probs * tm[:, None]).sum(axis=0) / n_real
     aux = e * jnp.sum(f * p)
     return dispatch, combine, aux
 
 
+def group_count(num_tokens: int, group_size: int) -> int:
+    """Number of routing groups: tokens split into equal groups of at most
+    ``group_size`` — the largest divisor of ``num_tokens`` that fits."""
+    tg = min(num_tokens, max(1, group_size))
+    while num_tokens % tg:
+        tg -= 1
+    return num_tokens // tg
+
+
 def moe_ffn(x, params: Mapping[str, Any], *, capacity_factor: float = 1.25,
-            activation=None):
+            activation=None, token_mask=None, group_size: int = 1024):
     """Expert-parallel FFN over tokens ``x`` of shape ``(..., M)``.
 
     ``params``: the :data:`PARAM_AXES` pytree — ``gate (M, E)``,
     ``w_in (E, M, H)``, ``b_in (E, H)``, ``w_out (E, H, M)``,
-    ``b_out (E, M)``.  Returns ``(y, aux_loss)`` with ``y`` shaped like
+    ``b_out (E, M)``.  ``token_mask``: optional, shaped like ``x`` minus
+    the feature dim — 0 marks padding tokens, which are not routed (see
+    :func:`top1_route`).  Returns ``(y, aux_loss)`` with ``y`` shaped like
     ``x``; the caller adds the residual and weighs ``aux_loss`` into the
     objective.  Computation follows the house MXU policy: matmuls in the
     input dtype with float32 accumulation; router math fully float32.
+
+    Routing runs per **token group** of ≤ ``group_size`` tokens (standard
+    Switch/Mesh-TF practice): the dispatch/combine tensors are
+    ``(G, Tg, E, C)`` with ``C = capacity_factor·Tg/E``, i.e. memory
+    ``O(T·Tg)`` — *linear* in the global token count for a fixed group
+    size, where one global group would be quadratic (B=32, S=384 BERT
+    shapes: ~63 MB vs ~755 MB per MoE layer) — and the capacity bound +
+    load-balance aux apply within each group.  Token order is preserved;
+    batches ≤ ``group_size`` tokens route exactly as a single group.
     """
     import jax
     import jax.numpy as jnp
@@ -111,35 +146,53 @@ def moe_ffn(x, params: Mapping[str, Any], *, capacity_factor: float = 1.25,
     dtype = x.dtype
     lead = x.shape[:-1]
     m = x.shape[-1]
-    xt = x.reshape(-1, m)                                       # (T, M)
-    t = xt.shape[0]
+    t = 1
+    for s in lead:
+        t *= s
+    g = group_count(t, group_size)
+    xt = x.reshape(g, t // g, m)                                # (G, Tg, M)
     e = params["w_in"].shape[0]
-    c = capacity_of(t, e, capacity_factor)
+    c = capacity_of(t // g, e, capacity_factor)
 
-    logits = jnp.einsum("tm,me->te", xt.astype(jnp.float32),
+    grouped_mask = (None if token_mask is None
+                    else token_mask.reshape(g, t // g))         # (G, Tg)
+    logits = jnp.einsum("gtm,me->gte", xt.astype(jnp.float32),
                         params["gate"].astype(jnp.float32))
-    dispatch, combine, aux = top1_route(logits, c)
+    if grouped_mask is None:
+        dispatch, combine, aux = jax.vmap(
+            lambda lg: top1_route(lg, c))(logits)
+    else:
+        dispatch, combine, aux = jax.vmap(
+            lambda lg, mg: top1_route(lg, c, token_mask=mg))(
+                logits, grouped_mask)
 
-    # (E, C, M): each expert's padded token block — sharded over ep so the
-    # expert matmuls (and the all_to_alls feeding them) run expert-parallel
-    expert_in = jnp.einsum("tec,tm->ecm", dispatch.astype(dtype), xt,
+    # (G, E, C, M): each expert's padded token block per group — sharded
+    # over ep so the expert matmuls (and the all_to_alls feeding them) run
+    # expert-parallel
+    expert_in = jnp.einsum("gtec,gtm->gecm", dispatch.astype(dtype), xt,
                            preferred_element_type=jnp.float32).astype(dtype)
     active = mesh_lib.get_active_mesh()
     if active is not None and active.shape.get("ep", 1) > 1:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        # pin ONLY the expert dim (that is what forces the token
+        # all_to_all over ep); the group/capacity/model dims stay
+        # UNCONSTRAINED — a None here would mean "replicated" and would
+        # all_gather every group onto every dp/fsdp rank, making each
+        # data-parallel rank compute the global batch's expert FFNs
+        u = P.UNCONSTRAINED
         expert_in = jax.lax.with_sharding_constraint(
-            expert_in, NamedSharding(active, P("ep", None, None)))
+            expert_in, NamedSharding(active, P(u, "ep", u, u)))
     h = activation(
-        jnp.einsum("ecm,emh->ech", expert_in, params["w_in"].astype(dtype),
+        jnp.einsum("gecm,emh->gech", expert_in, params["w_in"].astype(dtype),
                    preferred_element_type=jnp.float32).astype(dtype)
-        + params["b_in"].astype(dtype)[:, None, :])
-    out = jnp.einsum("ech,ehm->ecm", h, params["w_out"].astype(dtype),
+        + params["b_in"].astype(dtype)[None, :, None, :])
+    out = jnp.einsum("gech,ehm->gecm", h, params["w_out"].astype(dtype),
                      preferred_element_type=jnp.float32).astype(dtype)
-    out = out + params["b_out"].astype(dtype)[:, None, :]
-    y = jnp.einsum("tec,ecm->tm", combine.astype(dtype), out,
+    out = out + params["b_out"].astype(dtype)[None, :, None, :]
+    y = jnp.einsum("gtec,gecm->gtm", combine.astype(dtype), out,
                    preferred_element_type=jnp.float32).astype(dtype)
-    return y.reshape(*lead, m), aux
+    return y.reshape(*lead, m), aux.mean()
 
 
 def init_params(rng, num_experts: int, model_dim: int, hidden_dim: int,

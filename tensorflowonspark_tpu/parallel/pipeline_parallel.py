@@ -142,9 +142,10 @@ def pipeline_apply(
         )
 
     # pp composes with data parallelism: each microbatch's batch dim is
-    # sharded over (dp, fsdp), so every dp shard pipelines its own slice of
+    # sharded over (dp, fsdp, ep) — the framework's data axes, matching
+    # mesh.batch_spec — so every data shard pipelines its own slice of
     # the data instead of redundantly recomputing the global batch
-    data_axes = tuple(a for a in ("dp", "fsdp")
+    data_axes = tuple(a for a in ("dp", "fsdp", "ep")
                       if a in mesh.axis_names and mesh.shape[a] > 1)
     data_world = 1
     for a in data_axes:

@@ -170,13 +170,16 @@ def make_sharded_attention(mesh, causal: bool = False, impl: str = "ring"):
     """Wrap :func:`ring_attention` in ``shard_map`` over the full mesh.
 
     Inputs/outputs are global ``(batch, seq, heads, head_dim)`` arrays with
-    batch over (dp, fsdp) and seq over sp.  Usable directly inside a jitted
-    model: shard_map composes with jit and with grad.
+    batch over (dp, fsdp, ep) — matching ``mesh.batch_spec``, so an MoE
+    model's sp attention doesn't all_gather the batch over ep and compute
+    each attention layer redundantly per ep group — and seq over sp.
+    Usable directly inside a jitted model: shard_map composes with jit and
+    with grad.
     """
     from jax.sharding import PartitionSpec as P
 
-    spec = P(("dp", "fsdp"), "sp", None, None)
-    mask_spec = P(("dp", "fsdp"), "sp")
+    spec = P(("dp", "fsdp", "ep"), "sp", None, None)
+    mask_spec = P(("dp", "fsdp", "ep"), "sp")
     fn = ring_attention if impl == "ring" else ulysses_attention
 
     def attn_plain(q, k, v):

@@ -84,7 +84,8 @@ class Trainer:
         # shardings divide evenly for any mesh (dp*fsdp may be odd); a
         # pipelined model additionally splits the batch into microbatches,
         # each of which must still divide the data-parallel world
-        data_world = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+        data_world = (self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+                      * self.mesh.shape.get("ep", 1))
         micro = 1
         if (getattr(self.config, "pp_stages", 0) or 0) > 1 and \
                 self.mesh.shape.get("pp", 1) > 1:

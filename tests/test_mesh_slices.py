@@ -53,7 +53,7 @@ def test_two_slice_mesh_confines_tp_sp_to_a_slice():
     cfg = MeshConfig(dp=2, sp=2, tp=2, slices=2).resolve(8)
     assert cfg.dcn_axis() == "dp"
     mesh = build_mesh(cfg, devices=devices)
-    assert dict(mesh.shape) == {"dp": 2, "fsdp": 1, "pp": 1, "sp": 2, "tp": 2}
+    assert dict(mesh.shape) == {"dp": 2, "fsdp": 1, "ep": 1, "pp": 1, "sp": 2, "tp": 2}
     _check_ici_axes_stay_in_slice(
         mesh, "dp", 2, _device_slice_map(devices, 2))
 

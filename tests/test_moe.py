@@ -58,6 +58,94 @@ def test_aux_loss_minimised_at_uniform_routing():
     assert float(aux_s) > float(aux_u) >= 0.99  # uniform → ~1.0
 
 
+def test_top1_route_padding_tokens_not_routed():
+    """Masked (padding) tokens claim no capacity slot, produce no output,
+    and are excluded from the load-balance statistics — an early sequence's
+    pads must not crowd out a later sequence's real tokens."""
+    t, e = 16, 4
+    # every token prefers expert 0; the first 8 are PADDING
+    logits = jnp.zeros((t, e), jnp.float32).at[:, 0].set(10.0)
+    mask = jnp.concatenate([jnp.zeros(8), jnp.ones(8)])
+    dispatch, combine, aux = moe.top1_route(logits, 3, token_mask=mask)
+    d = np.asarray(dispatch)
+    # pads routed nowhere
+    assert d[:8].sum() == 0.0
+    # the 3 capacity slots went to the first REAL tokens (8, 9, 10), not
+    # to pads
+    assert d[8:11, 0].sum() == 3.0
+    assert d[11:].sum() == 0.0
+    # aux computed over real tokens only: all 8 real tokens on one of 4
+    # experts → f=(1,0,0,0), p≈(1,0,0,0) → aux ≈ e·1 = 4, same as the
+    # unmasked all-on-one-expert case (pads don't dilute it)
+    _, _, aux_unmasked = moe.top1_route(logits[8:], 3)
+    np.testing.assert_allclose(float(aux), float(aux_unmasked), rtol=1e-6)
+
+
+def test_moe_ffn_token_mask_zeroes_padding_output():
+    params = moe.init_params(jax.random.PRNGKey(3), num_experts=2,
+                             model_dim=8, hidden_dim=16)
+    x = jnp.asarray(np.random.RandomState(4)
+                    .randn(2, 6, 8).astype(np.float32))
+    mask = jnp.asarray([[1, 1, 1, 0, 0, 0], [1, 1, 1, 1, 1, 1]],
+                       jnp.float32)
+    y, aux = moe.moe_ffn(x, params, token_mask=mask)
+    y = np.asarray(y)
+    # padding positions contribute exactly zero (residual carries them)
+    assert np.abs(y[0, 3:]).max() == 0.0
+    # real positions generally non-zero
+    assert np.abs(y[1]).max() > 0.0
+    assert np.isfinite(float(aux))
+
+
+def test_group_count_picks_largest_fitting_divisor():
+    assert moe.group_count(64, 1024) == 1      # small batch: one group
+    assert moe.group_count(4096, 1024) == 4    # exact split
+    assert moe.group_count(12288, 1024) == 12  # BERT-large-ish T
+    assert moe.group_count(96, 64) == 2        # 96 = 2×48, 48 ≤ 64
+    assert moe.group_count(7, 4) == 7          # prime: degenerates safely
+
+
+def test_moe_ffn_grouped_routing_matches_explicit_groups():
+    """group_size splits routing into independent groups: the output for
+    group g must equal running that group alone (capacity + aux are
+    per-group by construction)."""
+    params = moe.init_params(jax.random.PRNGKey(5), num_experts=2,
+                             model_dim=8, hidden_dim=16)
+    x = jnp.asarray(np.random.RandomState(6)
+                    .randn(4, 8, 8).astype(np.float32))  # T=32
+    y, aux = moe.moe_ffn(x, params, group_size=16)       # 2 groups of 16
+    y0, aux0 = moe.moe_ffn(x[:2], params, group_size=16)  # group 0 alone
+    y1, aux1 = moe.moe_ffn(x[2:], params, group_size=16)  # group 1 alone
+    np.testing.assert_allclose(np.asarray(y[:2]), np.asarray(y0),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(y[2:]), np.asarray(y1),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(aux), (float(aux0) + float(aux1)) / 2,
+                               rtol=1e-6)
+
+
+def test_bert_moe_composes_with_sequence_parallel():
+    """MoE (ep) together with sp ring attention: the batch stays sharded
+    over ep through the attention shard_map (no redundant per-ep-group
+    trunk compute) and numerics match the dp-only run."""
+    from tensorflowonspark_tpu.models import bert
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    cfg = dataclasses.replace(bert.Config.tiny(), moe_experts=4)
+    batch = bert.example_batch(cfg, batch_size=8, seq_len=16)
+    t_ref = Trainer("bert", config=cfg, mesh_config=MeshConfig(dp=8), seed=21)
+    t_es = Trainer("bert", config=cfg,
+                   mesh_config=MeshConfig(dp=2, ep=2, sp=2), seed=21)
+    s_r, e_r = t_ref.predict(batch)
+    s_e, e_e = t_es.predict(batch)
+    np.testing.assert_allclose(np.asarray(s_e), np.asarray(s_r),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(e_e), np.asarray(e_r),
+                               rtol=2e-4, atol=2e-4)
+    loss = float(t_es.step(batch))
+    assert np.isfinite(loss)
+
+
 def test_moe_ffn_expert_parallel_matches_unsharded():
     """The SAME tokens/params through an ep=2 mesh and a dp-only mesh must
     produce the same outputs — GSPMD's expert all_to_alls are an

@@ -32,7 +32,7 @@ def test_mesh_config_resolve():
 
 def test_build_mesh_axes():
     mesh = build_mesh(MeshConfig(dp=2, sp=2, tp=2))
-    assert dict(mesh.shape) == {"dp": 2, "fsdp": 1, "pp": 1, "sp": 2, "tp": 2}
+    assert dict(mesh.shape) == {"dp": 2, "fsdp": 1, "ep": 1, "pp": 1, "sp": 2, "tp": 2}
 
 
 def test_shard_batch_places_batch_axis():
@@ -40,8 +40,8 @@ def test_shard_batch_places_batch_axis():
     batch = {"x": np.ones((8, 6, 4), np.float32), "y": np.ones((8,), np.int32)}
     out = shard_batch(mesh, batch, sequence_axes={"x": 1})
     spec = out["x"].sharding.spec
-    assert spec[0] == ("dp", "fsdp") and spec[1] == "sp"
-    assert out["y"].sharding.spec[0] == ("dp", "fsdp")
+    assert spec[0] == ("dp", "fsdp", "ep") and spec[1] == "sp"
+    assert out["y"].sharding.spec[0] == ("dp", "fsdp", "ep")
 
 
 def _toy_setup(mesh, zero=False):
