@@ -3,9 +3,11 @@
 Reference anchor: **none exists in the reference** — this config comes from
 ``BASELINE.json`` ("BERT-base SQuAD fine-tune streamed from Spark DataFrame,
 sharded over TPU pod").  The mesh axes come from the CLI: ``--dp/--fsdp/
---sp/--tp`` map straight onto the named mesh; ``--sp > 1`` activates ring
-attention over ICI (sequence sharded across devices, K/V blocks rotating via
-``ppermute`` — long-context first-class).
+--sp/--tp/--pp/--ep`` map straight onto the named mesh; ``--sp > 1``
+activates ring attention over ICI (sequence sharded across devices, K/V
+blocks rotating via ``ppermute`` — long-context first-class);
+``--moe_experts N --ep E`` switches every 2nd FFN to a Switch-MoE layer
+expert-parallel over ``ep``.
 
     python examples/bert/bert_squad.py --cluster_size 2 --tiny --sp 2
 """
@@ -41,10 +43,13 @@ def map_fun(args, ctx):
         # GPipe trunk: stacked layer params over the pp axis
         config = dataclasses.replace(config, pp_stages=args.pp,
                                      pp_microbatches=args.pp_microbatches)
+    if args.moe_experts > 0:
+        # Switch-MoE FFN layers, expert-parallel over the ep mesh axis
+        config = dataclasses.replace(config, moe_experts=args.moe_experts)
     trainer = Trainer(
         "bert", config=config,
         mesh_config=MeshConfig(dp=args.dp, fsdp=args.fsdp, sp=args.sp,
-                               tp=args.tp, pp=args.pp),
+                               tp=args.tp, pp=args.pp, ep=args.ep),
         optimizer=optax.adamw(args.lr, weight_decay=0.01),
         zero=args.fsdp > 1 or ctx.num_ps > 0,  # num_ps parity: ZeRO mapping
     )
@@ -116,6 +121,13 @@ def main(argv=None):
                         "--tp and --sp — ring attention runs inside "
                         "pipeline stages when --sp > 1)")
     p.add_argument("--pp_microbatches", type=int, default=4)
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel mesh axis (use with "
+                        "--moe_experts; experts and their token blocks "
+                        "shard over ep)")
+    p.add_argument("--moe_experts", type=int, default=0,
+                   help="> 0 switches every 2nd FFN to a Switch-MoE "
+                        "layer with this many experts")
     p.add_argument("--num_samples", type=int, default=512)
     p.add_argument("--model_dir", default=None)
     p.add_argument("--tiny", action="store_true")

@@ -140,3 +140,15 @@ def test_bert_squad_example_pp_with_sp(capsys):
               "--pp_microbatches", "2"])
     out = capsys.readouterr().out
     assert "'pp': 2" in out and "'sp': 2" in out
+
+
+def test_bert_squad_example_moe_expert_parallel(capsys):
+    """--moe_experts 4 --ep 2: Switch-MoE FFN layers expert-parallel over
+    the ep mesh axis, through the full cluster path (SPARK feed included)."""
+    mod = _load("bert", "bert_squad")
+    mod.main(["--cluster_size", "2", "--epochs", "1", "--tiny",
+              "--num_samples", "64", "--batch_size", "8",
+              "--seq_len", "32", "--moe_experts", "4", "--ep", "2",
+              "--dp", "2", "--tp", "2"])
+    out = capsys.readouterr().out
+    assert "'ep': 2" in out and "loss=" in out
