@@ -275,9 +275,35 @@ class TFCluster:
 
     def _check_bootstrap_error(self) -> None:
         if self._thread_error:
+            detail = ""
+            for msg in self._drain_node_errors():
+                detail += f"\n  node error: {msg}"
             raise RuntimeError(
-                "cluster bootstrap/training job failed"
+                "cluster bootstrap/training job failed" + detail
             ) from self._thread_error[0]
+
+    def _drain_node_errors(self) -> list:
+        """Best-effort read of every node's error queue, so a trainer that
+        attributed its own death (e.g. the mid-run wedge watchdog's
+        ``ctx.report_error`` before ``os._exit``) names itself in the
+        driver's exception instead of leaving only the substrate's generic
+        'executor died' message."""
+        from tensorflowonspark_tpu import TFManager
+
+        msgs = []
+        try:
+            authkey = bytes.fromhex(self.cluster_meta["authkey_hex"])
+        except Exception:
+            return msgs
+        for meta in self.cluster_info or []:
+            try:
+                q = TFManager.connect(
+                    tuple(meta["addr"]), authkey).get_queue("error")
+                while True:  # drain until Empty (raises) or manager gone
+                    msgs.append(q.get(block=False))
+            except Exception:
+                continue
+        return msgs
 
 
 def run(

@@ -35,10 +35,67 @@ _kv: dict[str, Any] = {}
 _maxsize: list[int] = [1024]
 
 
-def _setup(qnames: Iterable[str], maxsize: int) -> None:
+def _setup(qnames: Iterable[str], maxsize: int,
+           parent_pid: int | None = None) -> None:
     _maxsize[0] = maxsize
     for name in qnames:
         _queues[name] = _queue_mod.Queue(maxsize)
+    _start_orphan_watch(parent_pid)
+
+
+def _start_orphan_watch(parent_pid: int | None) -> None:
+    """Exit the manager server once every process it serves is gone.
+
+    A node process that dies abruptly (e.g. the mid-run wedge watchdog's
+    ``os._exit``, or a SIGKILL) orphans this server.  Beyond the leak, the
+    orphan pins the multiprocessing ``resource_tracker`` pipe it inherited,
+    which blocks the *driver's* interpreter exit in
+    ``resource_tracker._stop`` (observed: a driver that handled the failure
+    cleanly then hung forever at shutdown).
+
+    "Everyone it serves" is NOT just the starting parent: in SPARK mode the
+    bootstrap worker that started the manager may legitimately be reaped
+    mid-job (``spark.python.worker.reuse=false``) while the spawned trainer
+    still depends on the data plane — the node runtime publishes that
+    trainer's pid as kv ``trainer_pid``, and the watch keeps serving while
+    it is alive.  Only when the parent is gone AND no registered trainer is
+    alive does the server exit, after a short grace that lets the driver
+    drain the error/kv queues attributing the failure.  On any
+    indeterminate liveness check it keeps serving (the pre-watch behavior).
+    """
+    if not parent_pid:
+        return
+    import os
+    import threading
+    import time
+
+    grace = float(os.environ.get("TFOS_MANAGER_ORPHAN_GRACE_S", "15"))
+
+    def _trainer_alive() -> bool:
+        owner = _kv.get("trainer_pid")  # same-process global (server side)
+        if not owner:
+            return False
+        try:
+            os.kill(int(owner), 0)
+            return True
+        except ProcessLookupError:
+            return False
+        except Exception:
+            return True  # indeterminate: keep serving
+
+    def watch() -> None:
+        while True:
+            time.sleep(2.0)
+            if os.getppid() == parent_pid:
+                continue
+            if _trainer_alive():
+                continue
+            time.sleep(grace)
+            if not _trainer_alive():
+                os._exit(0)
+
+    threading.Thread(target=watch, name="tfos-manager-orphan-watch",
+                     daemon=True).start()
 
 
 def _get_queue(qname: str) -> _queue_mod.Queue:
@@ -202,9 +259,12 @@ def start(
     host = "127.0.0.1" if mode == "local" else ""
     # spawn, not fork: the caller typically has live JAX threads, and forking
     # a multithreaded process deadlocks (JAX warns loudly about this).
+    import os
+
     ctx = multiprocessing.get_context("spawn")
     mgr = _TFManagerBase(address=(host, 0), authkey=authkey, ctx=ctx)
-    mgr.start(initializer=_setup, initargs=(list(queues), maxsize))
+    mgr.start(initializer=_setup,
+              initargs=(list(queues), maxsize, os.getpid()))
     return TFManager(mgr, owns_server=True)
 
 

@@ -111,6 +111,16 @@ class TFNodeContext:
 
         return hdfs_path(self, path)
 
+    def report_error(self, message: str) -> None:
+        """Push an attributed failure onto this node's error queue (the
+        queue the driver re-raises from at ``train``/``shutdown``).  Wire
+        it as ``Trainer(error_sink=ctx.report_error)`` so the mid-run wedge
+        watchdog (``health.StepWatchdog``) names the sick executor before
+        hard-exiting the trainer process."""
+        self.mgr.get_queue("error").put(
+            f"executor {self.executor_id} ({self.job_name}:{self.task_index})"
+            f": {message}")
+
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_mgr"] = None  # manager proxies don't survive pickling
@@ -316,6 +326,11 @@ class _MapFn:
                 daemon=True,
             )
             p.start()
+            # the manager's orphan watch keys liveness to this pid: the
+            # bootstrap worker may be reaped long before the trainer is
+            # done (spark.python.worker.reuse=false), and the data plane
+            # must outlive the worker, not the trainer
+            mgr.set("trainer_pid", p.pid)
             logger.info(
                 "executor %s: trainer started in background pid %s", executor_id, p.pid
             )

@@ -83,6 +83,76 @@ def probe_chip_health(timeout_s: float = DEFAULT_TIMEOUT_S) -> str | None:
     return None
 
 
+_STALL_EXIT_CODE = 86
+
+
+class StepWatchdog:
+    """Mid-training wedge detector: the rendezvous probe (above) catches a
+    chip that is wedged at bootstrap, but this hardware's observed outage
+    also strikes *mid-run* — a dispatched step simply never completes, and
+    the mesh then hangs at a collective with nothing but ``feed_timeout``
+    (driver-side, generic) to notice.  The watchdog turns that into a fast,
+    attributed trainer failure: ``arm()`` when a step is dispatched,
+    ``beat()`` when its result has materialized; if an armed step stays
+    incomplete for ``timeout_s``, ``on_stall(reason)`` runs once (push the
+    reason to the node's error queue) and then the process hard-exits
+    (``os._exit``) — a wedged device op cannot be interrupted in-process,
+    and failing fast is the framework's recovery contract
+    (``spark.task.maxFailures=1`` semantics + restart from checkpoint,
+    SURVEY §5/§7).
+
+    ``on_stall`` is injectable so tests (and embedders that prefer a
+    different policy) can observe the stall without dying.
+    """
+
+    def __init__(self, timeout_s: float, on_stall=None, *, exit_on_stall=True):
+        import threading
+
+        self.timeout_s = float(timeout_s)
+        self._on_stall = on_stall
+        self._exit = exit_on_stall
+        self._armed_at: float | None = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._fired = False
+        self._thread = threading.Thread(
+            target=self._monitor, name="tfos-step-watchdog", daemon=True)
+        self._thread.start()
+
+    def arm(self) -> None:
+        with self._lock:
+            self._armed_at = time.monotonic()
+
+    def beat(self) -> None:
+        with self._lock:
+            self._armed_at = None
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _monitor(self) -> None:
+        poll = max(0.05, self.timeout_s / 4.0)
+        while not self._stop.wait(poll):
+            with self._lock:
+                armed_at = self._armed_at
+            if armed_at is None or self._fired:
+                continue
+            stalled = time.monotonic() - armed_at
+            if stalled < self.timeout_s:
+                continue
+            self._fired = True
+            reason = (f"train step stalled for {stalled:.0f}s "
+                      f"(> step_timeout_s={self.timeout_s:.0f}) — "
+                      "chip/slice wedged mid-run?")
+            logger.critical("%s", reason)
+            try:
+                if self._on_stall is not None:
+                    self._on_stall(reason)
+            finally:
+                if self._exit:
+                    os._exit(_STALL_EXIT_CODE)
+
+
 def should_probe(cluster_meta: dict, chips: list) -> bool:
     """Decide whether this bootstrap should probe (see module docstring)."""
     env = os.environ.get("TFOS_HEALTH_PROBE")

@@ -20,6 +20,7 @@ TPU-first details:
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Any
 
@@ -54,6 +55,8 @@ class Trainer:
         zero: bool | None = None,
         seed: int = 0,
         devices: Any = None,
+        step_timeout_s: float | None = None,
+        error_sink: Any = None,
     ):
         import jax
         import optax
@@ -136,6 +139,23 @@ class Trainer:
         self._step_callbacks: list = []
         self._last_step_t: float | None = None
 
+        # mid-run wedge watchdog (health.StepWatchdog): opt-in via the
+        # step_timeout_s param or TFOS_STEP_TIMEOUT_S.  When armed, step()
+        # synchronously materializes the loss so "step completed" is a
+        # device-proven fact, and a stall kills the trainer process fast
+        # with the reason on the node's error queue (error_sink, e.g.
+        # ctx.report_error) instead of hanging the mesh until feed_timeout.
+        if step_timeout_s is None:
+            env_t = os.environ.get("TFOS_STEP_TIMEOUT_S")
+            step_timeout_s = float(env_t) if env_t else None
+        self._watchdog = None
+        self._watchdog_warm_shapes: set = set()
+        if step_timeout_s and step_timeout_s > 0:
+            from tensorflowonspark_tpu import health
+
+            self._watchdog = health.StepWatchdog(
+                step_timeout_s, on_stall=error_sink)
+
         # a model-zoo module may supply its own sharded step (e.g. wide&deep's
         # sparse embedding update); it composes via parallel.train.compile_step
         make_custom = getattr(self.module_lib, "make_sharded_train_step", None)
@@ -176,7 +196,13 @@ class Trainer:
 
     def step(self, batch) -> float:
         """One sharded optimizer step; returns the (replicated) loss."""
+        if self._watchdog is not None:
+            return self._watchdogged_step(batch)
         self.state, loss = self.train_step(self.state, self.shard(batch))
+        return self._after_step(loss, batch)
+
+    def _after_step(self, loss, batch):
+        """Shared post-step accounting: wall-time + examples → callbacks."""
         if self._step_callbacks:
             now = time.perf_counter()
             dt = now - self._last_step_t if self._last_step_t else 0.0
@@ -185,6 +211,40 @@ class Trainer:
             for cb in self._step_callbacks:
                 cb(loss, n, dt)
         return loss
+
+    def _watchdogged_step(self, batch) -> float:
+        """step() under the mid-run wedge watchdog: the loss is forced to
+        the host inside the armed window, so a wedged chip trips the
+        watchdog instead of deferring the hang to a later fetch.
+
+        The watchdog only arms for batch shapes it has already seen
+        complete once: jit compiles lazily on first call (and recompiles on
+        a shape change, e.g. a short final batch), and minutes of XLA
+        compilation inside an armed window would read as a wedge and kill a
+        healthy trainer.  Unarmed steps still hang forever on a truly
+        wedged chip — but the first step of a run meeting a wedged chip is
+        the rendezvous health probe's job (health.probe_chip_health), not
+        this watchdog's.
+        """
+        import jax
+
+        shapes = tuple(sorted(
+            (k, tuple(getattr(v, "shape", ())))
+            for k, v in batch.items())) if isinstance(batch, dict) else None
+        armed = shapes in self._watchdog_warm_shapes
+        if armed:
+            self._watchdog.arm()
+            if os.environ.get("TFOS_STEP_WATCHDOG_TEST_HANG"):
+                time.sleep(3600)  # simulated mid-run wedge (tests)
+        try:
+            self.state, loss = self.train_step(self.state, self.shard(batch))
+            loss = jax.block_until_ready(loss)
+        finally:
+            # disarm on ANY exit: an exception a caller handles must not
+            # leave a stale armed timestamp that later reads as a stall
+            self._watchdog.beat()
+        self._watchdog_warm_shapes.add(shapes)
+        return self._after_step(loss, batch)
 
     def predict(self, batch):
         if getattr(self.forward_fn, "stateful", False):

@@ -317,6 +317,113 @@ def test_probe_skipped_when_no_chips():
     assert health.should_probe({"health_probe": False}, chips=[0]) is False
 
 
+def test_step_watchdog_fires_on_stall_and_not_on_beats():
+    """Unit: an armed step that never completes trips on_stall once; beats
+    keep it quiet (exit_on_stall=False so the test process survives)."""
+    from tensorflowonspark_tpu import health
+
+    fired = []
+    wd = health.StepWatchdog(0.3, on_stall=fired.append,
+                             exit_on_stall=False)
+    try:
+        # beating steps: never fires
+        for _ in range(4):
+            wd.arm()
+            time.sleep(0.05)
+            wd.beat()
+        time.sleep(0.5)
+        assert fired == []
+        # a stall: fires exactly once, with an attributable reason
+        wd.arm()
+        time.sleep(1.0)
+        assert len(fired) == 1 and "stalled" in fired[0]
+    finally:
+        wd.stop()
+
+
+def test_trainer_step_watchdog_healthy_path():
+    """Trainer(step_timeout_s=...) on a healthy backend: steps run, loss is
+    finite, callbacks still fire, nothing trips."""
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    seen = []
+    t = Trainer("mnist_mlp", config=mnist.Config.tiny(), step_timeout_s=60,
+                error_sink=seen.append)
+    t.add_step_callback(lambda loss, n, dt: seen.append(("cb", float(loss))))
+    batch = mnist.example_batch(t.config, batch_size=8)
+    losses = [float(t.step(batch)) for _ in range(2)]
+    assert np.isfinite(losses).all()
+    assert [s for s in seen if isinstance(s, tuple)]  # callbacks ran
+    assert not [s for s in seen if isinstance(s, str)]  # no stall reported
+
+
+def test_trainer_watchdog_tolerates_compile_and_handled_errors():
+    """The first (compiling) step of each batch shape runs unarmed — XLA
+    compile minutes must not read as a wedge — and an exception the caller
+    handles disarms the watchdog instead of leaving a stale timestamp that
+    later fires (either failure here would os._exit the test run)."""
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    reported = []
+    t = Trainer("mnist_mlp", config=mnist.Config.tiny(), step_timeout_s=1,
+                error_sink=reported.append)
+    batch = mnist.example_batch(t.config, batch_size=8)
+    t.step(batch)   # compile happens here, unarmed (takes > timeout)
+    t.step(batch)   # armed steady-state step, well under the timeout
+    # same keys AND shapes as the warm batch (so this step runs ARMED) but
+    # an object-dtype leaf → shard/device_put raises mid-armed-window
+    bad = dict(batch)
+    bad["label"] = np.array(["x"] * len(np.asarray(batch["label"])))
+    with pytest.raises(Exception):
+        t.step(bad)
+    time.sleep(1.5)  # stale armed timestamp would fire in this window
+    assert reported == []
+
+
+def test_mid_run_wedge_fails_fast_and_named(monkeypatch):
+    """Cluster-level: a trainer whose step wedges mid-run (simulated via
+    TFOS_STEP_WATCHDOG_TEST_HANG) dies fast with the reason on the error
+    queue — the driver raises an attributed error instead of hanging the
+    mesh until feed_timeout."""
+    monkeypatch.setenv("TFOS_STEP_WATCHDOG_TEST_HANG", "1")
+    # shrink the dead-executor manager's orphan lingering so the test's
+    # teardown (sc.stop + interpreter exit) stays fast
+    monkeypatch.setenv("TFOS_MANAGER_ORPHAN_GRACE_S", "3")
+
+    def wedged_train_fun(args, ctx):
+        from tensorflowonspark_tpu import util
+
+        util.ensure_jax_platform()
+        from tensorflowonspark_tpu.models import mnist
+        from tensorflowonspark_tpu.trainer import Trainer
+
+        t = Trainer("mnist_mlp", config=mnist.Config.tiny(),
+                    step_timeout_s=3, error_sink=ctx.report_error)
+        batch = mnist.example_batch(t.config, batch_size=8)
+        t.step(batch)  # first step: compile warm-up, runs unarmed
+        t.step(batch)  # second step arms, then wedges — never returns
+
+    ctx = LocalSparkContext("local-cluster[1,1,1024]", "wedge-midrun-test")
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError) as ei:
+            cluster = TFCluster.run(
+                sc=ctx, map_fun=wedged_train_fun, tf_args=None,
+                num_executors=1,
+                input_mode=TFCluster.InputMode.TENSORFLOW,
+            )
+            cluster.shutdown(grace_secs=60)
+        msg = str(ei.value)
+        # the watchdog's report_error reached the driver's exception: the
+        # sick executor names itself and the stall reason
+        assert "stalled" in msg and "executor 0" in msg, msg
+        assert time.monotonic() - t0 < 90
+    finally:
+        ctx.stop()
+
+
 def test_train_requires_spark_mode(sc):
     cluster = TFCluster.run(sc, tf_mode_fun, tf_args=None, num_executors=2,
                             input_mode=TFCluster.InputMode.TENSORFLOW)
