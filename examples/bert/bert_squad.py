@@ -52,6 +52,7 @@ def map_fun(args, ctx):
                                tp=args.tp, pp=args.pp, ep=args.ep),
         optimizer=optax.adamw(args.lr, weight_decay=0.01),
         zero=args.fsdp > 1 or ctx.num_ps > 0,  # num_ps parity: ZeRO mapping
+        error_sink=ctx.report_error,  # attributes TFOS_STEP_TIMEOUT_S stalls
     )
     feed = ctx.get_data_feed(
         train_mode=True,

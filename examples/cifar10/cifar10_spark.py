@@ -47,7 +47,8 @@ def map_fun(args, ctx):
 
     distributed.maybe_initialize(ctx)
     config = cifar.Config.tiny() if args.tiny else cifar.Config()
-    trainer = Trainer("cifar10_cnn", config=config, learning_rate=args.lr)
+    trainer = Trainer("cifar10_cnn", config=config, learning_rate=args.lr,
+                      error_sink=ctx.report_error)
     reporter = metrics.MetricsReporter(ctx, interval=5)
     trainer.add_step_callback(reporter)
     side = config.image_size

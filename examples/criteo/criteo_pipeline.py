@@ -37,7 +37,8 @@ def train_fun(args, ctx):
     # the tables at 10x (the classic wide-vs-deep rate split), AdamW on the
     # MLP through the Trainer's default optimizer
     config = dataclasses.replace(config, table_lr=args.lr * 10.0)
-    trainer = Trainer("wide_deep", config=config, learning_rate=args.lr)
+    trainer = Trainer("wide_deep", config=config, learning_rate=args.lr,
+                      error_sink=ctx.report_error)
     feed = ctx.get_data_feed(train_mode=True,
                              input_mapping=["dense", "cat", "label"],
                              prefetch=2)
@@ -89,7 +90,8 @@ def parquet_train_fun(args, ctx):
 
     config = widedeep.Config.tiny() if args.tiny else widedeep.Config()
     config = dataclasses.replace(config, table_lr=args.lr * 10.0)
-    trainer = Trainer("wide_deep", config=config, learning_rate=args.lr)
+    trainer = Trainer("wide_deep", config=config, learning_rate=args.lr,
+                      error_sink=ctx.report_error)
 
     # the same (unresolved) path the driver wrote to — resolving only on
     # the read side would diverge from the writer under a remote defaultFS;

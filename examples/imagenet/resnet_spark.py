@@ -49,7 +49,8 @@ def map_fun(args, ctx):
 
     arch_lib = model_zoo.get_model(args.arch)
     config = arch_lib.Config.tiny() if args.tiny else arch_lib.Config()
-    trainer = Trainer(args.arch, config=config, learning_rate=args.lr)
+    trainer = Trainer(args.arch, config=config, learning_rate=args.lr,
+                      error_sink=ctx.report_error)
     reporter = metrics.MetricsReporter(ctx, interval=5)
     trainer.add_step_callback(reporter)
     side = config.image_size
