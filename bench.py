@@ -43,6 +43,10 @@ TARGETS = {
     # — the SAME-padded variant was ~41); at the chip's 0.30-0.35 MFU band
     # the roofline is ~3000-3500 img/s — target set to the band's floor
     "inception_v3": ("images/sec/chip", 3000.0),
+    # ~1.14 GFLOP fwd/img (≈1/7th of resnet50's compute) but depthwise
+    # convs run on the VPU, capping MFU well below ResNet's band —
+    # target ≈ 3× resnet
+    "mobilenet_v1": ("images/sec/chip", 6000.0),
     "wide_deep": ("steps/sec", 100.0),  # see TARGET_NOTES["wide_deep"]
     "bert": ("examples/sec/chip", 100.0),
     "mnist_mlp": ("images/sec/chip", 100000.0),
@@ -69,6 +73,7 @@ TARGET_NOTES = {
 ACCEL_BATCH = {
     "resnet50": 128,
     "inception_v3": 128,
+    "mobilenet_v1": 256,
     "wide_deep": 4096,
     "bert": 32,
     "mnist_mlp": 512,
@@ -228,6 +233,11 @@ def _analytic_flops(model: str, config, batch_size: int) -> float | None:
         if getattr(config, "canonical", False):
             return 3.0 * 5.7e9 * batch_size
         return 3.0 * 13.7e9 * batch_size
+    if model == "mobilenet_v1":
+        # derived from the block table for ANY width/image size
+        from tensorflowonspark_tpu.models import mobilenet
+
+        return 3.0 * mobilenet.analytic_fwd_flops(config) * batch_size
     if model == "wide_deep":
         # derived, not a constant: MLP matmul chain dominates the countable
         # FLOPs (the gathers/optimizer update are bandwidth, not FLOPs)

@@ -108,8 +108,9 @@ def prep_tfrecords(data_dir: str, n: int, parts: int, side: int,
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--arch", default="resnet50",
-                   choices=["resnet50", "inception_v3"],
-                   help="acceptance config #3 names both architectures")
+                   choices=["resnet50", "inception_v3", "mobilenet_v1"],
+                   help="acceptance config #3 names resnet50/inception_v3; "
+                        "mobilenet_v1 covers the reference's slim family")
     p.add_argument("--cluster_size", type=int, default=2)
     p.add_argument("--batch_size", type=int, default=32)
     p.add_argument("--epochs", type=int, default=1)

@@ -25,6 +25,7 @@ _REGISTRY = {
     "cifar10_cnn": "tensorflowonspark_tpu.models.cifar",
     "resnet50": "tensorflowonspark_tpu.models.resnet",
     "inception_v3": "tensorflowonspark_tpu.models.inception",
+    "mobilenet_v1": "tensorflowonspark_tpu.models.mobilenet",
     "wide_deep": "tensorflowonspark_tpu.models.widedeep",
     "bert": "tensorflowonspark_tpu.models.bert",
 }

@@ -1,0 +1,150 @@
+"""MobileNetV1 — the "slim" compact-net family of the reference era.
+
+Reference anchor: ``examples/slim`` (``SURVEY.md §1 L6`` lists the slim
+model zoo among the reference's examples; MobileNetV1 is its canonical
+compact classifier).  Architecture: Howard et al. 2017 — a 3×3 stride-2
+stem, then 13 **depthwise-separable** blocks (3×3 depthwise + 1×1
+pointwise), global average pool, classifier.
+
+TPU-first notes:
+
+- NHWC throughout (channels innermost → XLA tiles the pointwise 1×1 convs
+  onto the MXU; they carry ~95% of the FLOPs).
+- Depthwise convolutions lower to ``feature_group_count = channels`` —
+  they run on the VPU rather than the MXU, which is exactly why this
+  family's MFU ceiling is lower than ResNet's; the pointwise convs are
+  the MXU work.
+- GroupNorm, not BatchNorm (same choice as ``cifar.py``/``resnet.py``):
+  no cross-replica batch-stat sync over ICI, loss stays a pure function
+  of ``(params, batch)``.
+- ``width_mult`` scales every channel count (the paper's α), rounded to
+  multiples of 8 so GroupNorm groups and MXU lanes divide evenly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+#: (pointwise_channels, depthwise_stride) per separable block — the
+#: published 13-block schedule.
+_BLOCKS = (
+    (64, 1),
+    (128, 2), (128, 1),
+    (256, 2), (256, 1),
+    (512, 2), (512, 1), (512, 1), (512, 1), (512, 1), (512, 1),
+    (1024, 2), (1024, 1),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    width_mult: float = 1.0
+    num_classes: int = 1000
+    image_size: int = 224
+    groups: int = 8
+    dtype: str = "bfloat16"
+
+    @classmethod
+    def tiny(cls) -> "Config":
+        return cls(width_mult=0.25, num_classes=10, image_size=16,
+                   groups=2, dtype="float32")
+
+
+SEQUENCE_AXES: dict = {}
+
+
+def _scaled(ch: int, width_mult: float) -> int:
+    """Channel count under the width multiplier, rounded to a multiple of 8
+    (minimum 8) so GroupNorm groups and vector lanes divide evenly."""
+    return max(8, int(round(ch * width_mult / 8)) * 8)
+
+
+def make_model(config: Config, mesh=None):
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(config.dtype)
+    conv_init = nn.with_partitioning(
+        nn.initializers.he_normal(), (None, None, "embed", "mlp")
+    )
+    # depthwise kernels have a single input-channel slice per group — no
+    # meaningful tp axis; keep them unsharded
+    dw_init = nn.with_partitioning(
+        nn.initializers.he_normal(), (None, None, None, "conv_kernel")
+    )
+
+    def norm_relu(x, ch):
+        x = nn.GroupNorm(num_groups=min(config.groups, ch), dtype=dtype)(x)
+        return nn.relu(x)
+
+    class MobileNetV1(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = x.astype(dtype)
+            ch = _scaled(32, config.width_mult)
+            x = nn.Conv(ch, (3, 3), strides=(2, 2), dtype=dtype,
+                        use_bias=False,  # GroupNorm beta follows
+                        kernel_init=conv_init, name="stem")(x)
+            x = norm_relu(x, ch)
+            for i, (pw_ch, stride) in enumerate(_BLOCKS):
+                # 3x3 depthwise on the current channels (VPU work)
+                x = nn.Conv(ch, (3, 3), strides=(stride, stride),
+                            feature_group_count=ch, dtype=dtype,
+                            use_bias=False,
+                            kernel_init=dw_init, name=f"dw_{i}")(x)
+                x = norm_relu(x, ch)
+                # 1x1 pointwise to the block's channels (MXU work)
+                ch = _scaled(pw_ch, config.width_mult)
+                x = nn.Conv(ch, (1, 1), dtype=dtype, use_bias=False,
+                            kernel_init=conv_init, name=f"pw_{i}")(x)
+                x = norm_relu(x, ch)
+            x = x.mean(axis=(1, 2))  # global average pool
+            return nn.Dense(
+                config.num_classes,
+                dtype=jnp.float32,
+                kernel_init=nn.with_partitioning(
+                    nn.initializers.lecun_normal(), ("embed", "classes")
+                ),
+                name="classifier",
+            )(x)
+
+    return MobileNetV1()
+
+
+def make_loss_fn(module, config: Config):
+    from tensorflowonspark_tpu.models._common import make_classification_loss_fn
+
+    return make_classification_loss_fn(module)
+
+
+def make_forward_fn(module, config: Config):
+    from tensorflowonspark_tpu.models._common import (
+        make_classification_forward_fn,
+    )
+
+    return make_classification_forward_fn(module)
+
+
+def example_batch(config: Config, batch_size: int = 8, seed: int = 0):
+    from tensorflowonspark_tpu.models._common import image_example_batch
+
+    return image_example_batch(
+        (config.image_size, config.image_size, 3), config.num_classes,
+        batch_size=batch_size, seed=seed)
+
+
+def analytic_fwd_flops(config: Config) -> float:
+    """Forward FLOPs per image, derived from the block table (2 FLOPs per
+    MAC; norms/activations negligible).  Width 1.0 @ 224 ≈ 1.14 GFLOP —
+    the paper's 569M mult-adds."""
+    h = (config.image_size + 1) // 2  # stride-2 SAME stem
+    ch = _scaled(32, config.width_mult)
+    total = 2.0 * h * h * 9 * 3 * ch
+    for pw_ch, stride in _BLOCKS:
+        if stride == 2:
+            h = (h + 1) // 2
+        total += 2.0 * h * h * 9 * ch          # 3x3 depthwise
+        out_ch = _scaled(pw_ch, config.width_mult)
+        total += 2.0 * h * h * ch * out_ch     # 1x1 pointwise
+        ch = out_ch
+    return total + 2.0 * ch * config.num_classes

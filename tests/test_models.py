@@ -19,7 +19,7 @@ ALL_MODELS = zoo.available()
 def test_registry_lists_all():
     assert ALL_MODELS == sorted(
         ["mnist_mlp", "cifar10_cnn", "resnet50", "inception_v3",
-         "wide_deep", "bert"]
+         "mobilenet_v1", "wide_deep", "bert"]
     )
 
 
@@ -232,6 +232,36 @@ def test_bert_pp_composes_with_tp_and_fsdp():
         losses = [float(t.step(batch)) for _ in range(3)]
         assert np.isfinite(losses).all() and losses[-1] < losses[0], (mc,
                                                                       losses)
+
+
+def test_mobilenet_published_shapes_and_width_mult():
+    """MobileNetV1 at full size: 224 input runs the published stride
+    schedule down to a 7×7×1024 feature map before the pool (abstract
+    eval — no FLOPs); the width multiplier scales channels in multiples
+    of 8."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import mobilenet
+    from tensorflowonspark_tpu.parallel.train import unbox
+
+    cfg = mobilenet.Config()  # width 1.0, 224, 1000 classes
+    module = mobilenet.make_model(cfg)
+    x = jax.ShapeDtypeStruct((2, 224, 224, 3), jnp.float32)
+    var_shapes = jax.eval_shape(
+        lambda v: module.init(jax.random.PRNGKey(0), v), x)
+    params = unbox(var_shapes)["params"]
+    # last pointwise conv carries the 7x7 stage's 1024 channels
+    assert params["pw_12"]["kernel"].shape == (1, 1, 1024, 1024)
+    # depthwise kernels are one filter per channel (feature_group_count)
+    assert params["dw_12"]["kernel"].shape[-2] == 1
+    out = jax.eval_shape(
+        lambda p, v: module.apply({"params": p}, v), params, x)
+    assert out.shape == (2, 1000)
+
+    assert mobilenet._scaled(1024, 0.25) == 256
+    assert mobilenet._scaled(32, 0.25) == 8
+    assert mobilenet._scaled(64, 0.1) == 8  # floor
 
 
 def test_inception_canonical_stem_shapes():
