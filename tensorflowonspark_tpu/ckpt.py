@@ -73,7 +73,15 @@ def load_pytree(path: str, target: Any | None = None) -> Any:
             lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta_tree)
         return ckptr.restore(
             path, args=ocp.args.PyTreeRestore(restore_args=restore_args))
-    return _checkpointer().restore(path, args=ocp.args.PyTreeRestore(item=target))
+
+    # carry the TARGET's shardings into the restore: without them orbax
+    # falls back to the sharding file recorded by the WRITER, which
+    # references the writer's topology and is wrong (or fails) on any
+    # other — e.g. restarting on a differently-shaped mesh
+    restore_args = ocp.checkpoint_utils.construct_restore_args(target)
+    return _checkpointer().restore(
+        path, args=ocp.args.PyTreeRestore(item=target,
+                                          restore_args=restore_args))
 
 
 class CheckpointManager:
