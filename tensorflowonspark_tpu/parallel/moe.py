@@ -143,12 +143,12 @@ def moe_ffn(x, params: Mapping[str, Any], *, capacity_factor: float = 1.25,
 
         activation = nn.gelu
 
+    import math
+
     dtype = x.dtype
     lead = x.shape[:-1]
     m = x.shape[-1]
-    t = 1
-    for s in lead:
-        t *= s
+    t = math.prod(lead)
     g = group_count(t, group_size)
     xt = x.reshape(g, t // g, m)                                # (G, Tg, M)
     e = params["w_in"].shape[0]
