@@ -28,6 +28,10 @@ Env knobs:
 - ``TFOS_HEALTH_PROBE`` — force-enable ("1") or disable ("0") regardless of
   chip count.  Default: probe only when real chips were claimed (a CPU-only
   bootstrap has nothing to wedge, keeping healthy-path overhead at zero).
+- ``TFOS_HEALTH_PROBE_TIMEOUT_S`` — probe watchdog timeout for the
+  cluster-less serving path (``pipeline.single_node_env``); the cluster
+  bootstrap takes its timeout from the driver instead
+  (``TFCluster.run(health_probe_timeout=…)`` via cluster_meta).
 - ``TFOS_HEALTH_PROBE_HANG`` — test hook: the probe child sleeps forever,
   simulating the wedged chip (see ``tests/test_cluster.py``).
 """
@@ -153,12 +157,39 @@ class StepWatchdog:
                     os._exit(_STALL_EXIT_CODE)
 
 
+def _probe_env_override() -> bool | None:
+    """TFOS_HEALTH_PROBE parse shared by the bootstrap and serving
+    policies: None when unset, else the forced verdict."""
+    env = os.environ.get("TFOS_HEALTH_PROBE")
+    if env is None:
+        return None
+    return env not in ("0", "", "false", "no")
+
+
 def should_probe(cluster_meta: dict, chips: list) -> bool:
     """Decide whether this bootstrap should probe (see module docstring)."""
-    env = os.environ.get("TFOS_HEALTH_PROBE")
-    if env is not None:
-        return env not in ("0", "", "false", "no")
+    override = _probe_env_override()
+    if override is not None:
+        return override
     configured = cluster_meta.get("health_probe")
     if configured is not None:
         return bool(configured)
     return bool(chips)
+
+
+def should_probe_serving() -> bool:
+    """Probe policy for the cluster-less serving path
+    (``pipeline.single_node_env``): no cluster_meta and no chip claims
+    exist there, so probe only on accelerator *evidence* —
+    ``TFOS_JAX_PLATFORM`` explicitly naming a non-CPU backend, or (when
+    that is unset) the ``JAX_PLATFORMS`` env a site accelerator plugin
+    pins at interpreter start.  A plain CPU grid sets neither and pays
+    nothing, matching the bootstrap default's zero healthy-path overhead.
+    ``TFOS_HEALTH_PROBE`` overrides both ways."""
+    override = _probe_env_override()
+    if override is not None:
+        return override
+    plat = (os.environ.get("TFOS_JAX_PLATFORM")
+            or os.environ.get("JAX_PLATFORMS") or "")
+    first = plat.split(",")[0].strip().lower()
+    return bool(first) and first != "cpu"

@@ -537,16 +537,44 @@ def _is_tiny(params, lib) -> bool:
 # ---------------------------------------------------------------------------
 
 
+_SERVING_PROBED = False
+_SERVING_PROBE_ERROR: str | None = None
+
+
 def single_node_env(num_gpus: int = 0) -> None:
     """Set up a single-node accelerator environment on an executor.
 
     Reference anchor: ``pipeline.py::single_node_env`` (local TF env,
     ``CUDA_VISIBLE_DEVICES``).  Here: pin the JAX platform chosen by the
-    driver (TPU chip or CPU), nothing else — XLA owns the rest.
+    driver (TPU chip or CPU), plus — once per executor process, when the
+    platform is a real accelerator — the same watchdogged chip-health
+    probe the cluster bootstrap runs (``health.probe_chip_health``): a
+    wedged chip turns into a fast, attributed task failure instead of an
+    inference task that hangs anonymously until Spark's task timeout.
+    The probe runs once per process, but a FAILED verdict is memoized and
+    re-raised on every later call — Spark retries reuse the python worker,
+    and a retry that skipped the probe would hang on the wedged chip
+    anonymously, the exact failure this probe exists to prevent.
     """
     del num_gpus  # GPU pinning has no TPU meaning
-    from tensorflowonspark_tpu import util
+    import os
 
+    from tensorflowonspark_tpu import health, util
+
+    global _SERVING_PROBED, _SERVING_PROBE_ERROR
+    if not _SERVING_PROBED:
+        _SERVING_PROBED = True
+        if health.should_probe_serving():
+            timeout_s = float(os.environ.get(
+                "TFOS_HEALTH_PROBE_TIMEOUT_S", health.DEFAULT_TIMEOUT_S))
+            reason = health.probe_chip_health(timeout_s)
+            if reason:
+                import socket
+
+                _SERVING_PROBE_ERROR = (
+                    f"serving executor on {socket.gethostname()}: {reason}")
+    if _SERVING_PROBE_ERROR:
+        raise RuntimeError(_SERVING_PROBE_ERROR)
     util.ensure_jax_platform()
 
 

@@ -131,3 +131,41 @@ def test_get_meta_graph_def_lists_export(tmp_path):
     compat.export_saved_model(state, export_dir)
     meta = pipeline.get_meta_graph_def(export_dir)
     assert meta == {"params/w": {"shape": (3, 2), "dtype": "float32"}}
+
+
+def test_single_node_env_probes_serving_health(monkeypatch):
+    """The cluster-less serving path probes chip health once per process:
+    a wedged chip raises fast and named instead of hanging the inference
+    task anonymously (same machinery as the bootstrap probe)."""
+    import time
+
+    from tensorflowonspark_tpu import health
+
+    # default on the CPU test substrate: no probe, zero overhead
+    assert health.should_probe_serving() is False
+
+    # forced + simulated wedge: fails fast, naming the serving executor
+    monkeypatch.setenv("TFOS_HEALTH_PROBE", "1")
+    monkeypatch.setenv("TFOS_HEALTH_PROBE_HANG", "1")
+    monkeypatch.setenv("TFOS_HEALTH_PROBE_TIMEOUT_S", "3")
+    monkeypatch.setattr(pipeline, "_SERVING_PROBED", False)
+    monkeypatch.setattr(pipeline, "_SERVING_PROBE_ERROR", None)
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="serving executor on .*hung"):
+        pipeline.single_node_env()
+    assert time.monotonic() - t0 < 30
+    # the failure is memoized: a task RETRY in the same worker process must
+    # re-raise instantly, not skip the verdict and hang on the wedged chip
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="serving executor on .*hung"):
+        pipeline.single_node_env()
+    assert time.monotonic() - t0 < 1
+
+    # forced + healthy backend: passes, and later calls skip (flag set)
+    monkeypatch.delenv("TFOS_HEALTH_PROBE_HANG")
+    monkeypatch.setenv("TFOS_HEALTH_PROBE_TIMEOUT_S", "90")
+    monkeypatch.setattr(pipeline, "_SERVING_PROBED", False)
+    monkeypatch.setattr(pipeline, "_SERVING_PROBE_ERROR", None)
+    pipeline.single_node_env()
+    assert pipeline._SERVING_PROBED
+    pipeline.single_node_env()  # no re-probe, returns immediately
