@@ -27,8 +27,10 @@ Public surface mirrors the reference package:
   (weights + StableHLO forward + signature; ``python -m
   tensorflowonspark_tpu.saved_model show|run`` for inspection).
 - :mod:`tensorflowonspark_tpu.health` — slice-health check at rendezvous
-  (watchdogged device probe; a wedged chip fails bootstrap fast and
-  attributed instead of hanging the mesh).
+  plus the mid-run ``StepWatchdog`` (``Trainer(step_timeout_s=…)``): a
+  wedged chip fails fast and attributed — at bootstrap, mid-training, and
+  on the cluster-less serving path (``pipeline.single_node_env``) —
+  instead of hanging the mesh.
 """
 
 __version__ = "0.1.0"
