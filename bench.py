@@ -532,18 +532,30 @@ def probe_device(args) -> dict:
 
 
 def _probe_accelerator(deadline: "_Deadline", reserve_s: float = 0.0) -> dict:
-    """Run the liveness probe in a subprocess under a short timeout."""
+    """Run the liveness probe in a subprocess under a short timeout.
+
+    The whole attempt is spanned (``bench.probe``) so the trace artifact
+    attributes the probe window even when the run degrades — the round-5
+    bench burned its probe timeout with no record of *where* the 60 s went.
+    """
+    from tensorflowonspark_tpu import obs
+
     timeout_s = deadline.clip(_PROBE_TIMEOUT_S, reserve_s=reserve_s)
     # tests shrink _PROBE_TIMEOUT_S below _MIN_CHILD_S; only refuse to spawn
     # when the budget can't even cover the configured probe window
     if timeout_s < min(_MIN_CHILD_S, _PROBE_TIMEOUT_S):
+        obs.event("bench.probe_skipped",
+                  reason="wall budget exhausted before probe")
         return {"ok": False, "error": "wall budget exhausted before probe"}
     t0 = time.monotonic()
-    result = _run_child(["--_probe"], timeout_s)
-    if result is not None and result.get("ok"):
-        result["probe_s"] = round(time.monotonic() - t0, 1)
-        return result
-    err = (result or {}).get("_error", "no JSON from probe child")
+    with obs.span("bench.probe", timeout_s=round(timeout_s, 1)) as sp:
+        result = _run_child(["--_probe"], timeout_s)
+        if result is not None and result.get("ok"):
+            sp.set(ok=True)
+            result["probe_s"] = round(time.monotonic() - t0, 1)
+            return result
+        err = (result or {}).get("_error", "no JSON from probe child")
+        sp.set(ok=False, error=err)
     return {"ok": False, "error": err,
             "probe_s": round(time.monotonic() - t0, 1)}
 
@@ -586,6 +598,8 @@ def _bench_one(model: str, args, deadline: _Deadline, health: dict,
     to keep room for the mid-run re-probe, which would otherwise be starved
     by a first-half fallback that legitimately runs long.
     """
+    from tensorflowonspark_tpu import obs
+
     passthrough = [f"--model={model}", f"--warmup={args.warmup}"]
     if args.batch_size is not None:
         passthrough.append(f"--batch-size={args.batch_size}")
@@ -600,24 +614,32 @@ def _bench_one(model: str, args, deadline: _Deadline, health: dict,
         if timeout_s < _MIN_CHILD_S:
             primary_error = "wall budget exhausted before primary attempt"
         else:
-            result = _run_child(passthrough, timeout_s)
-            if result is not None and "_error" not in result:
-                return result
-            primary_error = (result or {}).get("_error", "no JSON from child")
+            with obs.span("bench.primary", model=model) as sp:
+                result = _run_child(passthrough, timeout_s)
+                if result is not None and "_error" not in result:
+                    sp.set(ok=True)
+                    return result
+                primary_error = (result or {}).get("_error",
+                                                   "no JSON from child")
+                sp.set(ok=False, error=primary_error)
             if "timeout" in primary_error:
                 # a hung (not merely failed) primary after a green probe:
                 # don't let the next model hang too
                 health["ok"] = False
                 health["why"] = (f"primary attempt for {model} hung: "
                                  f"{primary_error}")
+    else:
+        obs.event("bench.primary_skipped", model=model, why=primary_error)
     print(f"bench: {model} primary attempt skipped/failed ({primary_error}); "
           "using forced-CPU backend", file=sys.stderr)
     fb_timeout = deadline.clip(_FALLBACK_TIMEOUT_S,
                                reserve_s=(fallbacks_owed - 1)
                                * _FALLBACK_RESERVE_S + reserve_extra_s)
-    fallback = (_run_child(passthrough + ["--_force-cpu"], fb_timeout)
-                if fb_timeout >= _MIN_CHILD_S
-                else {"_error": "wall budget exhausted before fallback"})
+    with obs.span("bench.fallback", model=model) as sp:
+        fallback = (_run_child(passthrough + ["--_force-cpu"], fb_timeout)
+                    if fb_timeout >= _MIN_CHILD_S
+                    else {"_error": "wall budget exhausted before fallback"})
+        sp.set(ok=fallback is not None and "_error" not in fallback)
     if fallback is not None and "_error" not in fallback:
         fallback["degraded"] = f"accelerator unavailable: {primary_error}"
         return fallback
@@ -632,6 +654,30 @@ def _bench_one(model: str, args, deadline: _Deadline, health: dict,
         "error": primary_error,
         "fallback_error": (fallback or {}).get("_error", "no JSON from child"),
     }
+
+
+def _write_trace_artifact(result: dict) -> None:
+    """Write the driver-side Chrome-trace artifact and stamp its path.
+
+    Runs on EVERY driver exit path — including degraded/probe-failure
+    runs, where the ``bench.probe`` span shows exactly which phase
+    consumed the probe timeout (the attribution the round-5 fully-degraded
+    artifact lacked).  Best-effort: the bench JSON line must come out even
+    if the trace cannot be written.  Path: ``TFOS_BENCH_TRACE_PATH`` or
+    ``BENCH_trace.json`` next to this file; validate with
+    ``python tools/check_trace.py <path>``.
+    """
+    path = os.environ.get("TFOS_BENCH_TRACE_PATH") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_trace.json")
+    try:
+        from tensorflowonspark_tpu import obs
+
+        tracer = obs.get_tracer()
+        obs.chrome.write(path, {tracer.node: tracer.snapshot()})
+        result["trace_artifact"] = path
+    except Exception as e:  # fail-soft by design (see module docstring)
+        print(f"bench: could not write trace artifact ({e!r})",
+              file=sys.stderr)
 
 
 def main() -> None:
@@ -658,6 +704,9 @@ def main() -> None:
         return
 
     _setup_hang_counter()
+    from tensorflowonspark_tpu import obs
+
+    obs.configure(node="bench")
     deadline = _Deadline(_WALL_BUDGET_S)
     probe = _probe_accelerator(deadline)
     probe_failed_at_start = not probe.get("ok")
@@ -673,35 +722,43 @@ def main() -> None:
             passthrough.append(f"--batch-size={args.batch_size}")
         result = None
         primary_error = health["why"]
-        if health["ok"]:
-            timeout_s = deadline.clip(_PRIMARY_TIMEOUT_S,
-                                      reserve_s=_FALLBACK_RESERVE_S)
-            result = (_run_child(passthrough, timeout_s)
-                      if timeout_s >= _MIN_CHILD_S else
-                      {"_error": "wall budget exhausted"})
-            primary_error = (result or {}).get("_error",
-                                               "no JSON from child")
-        if result is None or "_error" in result:
-            fb_timeout = deadline.clip(_FALLBACK_TIMEOUT_S)
-            result = (_run_child(passthrough + ["--_force-cpu"], fb_timeout)
-                      if fb_timeout >= _MIN_CHILD_S
-                      else {"_error": "wall budget exhausted before fallback"})
-            if result is not None and "_error" not in result:
-                result["degraded"] = f"accelerator unavailable: {primary_error}"
-            else:
-                result = {  # same structured stub shape as _bench_one
-                    "metric": "feed_compute_overlap_efficiency",
-                    "value": 0.0, "unit": "fraction", "vs_baseline": 0.0,
-                    "degraded": f"accelerator unavailable: {primary_error}",
-                    "error": primary_error,
-                    "fallback_error": (result or {}).get(
-                        "_error", "no JSON from child"),
-                }
+        with obs.span("bench.feed"):
+            if health["ok"]:
+                timeout_s = deadline.clip(_PRIMARY_TIMEOUT_S,
+                                          reserve_s=_FALLBACK_RESERVE_S)
+                result = (_run_child(passthrough, timeout_s)
+                          if timeout_s >= _MIN_CHILD_S else
+                          {"_error": "wall budget exhausted"})
+                primary_error = (result or {}).get("_error",
+                                                   "no JSON from child")
+            if result is None or "_error" in result:
+                fb_timeout = deadline.clip(_FALLBACK_TIMEOUT_S)
+                result = (_run_child(passthrough + ["--_force-cpu"],
+                                     fb_timeout)
+                          if fb_timeout >= _MIN_CHILD_S
+                          else {"_error":
+                                "wall budget exhausted before fallback"})
+                if result is not None and "_error" not in result:
+                    result["degraded"] = (
+                        f"accelerator unavailable: {primary_error}")
+                else:
+                    result = {  # same structured stub shape as _bench_one
+                        "metric": "feed_compute_overlap_efficiency",
+                        "value": 0.0, "unit": "fraction", "vs_baseline": 0.0,
+                        "degraded": f"accelerator unavailable: "
+                                    f"{primary_error}",
+                        "error": primary_error,
+                        "fallback_error": (result or {}).get(
+                            "_error", "no JSON from child"),
+                    }
+        _write_trace_artifact(result)
         print(json.dumps(result))
         return
 
     if args.model is not None:
-        print(json.dumps(_bench_one(args.model, args, deadline, health)))
+        result = _bench_one(args.model, args, deadline, health)
+        _write_trace_artifact(result)
+        print(json.dumps(result))
         return
 
     # Headline run (driver invokes with no args): BOTH halves of
@@ -727,6 +784,7 @@ def main() -> None:
     result["secondary"] = _bench_one("wide_deep", args, deadline, health)
     if not probe.get("ok"):
         result["probe"] = probe
+    _write_trace_artifact(result)
     print(json.dumps(result))
 
 

@@ -27,7 +27,7 @@ import uuid
 from enum import Enum
 from typing import Any, Callable
 
-from tensorflowonspark_tpu import TFSparkNode, reservation
+from tensorflowonspark_tpu import TFSparkNode, obs, reservation
 
 logger = logging.getLogger(__name__)
 
@@ -76,13 +76,16 @@ class TFCluster:
         self._check_bootstrap_error()
         poller = self._start_metrics_poller(metrics_interval)
         try:
-            for epoch in range(num_epochs):
-                logger.info("feeding epoch %d/%d", epoch + 1, num_epochs)
-                dataRDD.foreachPartition(
-                    TFSparkNode.train(self.cluster_info, self.cluster_meta,
-                                      feed_timeout, qname)
-                )
-                self._check_bootstrap_error()
+            with obs.span("cluster.train", epochs=num_epochs):
+                for epoch in range(num_epochs):
+                    logger.info("feeding epoch %d/%d", epoch + 1, num_epochs)
+                    with obs.span("cluster.feed_epoch", epoch=epoch + 1):
+                        dataRDD.foreachPartition(
+                            TFSparkNode.train(self.cluster_info,
+                                              self.cluster_meta,
+                                              feed_timeout, qname)
+                        )
+                    self._check_bootstrap_error()
         finally:
             if poller is not None:
                 poller()
@@ -172,18 +175,20 @@ class TFCluster:
         if ssc is not None:
             self._drain_and_stop_streaming(ssc, timeout, qname)
         try:
-            if self.input_mode is InputMode.SPARK:
-                n = self.num_executors
-                self.sc.parallelize(range(n), n).foreachPartition(
-                    TFSparkNode.shutdown(self.cluster_info, self.cluster_meta,
-                                         grace_secs, qname)
-                )
-            self._thread.join(timeout=timeout)
-            if self._thread.is_alive():
-                raise RuntimeError(
-                    f"cluster bootstrap job still running after {timeout}s"
-                )
-            self._check_bootstrap_error()
+            with obs.span("cluster.shutdown", grace_secs=grace_secs):
+                if self.input_mode is InputMode.SPARK:
+                    n = self.num_executors
+                    self.sc.parallelize(range(n), n).foreachPartition(
+                        TFSparkNode.shutdown(self.cluster_info,
+                                             self.cluster_meta,
+                                             grace_secs, qname)
+                    )
+                self._thread.join(timeout=timeout)
+                if self._thread.is_alive():
+                    raise RuntimeError(
+                        f"cluster bootstrap job still running after {timeout}s"
+                    )
+                self._check_bootstrap_error()
         finally:
             self.server.stop()
 
@@ -248,6 +253,81 @@ class TFCluster:
                 per_node[name] = {**self._last_node_metrics[name],
                                   "stale": True}
         return metrics_lib.aggregate(per_node)
+
+    def metrics_prometheus(self, key: str = "metrics") -> str:
+        """Prometheus text exposition of the cluster's merged metrics.
+
+        One scrape-able document: per-node step metrics (``node``-labelled
+        gauges), the cluster rollup, and the merged obs registry
+        (counters/histograms summed across nodes, registry gauges kept
+        per node).  Serve it from any HTTP handler — the framework stays
+        transport-agnostic, matching the reference's "bring your own
+        serving" posture.
+        """
+        from tensorflowonspark_tpu.obs import registry as reg
+
+        agg = self.metrics(key)
+        parts: list[str] = []
+        # per-node step gauges go through the merged-shape emitter so each
+        # metric family gets ONE "# TYPE" line with all node-labelled
+        # samples grouped under it — a second TYPE line for the same name
+        # is a text-exposition-format violation scrapers reject
+        node_gauges: dict[str, dict[str, Any]] = {}
+        for node, snap in sorted((agg.get("nodes") or {}).items()):
+            for k in ("step", "loss", "examples_per_sec", "total_examples"):
+                if isinstance(snap.get(k), (int, float)):
+                    node_gauges.setdefault(f"node_{k}", {})[node] = snap[k]
+        if node_gauges:
+            parts.append(reg.merged_to_prometheus({"gauges": node_gauges}))
+        rollup = {
+            f"cluster_{k}": agg[k]
+            for k in ("num_reporting", "total_examples_per_sec", "mean_loss")
+            if isinstance(agg.get(k), (int, float))
+        }
+        if rollup:
+            parts.append(reg.snapshot_to_prometheus({"gauges": rollup}))
+        merged = agg.get("registry")
+        if merged:
+            parts.append(reg.merged_to_prometheus(merged))
+        return "".join(parts)
+
+    def dump_trace(self, path: str) -> str:
+        """Merge driver + every node's trace events into one
+        Chrome-trace-format file at ``path``; returns ``path``.
+
+        Each node process (bootstrap task and spawned trainer) ships its
+        event ring buffer to its own ``trace:<node>:<pid>`` key on the
+        node's kv blackboard (:mod:`tensorflowonspark_tpu.obs`); this
+        collects them all, adds the driver's own buffer, and writes the
+        merged timeline (``obs.chrome``) — open it in ``chrome://tracing``
+        / Perfetto to see exactly where cluster time went (the view the
+        round-5 degraded bench lacked).  Unreachable nodes are skipped
+        with a warning, so a post-mortem dump after a crash still writes
+        whatever shipped before the death.
+
+        The driver's own buffer is process-lifetime (a driver that runs
+        several clusters sees all its spans on one timeline — that is the
+        point of a trace); executor-side buffers are cleared when a reused
+        worker bootstraps a new cluster, so node tracks never mix runs.
+        """
+        from tensorflowonspark_tpu import TFManager
+
+        tracer = obs.get_tracer()
+        by_node: dict[str, list[dict]] = {tracer.node: tracer.snapshot()}
+        authkey = bytes.fromhex(self.cluster_meta["authkey_hex"])
+        for meta in self.cluster_info:
+            name = f"{meta['job_name']}:{meta['task_index']}"
+            try:
+                mgr = TFManager.connect(tuple(meta["addr"]), authkey)
+                shipped = obs.collect_blackboard(mgr.kv_snapshot())
+            except Exception as e:
+                logger.warning("dump_trace: node %s unreachable: %s", name, e)
+                continue
+            for node, events in shipped.items():
+                by_node.setdefault(node, []).extend(events)
+        logger.info("dump_trace: %d nodes, %d events → %s", len(by_node),
+                    sum(len(v) for v in by_node.values()), path)
+        return obs.chrome.write(path, by_node)
 
     def tensorboard_url(self, timeout: float = 0.0) -> str | None:
         """URL of the cluster's TensorBoard, if one was started.
@@ -424,26 +504,31 @@ def run(
     import time as _time
 
     deadline = _time.monotonic() + reservation_timeout
-    while True:
-        sick = server.kv_get("health_error")
-        if sick:
-            server.stop()
-            raise RuntimeError(f"node failed chip health probe: {sick}")
-        if thread_error:
-            server.stop()
-            raise RuntimeError("cluster bootstrap failed") from thread_error[0]
-        remaining = deadline - _time.monotonic()
-        if remaining <= 0:
-            server.stop()
-            raise TimeoutError(
-                f"timed out after {reservation_timeout}s waiting for "
-                f"{server.reservations.remaining()} of {num_executors} nodes"
-            )
-        try:
-            cluster_info = server.await_reservations(timeout=min(1.0, remaining))
-            break
-        except TimeoutError:
-            continue
+    with obs.span("cluster.reserve", num_executors=num_executors,
+                  cluster_id=cluster_meta["id"]):
+        while True:
+            sick = server.kv_get("health_error")
+            if sick:
+                server.stop()
+                raise RuntimeError(f"node failed chip health probe: {sick}")
+            if thread_error:
+                server.stop()
+                raise RuntimeError(
+                    "cluster bootstrap failed") from thread_error[0]
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                server.stop()
+                raise TimeoutError(
+                    f"timed out after {reservation_timeout}s waiting for "
+                    f"{server.reservations.remaining()} of {num_executors} "
+                    "nodes"
+                )
+            try:
+                cluster_info = server.await_reservations(
+                    timeout=min(1.0, remaining))
+                break
+            except TimeoutError:
+                continue
     logger.info("cluster formed: %d nodes", len(cluster_info))
 
     cluster = TFCluster(sc, cluster_meta, cluster_info, server, input_mode, t)

@@ -197,6 +197,17 @@ class TFManager:
         """kv write. Reference anchor: ``TFManager.py::_set``."""
         self._kv().update({key: value})
 
+    def kv_snapshot(self) -> dict[str, Any]:
+        """Full copy of the kv blackboard in one round-trip.
+
+        Used by the driver's trace collection (``TFCluster.dump_trace``),
+        which must *enumerate* the per-process ``trace:<node>:<pid>`` keys
+        each node's processes published — ``get`` alone cannot.  ``copy()``
+        (not ``keys()``/``items()``) because a dict is picklable across the
+        proxy while dict views are not.
+        """
+        return dict(self._kv().copy())
+
     def del_queue(self, qname: str) -> None:
         """Remove a dynamically-created queue from the server."""
         self._manager.del_queue(qname)

@@ -33,7 +33,7 @@ import time
 from typing import Any, Callable, Iterator
 
 from tensorflowonspark_tpu import (TFManager, chip_info, health, marker,
-                                   reservation, util)
+                                   obs, reservation, util)
 
 logger = logging.getLogger(__name__)
 
@@ -164,25 +164,33 @@ def _raise_worker_error(mgr) -> None:
     raise RuntimeError(f"exception in worker map_fun:\n{err}")
 
 
-def _background_main(fn_blob: bytes, args_blob: bytes, ctx: TFNodeContext) -> None:
-    """Entry point of the spawned trainer process (SPARK input mode)."""
+def _run_map_fun(fn_blob: bytes, args_blob: bytes, ctx: TFNodeContext,
+                 mgr) -> None:
+    """Instrumented run of the user's ``map_fun`` — the ONE copy of the
+    span/flush/state choreography shared by both input modes (the spawned
+    SPARK-mode trainer and the inline TENSORFLOW-mode bootstrap task).
+
+    Invariants encoded here: the multi-host JAX runtime forms BEFORE user
+    code runs (reference: TF_CONFIG was exported by the node runtime, not
+    by ``map_fun`` — a ``map_fun`` that forgets the call must not silently
+    train per-host islands; no-op on single-node clusters); the trace
+    flush happens BEFORE the "finished" state is visible, because shutdown
+    (and a driver ``dump_trace`` right after it) keys on that state and
+    the ``map_fun`` span must already be on the blackboard by then; a
+    failure lands on the error queue + "failed" state before re-raising.
+    """
     import cloudpickle
 
-    util.ensure_jax_platform()
-    mgr = ctx.mgr
-    mgr.set("trainer_pid", os.getpid())
-    mgr.set("state", "running")
     try:
-        # Form the multi-host JAX runtime BEFORE user code runs (reference:
-        # TF_CONFIG was exported by the node runtime, not by map_fun) — a
-        # map_fun that forgets the call must not silently train per-host
-        # islands.  No-op on single-node clusters / chip-less "auto" mode.
         from tensorflowonspark_tpu.parallel import distributed
 
-        distributed.maybe_initialize(ctx)
+        with obs.span("node.distributed_init"):
+            distributed.maybe_initialize(ctx)
         fn = cloudpickle.loads(fn_blob)
         tf_args = cloudpickle.loads(args_blob)
-        fn(tf_args, ctx)
+        with obs.span("node.map_fun", executor_id=ctx.executor_id):
+            fn(tf_args, ctx)
+        obs.flush(mgr)  # before "finished" becomes visible
         mgr.set("state", "finished")
     except BaseException:
         import traceback
@@ -195,6 +203,20 @@ def _background_main(fn_blob: bytes, args_blob: bytes, ctx: TFNodeContext) -> No
         except Exception:
             pass
         raise
+    finally:
+        obs.flush(mgr)
+
+
+def _background_main(fn_blob: bytes, args_blob: bytes, ctx: TFNodeContext) -> None:
+    """Entry point of the spawned trainer process (SPARK input mode)."""
+    util.ensure_jax_platform()
+    mgr = ctx.mgr
+    mgr.set("trainer_pid", os.getpid())
+    mgr.set("state", "running")
+    # the spawned trainer is a fresh process: give its tracer the node
+    # identity and the blackboard so its spans ship to the driver
+    obs.configure(node=f"{ctx.job_name}:{ctx.task_index}", mgr=mgr)
+    _run_map_fun(fn_blob, args_blob, ctx, mgr)
 
 
 class _MapFn:
@@ -219,6 +241,12 @@ class _MapFn:
                                "per partition (sc.parallelize(range(n), n))")
         executor_id = int(part[0])
 
+        # a reused python worker may have bootstrapped an EARLIER cluster:
+        # that run's events were already shipped to its own blackboard, so
+        # drop them now — flush publishes the full buffer, and stale spans
+        # with old timestamps would corrupt this cluster's trace timeline
+        obs.get_tracer().clear()
+
         # collision guard (reference: util.write_executor_id + cross-check)
         existing = util.read_executor_id(name=_guard_name(cluster_id))
         if existing is not None:
@@ -232,16 +260,19 @@ class _MapFn:
         # CUDA_VISIBLE_DEVICES)
         chips = []
         if meta.get("num_chips", 0) > 0:
-            chips = chip_info.claim_chips(
-                meta["num_chips"], cluster_id, f"executor_{executor_id}"
-            )
-            chip_info.set_visibility_env(chips)
+            with obs.span("node.chip_claim", executor_id=executor_id,
+                          num_chips=meta["num_chips"]):
+                chips = chip_info.claim_chips(
+                    meta["num_chips"], cluster_id, f"executor_{executor_id}"
+                )
+                chip_info.set_visibility_env(chips)
 
         # data-plane manager: loopback for SPARK mode, routable for
         # TENSORFLOW mode (reference: TFManager.start local/remote)
         mode = "local" if meta["input_mode"] == "spark" else "remote"
         authkey = bytes.fromhex(meta["authkey_hex"])
-        mgr = TFManager.start(authkey, meta["queues"], mode=mode)
+        with obs.span("node.manager_start", executor_id=executor_id):
+            mgr = TFManager.start(authkey, meta["queues"], mode=mode)
         _MGRS[cluster_id] = mgr  # keep the server alive past this task
         mgr.set("state", "bootstrapping")
 
@@ -249,6 +280,10 @@ class _MapFn:
         job_name, task_index = meta["cluster_template"].get(
             executor_id, ("worker", executor_id)
         )
+        # the bootstrap process's events ship through this node's own
+        # blackboard once the identity is known; everything recorded before
+        # this (chip claim, manager start) rides along in the same buffer
+        obs.configure(node=f"{job_name}:{task_index}", mgr=mgr)
         node_meta = {
             "executor_id": executor_id,
             "host": host,
@@ -281,16 +316,19 @@ class _MapFn:
                     mgr.get_queue("error").put(msg)
                 except Exception:
                     pass
+                obs.flush(mgr)  # ship the failed-probe span before dying
                 raise RuntimeError(msg)
 
         # executor 0 publishes the jax.distributed coordinator address before
         # registering, so every node can read it after the barrier
         if executor_id == 0:
             client.put("jax_coordinator", f"{host}:{port}")
-        client.register(node_meta)
-        cluster_info = client.await_reservations(
-            timeout=meta.get("reservation_timeout", 600.0)
-        )
+        with obs.span("node.register_await", executor_id=executor_id,
+                      job=f"{job_name}:{task_index}"):
+            client.register(node_meta)
+            cluster_info = client.await_reservations(
+                timeout=meta.get("reservation_timeout", 600.0)
+            )
 
         cluster_spec: dict[str, list[str]] = {}
         for m in cluster_info:
@@ -334,27 +372,15 @@ class _MapFn:
             logger.info(
                 "executor %s: trainer started in background pid %s", executor_id, p.pid
             )
+            obs.event("node.trainer_spawned", executor_id=executor_id,
+                      trainer_pid=p.pid)
+            obs.flush(mgr)  # bootstrap spans ship before this task returns
             # bootstrap task returns; the executor is free for feed tasks
         else:
-            import cloudpickle
-
             util.ensure_jax_platform()
             mgr.set("state", "running")
             mgr.set("trainer_pid", os.getpid())
-            fn = cloudpickle.loads(self.fn_blob)
-            tf_args = cloudpickle.loads(self.args_blob)
-            try:
-                from tensorflowonspark_tpu.parallel import distributed
-
-                distributed.maybe_initialize(ctx)
-                fn(tf_args, ctx)
-                mgr.set("state", "finished")
-            except BaseException:
-                import traceback
-
-                mgr.get_queue("error").put(traceback.format_exc())
-                mgr.set("state", "failed")
-                raise
+            _run_map_fun(self.fn_blob, self.args_blob, ctx, mgr)
 
     def _start_tensorboard(self, client, ctx) -> None:
         """Profiler endpoint + TensorBoard (when the binary exists).

@@ -31,6 +31,11 @@ Public surface mirrors the reference package:
   wedged chip fails fast and attributed — at bootstrap, mid-training, and
   on the cluster-less serving path (``pipeline.single_node_env``) —
   instead of hanging the mesh.
+- :mod:`tensorflowonspark_tpu.obs` — observability subsystem: lifecycle
+  span tracing (shipped executor→driver over the kv blackboard,
+  ``TFCluster.dump_trace`` merges to one Chrome-trace file) and a
+  counters/gauges/histograms registry with Prometheus exposition
+  (``TFCluster.metrics_prometheus``).
 """
 
 __version__ = "0.1.0"

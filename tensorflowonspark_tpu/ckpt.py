@@ -41,10 +41,13 @@ def _canonical(path: str) -> str:
 
 def save_pytree(state: Any, path: str) -> str:
     """Save a pytree (params/opt-state/step, arbitrary nesting) to ``path``."""
+    from tensorflowonspark_tpu import obs
+
     path = _canonical(path)
     if "://" not in path:
         os.makedirs(os.path.dirname(path), exist_ok=True)
-    _checkpointer().save(path, state, force=True)
+    with obs.span("ckpt.save", path=path):
+        _checkpointer().save(path, state, force=True)
     logger.info("saved checkpoint to %s", path)
     return path
 
@@ -62,26 +65,29 @@ def load_pytree(path: str, target: Any | None = None) -> Any:
     """
     import orbax.checkpoint as ocp
 
+    from tensorflowonspark_tpu import obs
+
     path = _canonical(path)
-    if target is None:
-        import jax
-        import numpy as np
+    with obs.span("ckpt.restore", path=path, targeted=target is not None):
+        if target is None:
+            import jax
+            import numpy as np
 
-        ckptr = _checkpointer()
-        meta_tree = ckptr.metadata(path).item_metadata.tree
-        restore_args = jax.tree.map(
-            lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta_tree)
-        return ckptr.restore(
-            path, args=ocp.args.PyTreeRestore(restore_args=restore_args))
+            ckptr = _checkpointer()
+            meta_tree = ckptr.metadata(path).item_metadata.tree
+            restore_args = jax.tree.map(
+                lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta_tree)
+            return ckptr.restore(
+                path, args=ocp.args.PyTreeRestore(restore_args=restore_args))
 
-    # carry the TARGET's shardings into the restore: without them orbax
-    # falls back to the sharding file recorded by the WRITER, which
-    # references the writer's topology and is wrong (or fails) on any
-    # other — e.g. restarting on a differently-shaped mesh
-    restore_args = ocp.checkpoint_utils.construct_restore_args(target)
-    return _checkpointer().restore(
-        path, args=ocp.args.PyTreeRestore(item=target,
-                                          restore_args=restore_args))
+        # carry the TARGET's shardings into the restore: without them orbax
+        # falls back to the sharding file recorded by the WRITER, which
+        # references the writer's topology and is wrong (or fails) on any
+        # other — e.g. restarting on a differently-shaped mesh
+        restore_args = ocp.checkpoint_utils.construct_restore_args(target)
+        return _checkpointer().restore(
+            path, args=ocp.args.PyTreeRestore(item=target,
+                                              restore_args=restore_args))
 
 
 class CheckpointManager:
@@ -105,7 +111,10 @@ class CheckpointManager:
     def save(self, step: int, state: Any) -> None:
         import orbax.checkpoint as ocp
 
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        from tensorflowonspark_tpu import obs
+
+        with obs.span("ckpt.save", path=self._directory, step=step):
+            self._mgr.save(step, args=ocp.args.StandardSave(state))
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()

@@ -68,21 +68,34 @@ def probe_chip_health(timeout_s: float = DEFAULT_TIMEOUT_S) -> str | None:
     Uses the *spawn* context (fork would clone any JAX threads the executor
     holds) and SIGKILLs the child on timeout — a wedged device op ignores
     gentler signals.
+
+    The whole probe runs under an ``obs`` span (``health.probe``) carrying
+    the verdict and the timeout, so a degraded run's trace shows exactly
+    which phase consumed the probe window (the round-5 bench ran fully
+    degraded with no such attribution).
     """
     import multiprocessing
+
+    from tensorflowonspark_tpu import obs
 
     ctx = multiprocessing.get_context("spawn")
     p = ctx.Process(target=_probe_child, name="tfos-health-probe", daemon=True)
     t0 = time.monotonic()
-    p.start()
-    p.join(timeout_s)
-    if p.is_alive():
-        p.kill()
-        p.join(5.0)
-        return (f"device health probe hung for {timeout_s}s "
-                "(chip/slice wedged?)")
-    if p.exitcode != 0:
-        return f"device health probe crashed (exit code {p.exitcode})"
+    with obs.span("health.probe", timeout_s=timeout_s) as sp:
+        p.start()
+        p.join(timeout_s)
+        if p.is_alive():
+            p.kill()
+            p.join(5.0)
+            reason = (f"device health probe hung for {timeout_s}s "
+                      "(chip/slice wedged?)")
+            sp.set(ok=False, reason=reason)
+            return reason
+        if p.exitcode != 0:
+            reason = f"device health probe crashed (exit code {p.exitcode})"
+            sp.set(ok=False, reason=reason)
+            return reason
+        sp.set(ok=True)
     logger.info("chip health probe passed in %.1fs", time.monotonic() - t0)
     return None
 
@@ -149,6 +162,14 @@ class StepWatchdog:
                       f"(> step_timeout_s={self.timeout_s:.0f}) — "
                       "chip/slice wedged mid-run?")
             logger.critical("%s", reason)
+            try:
+                from tensorflowonspark_tpu import obs
+
+                obs.event("health.step_stall", reason=reason,
+                          stalled_s=round(stalled, 1))
+                obs.flush()  # last chance before the hard exit below
+            except Exception:
+                pass
             try:
                 if self._on_stall is not None:
                     self._on_stall(reason)

@@ -77,11 +77,16 @@ class MetricsReporter:
     """
 
     def __init__(self, ctx=None, interval: int = 10, window: int = 50,
-                 key: str = "metrics", mgr=None):
+                 key: str = "metrics", mgr=None, registry=None):
         self._mgr = mgr if mgr is not None else (ctx.mgr if ctx else None)
         self.interval = max(1, interval)
         self.key = key
         self.stats = StepMetrics(window=window)
+        #: obs registry whose snapshot rides along with each publication
+        #: (None → the process-default registry; pass a fresh
+        #: ``obs.Registry()`` to isolate).  The driver's
+        #: ``TFCluster.metrics()`` merges the per-node snapshots.
+        self._registry = registry
 
     def __call__(self, loss: Any, examples: int, dt: float) -> None:
         self.stats.record(loss, examples, dt)
@@ -90,11 +95,26 @@ class MetricsReporter:
 
     def publish(self) -> dict[str, Any]:
         snap = self.stats.snapshot()
+        reg = self._registry
+        if reg is None:
+            from tensorflowonspark_tpu import obs
+
+            reg = obs.get_registry()
+        if len(reg):
+            snap["registry"] = reg.snapshot()
         if self._mgr is not None:
             try:
                 self._mgr.set(self.key, snap)
             except Exception as e:  # metrics must never kill training
                 logger.warning("metrics publish failed: %s", e)
+            # piggyback a trace flush on the same cadence: the trainer's
+            # spans reach the blackboard while it runs, not only at exit
+            try:
+                from tensorflowonspark_tpu import obs
+
+                obs.get_tracer().flush(self._mgr)
+            except Exception:
+                pass
         return snap
 
 
@@ -107,6 +127,12 @@ def aggregate(node_metrics: dict[str, dict[str, Any]]) -> dict[str, Any]:
     (finished/unreachable, last snapshot retained by ``TFCluster.metrics``)
     keep contributing to the loss but are excluded from the live
     ``total_examples_per_sec`` sum.
+
+    Node snapshots may carry an obs-registry section (``"registry"``,
+    published by :class:`MetricsReporter` when the node recorded any
+    counters/gauges/histograms); those merge cluster-wide into the
+    rollup's ``"registry"`` key (``obs.merge_snapshots`` semantics:
+    counters and histograms sum, gauges stay per-node).
     """
     totals = [m.get("examples_per_sec") for m in node_metrics.values()
               if m and m.get("examples_per_sec") and not m.get("stale")]
@@ -121,9 +147,16 @@ def aggregate(node_metrics: dict[str, dict[str, Any]]) -> dict[str, Any]:
         else:
             mean_loss = sum(l for l, _ in weighted) / len(weighted)
         mean_loss = round(mean_loss, 6)
-    return {
+    out = {
         "nodes": node_metrics,
         "num_reporting": len(node_metrics),
         "total_examples_per_sec": round(sum(totals), 2) if totals else None,
         "mean_loss": mean_loss,
     }
+    registries = {name: m["registry"] for name, m in node_metrics.items()
+                  if m and isinstance(m.get("registry"), dict)}
+    if registries:
+        from tensorflowonspark_tpu import obs
+
+        out["registry"] = obs.merge_snapshots(registries)
+    return out

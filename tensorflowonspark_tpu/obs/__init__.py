@@ -1,0 +1,61 @@
+"""Observability subsystem: tracing + structured event log + metrics export.
+
+Three layers over ONE event model (ISSUE 1 tentpole; SURVEY.md §5 notes the
+reference had "Python logging ... no metrics registry"):
+
+- **tracing** (:mod:`.trace`) — ``obs.span("reserve")`` context-manager /
+  decorator spans and ``obs.event(...)`` instants, recorded into a bounded
+  per-process ring buffer and shipped executor→driver over the TFManager
+  kv blackboard;
+- **structured event log / Chrome trace** (:mod:`.chrome`) —
+  ``TFCluster.dump_trace(path)`` merges every node's events into one
+  Chrome-trace-format file (deterministic; schema-checked by
+  ``tools/check_trace.py``);
+- **metrics export** (:mod:`.registry`) — counters / gauges / histograms
+  with Prometheus text exposition and a JSON snapshot, published with the
+  step metrics and aggregated by ``TFCluster.metrics()`` /
+  ``TFCluster.metrics_prometheus()``.
+
+Instrumented out of the box: cluster lifecycle (``TFCluster`` /
+``TFSparkNode`` bootstrap, reserve, probe, shutdown), the trainer
+(``trainer.Trainer`` init + step counters, optional ``jax.profiler`` step
+annotations via ``TFOS_PROFILE_STEPS=1``), the data feed
+(``TFNode.DataFeed`` / ``readers``), checkpointing (``ckpt``), health
+probes (``health``), serving (``pipeline``), and ``bench.py`` (which
+writes a trace artifact even for degraded runs, attributing the probe
+timeout).  ``TFOS_TRACE=0`` disables recording.
+"""
+
+from tensorflowonspark_tpu.obs import chrome  # noqa: F401
+from tensorflowonspark_tpu.obs.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    counter,
+    gauge,
+    get_registry,
+    histogram,
+    merge_snapshots,
+    merged_to_prometheus,
+    snapshot_to_prometheus,
+)
+from tensorflowonspark_tpu.obs.trace import (  # noqa: F401
+    TRACE_KV_PREFIX,
+    Tracer,
+    collect_blackboard,
+    configure,
+    event,
+    flush,
+    get_tracer,
+    span,
+)
+
+__all__ = [
+    "chrome",
+    "Counter", "Gauge", "Histogram", "Registry",
+    "counter", "gauge", "histogram", "get_registry",
+    "merge_snapshots", "merged_to_prometheus", "snapshot_to_prometheus",
+    "TRACE_KV_PREFIX", "Tracer", "collect_blackboard", "configure",
+    "event", "flush", "get_tracer", "span",
+]

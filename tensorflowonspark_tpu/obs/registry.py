@@ -1,0 +1,295 @@
+"""Metrics registry: counters, gauges, histograms → Prometheus / JSON.
+
+Extends the round-2 step-metrics hook (``metrics.StepMetrics`` /
+``MetricsReporter``) into a small general registry (the reference has none —
+SURVEY.md §5).  Same delivery path as the step metrics: instruments record
+locally (lock-protected, allocation-free on the hot path), the per-node
+snapshot rides the kv blackboard inside the ``MetricsReporter`` publication,
+and the driver's generalized ``TFCluster.metrics()`` merges node snapshots
+(:func:`merge_snapshots`).  Two export formats:
+
+- :meth:`Registry.snapshot` — a plain JSON-able dict;
+- :meth:`Registry.to_prometheus` — Prometheus text exposition (v0.0.4),
+  driver-side ``TFCluster.metrics_prometheus()`` exposes the merged view
+  with a ``node`` label per series.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Iterable
+
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                    60.0, float("inf"))
+
+
+class Counter:
+    """Monotonically increasing value."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Settable value (last write wins; inc/dec for up-down counting)."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value -= n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics)."""
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Iterable[float] = _DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help
+        bounds = sorted(float(b) for b in buckets)
+        if not bounds or bounds[-1] != float("inf"):
+            bounds.append(float("inf"))
+        self.bounds = tuple(bounds)
+        self._counts = [0] * len(self.bounds)
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.sum += v
+            self.count += 1
+            for i, b in enumerate(self.bounds):
+                if v <= b:
+                    self._counts[i] += 1
+                    break
+
+    def cumulative(self) -> list[tuple[float, int]]:
+        """``[(le, cumulative_count), ...]`` — Prometheus bucket shape."""
+        with self._lock:
+            counts = list(self._counts)
+        out, running = [], 0
+        for b, c in zip(self.bounds, counts):
+            running += c
+            out.append((b, running))
+        return out
+
+    def export(self) -> dict[str, Any]:
+        """Atomic ``{"buckets", "sum", "count"}`` export: buckets, sum and
+        count are read under ONE lock acquisition so a concurrent
+        ``observe`` cannot tear the snapshot (count must equal the +Inf
+        bucket — the Prometheus histogram invariant scrape consumers
+        rely on)."""
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self.count, self.sum
+        buckets, running = [], 0
+        for b, c in zip(self.bounds, counts):
+            running += c
+            buckets.append(["+Inf" if b == float("inf") else b, running])
+        return {"buckets": buckets, "sum": s, "count": total}
+
+
+class Registry:
+    """Named instruments; get-or-create accessors are idempotent."""
+
+    def __init__(self):
+        self._instruments: dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, cls, **kwargs):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = self._instruments[name] = cls(name, **kwargs)
+            elif not isinstance(inst, cls):
+                raise TypeError(
+                    f"{name!r} already registered as "
+                    f"{type(inst).__name__}, not {cls.__name__}")
+            return inst
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(name, Counter, help=help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(name, Gauge, help=help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Iterable[float] = _DEFAULT_BUCKETS) -> Histogram:
+        return self._get(name, Histogram, help=help, buckets=buckets)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._instruments.clear()
+
+    def __len__(self) -> int:
+        return len(self._instruments)
+
+    # -- export ------------------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        """JSON-able snapshot: ``{"counters": {...}, "gauges": {...},
+        "histograms": {name: {"buckets": [[le, n], ...], "sum", "count"}}}``.
+        ``inf`` bucket bounds serialize as the string ``"+Inf"`` so the
+        snapshot round-trips through strict-JSON consumers."""
+        out: dict[str, Any] = {"counters": {}, "gauges": {}, "histograms": {}}
+        with self._lock:
+            instruments = list(self._instruments.values())
+        for inst in instruments:
+            if isinstance(inst, Counter):
+                out["counters"][inst.name] = inst.value
+            elif isinstance(inst, Gauge):
+                out["gauges"][inst.name] = inst.value
+            elif isinstance(inst, Histogram):
+                out["histograms"][inst.name] = inst.export()
+        return out
+
+    def to_prometheus(self, prefix: str = "tfos_",
+                      labels: dict[str, str] | None = None) -> str:
+        return snapshot_to_prometheus(self.snapshot(), prefix=prefix,
+                                      labels=labels)
+
+
+def _label_str(labels: dict[str, str] | None) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+def _fmt(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def snapshot_to_prometheus(snap: dict[str, Any], prefix: str = "tfos_",
+                           labels: dict[str, str] | None = None) -> str:
+    """One snapshot (from :meth:`Registry.snapshot`) → text exposition."""
+    lines: list[str] = []
+    for name, val in sorted(snap.get("counters", {}).items()):
+        metric = prefix + name
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric}{_label_str(labels)} {_fmt(val)}")
+    for name, val in sorted(snap.get("gauges", {}).items()):
+        metric = prefix + name
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric}{_label_str(labels)} {_fmt(val)}")
+    for name, h in sorted(snap.get("histograms", {}).items()):
+        metric = prefix + name
+        lines.append(f"# TYPE {metric} histogram")
+        for le, n in h.get("buckets", []):
+            le_s = "+Inf" if le in ("+Inf", float("inf")) else _fmt(le)
+            bl = dict(labels or {})
+            bl["le"] = le_s
+            lines.append(f"{metric}_bucket{_label_str(bl)} {_fmt(n)}")
+        lines.append(f"{metric}_sum{_label_str(labels)} {_fmt(h['sum'])}")
+        lines.append(f"{metric}_count{_label_str(labels)} {_fmt(h['count'])}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def merged_to_prometheus(merged: dict[str, Any],
+                         prefix: str = "tfos_") -> str:
+    """Exposition of a :func:`merge_snapshots` result: counters and
+    histograms as single cluster-wide series, gauges one series per node
+    (``node`` label)."""
+    lines: list[str] = []
+    single = {"counters": merged.get("counters", {}),
+              "histograms": merged.get("histograms", {})}
+    text = snapshot_to_prometheus(single, prefix=prefix)
+    if text.strip():
+        lines.append(text)
+    for name, per_node in sorted(merged.get("gauges", {}).items()):
+        metric = prefix + name
+        lines.append(f"# TYPE {metric} gauge\n")
+        for node, val in sorted(per_node.items()):
+            lines.append(
+                f"{metric}{_label_str({'node': node})} {_fmt(val)}\n")
+    return "".join(lines)
+
+
+def merge_snapshots(node_snaps: dict[str, dict[str, Any]]) -> dict[str, Any]:
+    """Driver-side rollup of per-node registry snapshots.
+
+    Counters and histograms sum across nodes (histograms bucket-wise by
+    ``le``); gauges keep per-node values (summing a utilization gauge would
+    be meaningless) under ``gauges[name][node]``.
+    """
+    out: dict[str, Any] = {"counters": {}, "gauges": {}, "histograms": {}}
+    for node in sorted(node_snaps):
+        snap = node_snaps[node] or {}
+        for name, val in snap.get("counters", {}).items():
+            out["counters"][name] = out["counters"].get(name, 0.0) + val
+        for name, val in snap.get("gauges", {}).items():
+            out["gauges"].setdefault(name, {})[node] = val
+        for name, h in snap.get("histograms", {}).items():
+            agg = out["histograms"].setdefault(
+                name, {"buckets": {}, "sum": 0.0, "count": 0})
+            agg["sum"] += h.get("sum", 0.0)
+            agg["count"] += h.get("count", 0)
+            for le, n in h.get("buckets", []):
+                key = "+Inf" if le in ("+Inf", float("inf")) else float(le)
+                agg["buckets"][key] = agg["buckets"].get(key, 0) + n
+    for h in out["histograms"].values():
+        h["buckets"] = sorted(
+            h["buckets"].items(),
+            key=lambda kv: float("inf") if kv[0] == "+Inf" else kv[0])
+        h["buckets"] = [[le, n] for le, n in h["buckets"]]
+    return out
+
+
+# -- module-level default registry (one per process) ------------------------
+
+_REGISTRY = Registry()
+
+
+def get_registry() -> Registry:
+    return _REGISTRY
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return _REGISTRY.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return _REGISTRY.gauge(name, help)
+
+
+def histogram(name: str, help: str = "",
+              buckets: Iterable[float] = _DEFAULT_BUCKETS) -> Histogram:
+    return _REGISTRY.histogram(name, help, buckets)

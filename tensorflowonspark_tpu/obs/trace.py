@@ -1,0 +1,314 @@
+"""Tracing layer: spans + structured event log + blackboard shipping.
+
+One event model serves all three observability layers (SURVEY.md §5 names
+the reference's gap: "Python logging ... no metrics registry"; TF-Replicator
+and the TensorFlow paper treat lifecycle tracing as first-class):
+
+- a **span** is a timed phase (``with obs.span("reserve"): ...`` or the
+  ``@obs.span("reserve")`` decorator) — it records one *complete* event
+  with a wall-clock timestamp, a monotonic-derived duration, the node
+  identity, thread id, and the enclosing span's name (nesting);
+- an **instant event** (:func:`event`) marks a point occurrence (a stall,
+  a collapsed MoE group, a dropped batch) with arbitrary attrs;
+- every process keeps its events in a bounded **ring buffer**
+  (:class:`Tracer`) — tracing must never grow memory or kill the hot loop;
+- executor-side tracers **ship** their buffer to the driver through the
+  existing TFManager kv blackboard (each process owns one kv key,
+  ``trace:<node>:<pid>``, so concurrent writers never race), where
+  ``TFCluster.dump_trace`` merges all nodes into a single
+  Chrome-trace-format file (:mod:`tensorflowonspark_tpu.obs.chrome`).
+
+Event record (plain dict, JSON- and pickle-serializable)::
+
+    {"name": str,          # phase name, dot-namespaced ("node.health_probe")
+     "ph": "X" | "i",      # complete span | instant event
+     "ts": float,          # µs since the epoch (wall clock, merge-coherent)
+     "dur": float,         # µs (spans only)
+     "node": "driver" | "<job_name>:<task_index>" | ...,
+     "pid": int, "tid": int,
+     "attrs": {...}}       # including "parent": enclosing span name
+
+Env knobs: ``TFOS_TRACE=0`` disables recording entirely (the record path
+then costs one attribute check); ``TFOS_TRACE_CAPACITY`` sizes the ring
+buffer (default 4096 events per process).
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable
+
+logger = logging.getLogger(__name__)
+
+#: kv-blackboard key prefix under which each process publishes its events
+TRACE_KV_PREFIX = "trace:"
+
+_DEFAULT_CAPACITY = 4096
+
+
+def _enabled_by_env() -> bool:
+    return os.environ.get("TFOS_TRACE", "1") not in ("0", "", "false", "no")
+
+
+def _capacity_from_env() -> int:
+    try:
+        return int(os.environ.get("TFOS_TRACE_CAPACITY", _DEFAULT_CAPACITY))
+    except ValueError:
+        return _DEFAULT_CAPACITY
+
+
+class Tracer:
+    """Per-process event recorder: bounded ring buffer + optional shipping.
+
+    ``node`` is the identity stamped on every event (``"driver"`` until
+    :meth:`configure` names it).  ``mgr`` (a
+    :class:`tensorflowonspark_tpu.TFManager.TFManager` handle) enables
+    shipping: :meth:`flush` publishes the current buffer snapshot under
+    this process's own kv key — idempotent full-snapshot overwrite, so a
+    crash between flushes loses at most ``flush_interval`` events and two
+    processes never contend on one key.  Recording is cheap (deque append
+    under a lock); shipping is throttled (every ``flush_interval`` events
+    or ``flush_interval_s`` seconds, whichever comes first) and never
+    raises into the instrumented code path.
+    """
+
+    def __init__(self, node: str = "driver", capacity: int | None = None):
+        self.node = node
+        self.enabled = _enabled_by_env()
+        self.capacity = capacity or _capacity_from_env()
+        self.dropped = 0
+        self.flush_interval = 64
+        self.flush_interval_s = 2.0
+        self._events: collections.deque = collections.deque(
+            maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._local = threading.local()  # per-thread span stack
+        self._mgr = None
+        self._since_flush = 0
+        # from construction, not 0.0: monotonic() is machine uptime, and
+        # "uptime > flush_interval_s" must not make the first event flush
+        self._last_flush = time.monotonic()
+
+    # -- configuration -----------------------------------------------------
+
+    def configure(self, node: str | None = None, mgr: Any = None,
+                  capacity: int | None = None) -> "Tracer":
+        """Set node identity / blackboard manager; returns self."""
+        if node:
+            self.node = node
+        if mgr is not None:
+            self._mgr = mgr
+        if capacity and capacity != self.capacity:
+            with self._lock:
+                self.capacity = capacity
+                self._events = collections.deque(self._events,
+                                                 maxlen=capacity)
+        return self
+
+    # -- recording ---------------------------------------------------------
+
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def record(self, name: str, ph: str, ts_us: float,
+               dur_us: float | None = None,
+               attrs: dict[str, Any] | None = None) -> None:
+        if not self.enabled:
+            return
+        ev: dict[str, Any] = {
+            "name": name,
+            "ph": ph,
+            "ts": ts_us,
+            "node": self.node,
+            "pid": os.getpid(),
+            "tid": threading.get_ident() & 0xFFFFFFFF,
+        }
+        if dur_us is not None:
+            ev["dur"] = dur_us
+        if attrs:
+            ev["attrs"] = attrs
+        with self._lock:
+            if len(self._events) == self.capacity:
+                self.dropped += 1
+            self._events.append(ev)
+            self._since_flush += 1
+            want_flush = self._mgr is not None and (
+                self._since_flush >= self.flush_interval
+                or time.monotonic() - self._last_flush > self.flush_interval_s
+            )
+        if want_flush:
+            self.flush()
+
+    def span(self, name: str, **attrs: Any) -> "_Span":
+        """Context manager *and* decorator timing one phase."""
+        return _Span(self, name, attrs)
+
+    def event(self, name: str, **attrs: Any) -> None:
+        """Record an instant (point-in-time) event.  Like span exits, it
+        names the enclosing span (``parent``) so the structured log keeps
+        its nesting context."""
+        stack = self._stack()
+        if stack:
+            attrs = {**attrs, "parent": stack[-1]}
+        self.record(name, "i", time.time() * 1e6, attrs=attrs or None)
+
+    # -- reading / shipping ------------------------------------------------
+
+    def snapshot(self) -> list[dict[str, Any]]:
+        """Copy of the buffered events, oldest first."""
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def clear(self) -> None:
+        """Empty the buffer AND detach any configured blackboard manager.
+
+        clear() marks a run boundary (a reused worker bootstrapping a new
+        cluster): keeping the old manager would let the next recorded
+        event auto-flush the new run's spans onto the PREVIOUS cluster's
+        blackboard, clobbering its shipped trace.  The new run must
+        :meth:`configure` its own manager.
+        """
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+            self._since_flush = 0
+            self._mgr = None
+
+    def kv_key(self) -> str:
+        return f"{TRACE_KV_PREFIX}{self.node}:{os.getpid()}"
+
+    def flush(self, mgr: Any = None) -> bool:
+        """Publish the buffer snapshot to the kv blackboard.
+
+        Returns True on success.  Never raises — observability must not
+        kill training (same contract as ``MetricsReporter.publish``).
+        """
+        mgr = mgr if mgr is not None else self._mgr
+        if mgr is None or not self.enabled:
+            return False
+        payload = {
+            "node": self.node,
+            "pid": os.getpid(),
+            "events": self.snapshot(),
+            "dropped": self.dropped,
+            "flushed_at": time.time(),
+        }
+        try:
+            mgr.set(self.kv_key(), payload)
+        except Exception as e:
+            logger.warning("trace flush failed: %s", e)
+            with self._lock:
+                # throttle retries to the normal flush cadence — a dead
+                # manager must not add one failing RPC per recorded event
+                self._since_flush = 0
+                self._last_flush = time.monotonic()
+            return False
+        with self._lock:
+            self._since_flush = 0
+            self._last_flush = time.monotonic()
+        return True
+
+
+class _Span:
+    """One timed phase; context manager and decorator in one object.
+
+    Decorator use creates a fresh timing per call (the instance holds only
+    the static name/attrs; per-entry state lives on an internal stack, so
+    reentrant/nested use of the same instance is safe).
+    """
+
+    __slots__ = ("_tracer", "name", "attrs", "_starts")
+
+    def __init__(self, tracer: Tracer, name: str, attrs: dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self._starts: list[tuple[float, float]] = []
+
+    def __enter__(self) -> "_Span":
+        self._starts.append((time.time(), time.perf_counter()))
+        self._tracer._stack().append(self.name)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        wall_t0, perf_t0 = self._starts.pop()
+        dur_us = (time.perf_counter() - perf_t0) * 1e6
+        stack = self._tracer._stack()
+        if stack and stack[-1] == self.name:
+            stack.pop()
+        attrs = dict(self.attrs) if self.attrs else {}
+        if stack:
+            attrs["parent"] = stack[-1]
+        if exc_type is not None:
+            attrs["error"] = f"{exc_type.__name__}: {exc}"[:300]
+        self._tracer.record(self.name, "X", wall_t0 * 1e6, dur_us,
+                            attrs or None)
+
+    def set(self, **attrs: Any) -> "_Span":
+        """Attach attrs discovered mid-span (e.g. an outcome)."""
+        self.attrs = {**self.attrs, **attrs}
+        return self
+
+    def __call__(self, fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with _Span(self._tracer, self.name, self.attrs):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
+
+# -- module-level default tracer (one per process) --------------------------
+
+_TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _TRACER
+
+
+def configure(node: str | None = None, mgr: Any = None,
+              capacity: int | None = None) -> Tracer:
+    """Configure the process-default tracer (identity / blackboard)."""
+    return _TRACER.configure(node=node, mgr=mgr, capacity=capacity)
+
+
+def span(name: str, **attrs: Any) -> _Span:
+    return _TRACER.span(name, **attrs)
+
+
+def event(name: str, **attrs: Any) -> None:
+    _TRACER.event(name, **attrs)
+
+
+def flush(mgr: Any = None) -> bool:
+    return _TRACER.flush(mgr)
+
+
+def collect_blackboard(kv_snapshot: dict[str, Any]) -> dict[str, list[dict]]:
+    """Extract shipped trace payloads from one node's kv snapshot.
+
+    Returns ``{node_name: [events...]}`` — a node may have several
+    publishing processes (bootstrap task, spawned trainer); their events
+    merge under the node name, ordered by timestamp.
+    """
+    by_node: dict[str, list[dict]] = {}
+    for key, payload in kv_snapshot.items():
+        if not (isinstance(key, str) and key.startswith(TRACE_KV_PREFIX)):
+            continue
+        if not isinstance(payload, dict) or "events" not in payload:
+            continue
+        node = payload.get("node") or key[len(TRACE_KV_PREFIX):].rsplit(
+            ":", 1)[0]
+        by_node.setdefault(node, []).extend(payload["events"])
+    for events in by_node.values():
+        events.sort(key=lambda e: (e.get("ts", 0), e.get("name", "")))
+    return by_node

@@ -31,7 +31,10 @@ handles imbalance), but shard the token dim over the data axes as usual.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Mapping
+
+logger = logging.getLogger(__name__)
 
 #: flax logical axes for each param — models pass these to
 #: ``nn.with_partitioning`` so ``param_sharding_from_metadata`` maps the
@@ -104,10 +107,32 @@ def top1_route(logits, capacity: int, token_mask=None):
 
 def group_count(num_tokens: int, group_size: int) -> int:
     """Number of routing groups: tokens split into equal groups of at most
-    ``group_size`` — the largest divisor of ``num_tokens`` that fits."""
-    tg = min(num_tokens, max(1, group_size))
+    ``group_size`` — the largest divisor of ``num_tokens`` that fits.
+
+    Token counts with no divisor near ``group_size`` (worst case: prime
+    ``num_tokens`` → groups of 1) silently disable the per-group capacity
+    bound and degenerate the load-balance aux (ADVICE r5).
+    :func:`moe_ffn` avoids the trap by padding the token dim up to a
+    multiple of the group size before calling this; direct callers that
+    hit the collapse get a structured warning event
+    (``moe.group_size_collapsed``) + log line so the degradation is
+    visible instead of silent.
+    """
+    ideal = min(num_tokens, max(1, group_size))
+    tg = ideal
     while num_tokens % tg:
         tg -= 1
+    if tg < max(1, ideal // 2) and num_tokens > 1:
+        from tensorflowonspark_tpu import obs
+
+        obs.event("moe.group_size_collapsed", num_tokens=num_tokens,
+                  requested_group_size=group_size, actual_group_size=tg)
+        logger.warning(
+            "moe.group_count: %d tokens have no divisor near group_size=%d "
+            "(groups of %d); the per-group capacity bound is effectively "
+            "disabled — pad the token count to a multiple of the group "
+            "size (moe_ffn does this automatically)",
+            num_tokens, group_size, tg)
     return num_tokens // tg
 
 
@@ -132,6 +157,16 @@ def moe_ffn(x, params: Mapping[str, Any], *, capacity_factor: float = 1.25,
     shapes: ~63 MB vs ~755 MB per MoE layer) — and the capacity bound +
     load-balance aux apply within each group.  Token order is preserved;
     batches ≤ ``group_size`` tokens route exactly as a single group.
+
+    Token counts that do not divide into groups of the requested size
+    (worst case: prime ``T``, whose only divisors are 1 and ``T``) are
+    **padded** up to the next multiple of the group size — pads are
+    masked out of routing (zero capacity claimed, zero output, excluded
+    from the aux statistics) and sliced off the result — instead of
+    letting ``group_count`` degenerate to tiny groups that silently
+    disable the capacity bound (ADVICE r5).  Padding is trace-time
+    (static shapes), so it costs one concat/slice pair per call only
+    when actually needed.
     """
     import jax
     import jax.numpy as jnp
@@ -149,13 +184,26 @@ def moe_ffn(x, params: Mapping[str, Any], *, capacity_factor: float = 1.25,
     lead = x.shape[:-1]
     m = x.shape[-1]
     t = math.prod(lead)
-    g = group_count(t, group_size)
-    xt = x.reshape(g, t // g, m)                                # (G, Tg, M)
+    tg_ideal = min(t, max(1, group_size))
+    pad = (-t) % tg_ideal
+    x_flat = x.reshape(t, m)
+    mask_flat = None if token_mask is None else token_mask.reshape(t)
+    if pad:
+        x_flat = jnp.concatenate(
+            [x_flat, jnp.zeros((pad, m), x_flat.dtype)])
+        mask_flat = jnp.concatenate([
+            jnp.ones(t, jnp.float32) if mask_flat is None
+            else mask_flat.astype(jnp.float32),
+            jnp.zeros(pad, jnp.float32),
+        ])
+    t_padded = t + pad
+    g = t_padded // tg_ideal
+    xt = x_flat.reshape(g, tg_ideal, m)                         # (G, Tg, M)
     e = params["w_in"].shape[0]
-    c = capacity_of(t // g, e, capacity_factor)
+    c = capacity_of(tg_ideal, e, capacity_factor)
 
-    grouped_mask = (None if token_mask is None
-                    else token_mask.reshape(g, t // g))         # (G, Tg)
+    grouped_mask = (None if mask_flat is None
+                    else mask_flat.reshape(g, tg_ideal))        # (G, Tg)
     logits = jnp.einsum("gtm,me->gte", xt.astype(jnp.float32),
                         params["gate"].astype(jnp.float32))
     if grouped_mask is None:
@@ -192,6 +240,9 @@ def moe_ffn(x, params: Mapping[str, Any], *, capacity_factor: float = 1.25,
     out = out + params["b_out"].astype(dtype)[None, :, None, :]
     y = jnp.einsum("gtec,gecm->gtm", combine.astype(dtype), out,
                    preferred_element_type=jnp.float32).astype(dtype)
+    y = y.reshape(t_padded, m)
+    if pad:
+        y = y[:t]  # padding tokens produced zeros; drop them
     return y.reshape(*lead, m), aux.mean()
 
 

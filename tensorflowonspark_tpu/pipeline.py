@@ -221,7 +221,7 @@ class TFEstimator(TFParams, HasBatchSize, HasEpochs, HasSteps, HasClusterSize,
         return self._fit(df)
 
     def _fit(self, df) -> "TFModel":
-        from tensorflowonspark_tpu import TFCluster
+        from tensorflowonspark_tpu import TFCluster, obs
 
         sc = _spark_context_of(df)
         args = self.merge_args()
@@ -232,17 +232,20 @@ class TFEstimator(TFParams, HasBatchSize, HasEpochs, HasSteps, HasClusterSize,
 
         logger.info("TFEstimator.fit: cluster_size=%d input_mode=%s",
                     self.getOrDefault("cluster_size"), input_mode)
-        cluster = TFCluster.run(
-            sc, self.train_fn, args,
-            num_executors=self.getOrDefault("cluster_size"),
-            num_ps=self.getOrDefault("num_ps"),
-            tensorboard=self.getOrDefault("tensorboard"),
-            input_mode=input_mode,
-            master_node=self.getOrDefault("master_node"),
-        )
-        if input_mode is TFCluster.InputMode.SPARK:
-            cluster.train(df.rdd.map(list), num_epochs=self.getOrDefault("epochs"))
-        cluster.shutdown(grace_secs=self.getOrDefault("grace_secs"))
+        with obs.span("pipeline.fit",
+                      cluster_size=self.getOrDefault("cluster_size")):
+            cluster = TFCluster.run(
+                sc, self.train_fn, args,
+                num_executors=self.getOrDefault("cluster_size"),
+                num_ps=self.getOrDefault("num_ps"),
+                tensorboard=self.getOrDefault("tensorboard"),
+                input_mode=input_mode,
+                master_node=self.getOrDefault("master_node"),
+            )
+            if input_mode is TFCluster.InputMode.SPARK:
+                cluster.train(df.rdd.map(list),
+                              num_epochs=self.getOrDefault("epochs"))
+            cluster.shutdown(grace_secs=self.getOrDefault("grace_secs"))
 
         model = TFModel(tf_args=self.tf_args)
         self._copyValues(model)
@@ -400,8 +403,18 @@ class _RunModel:
         key = (path, fn_id, mtime)
         if key in _MODEL_CACHE:
             return _MODEL_CACHE[key]
+        from tensorflowonspark_tpu import obs
+
+        with obs.span("serving.model_load", export_dir=self.export_dir,
+                      fn=fn_id or "?"):
+            return self._load_uncached(path, key, serialized)
+
+    def _load_uncached(self, path, key, serialized):
+        """Cache-miss half of :meth:`_load` (spanned as
+        ``serving.model_load`` — the restore+jit cost the first partition
+        on an executor pays)."""
         single_node_env()
-        from tensorflowonspark_tpu import ckpt
+        from tensorflowonspark_tpu import ckpt, saved_model
 
         state = ckpt.load_pytree(path)
         params = state.get("params", state) if isinstance(state, dict) else state
@@ -554,7 +567,11 @@ def single_node_env(num_gpus: int = 0) -> None:
     The probe runs once per process, but a FAILED verdict is memoized and
     re-raised on every later call — Spark retries reuse the python worker,
     and a retry that skipped the probe would hang on the wedged chip
-    anonymously, the exact failure this probe exists to prevent.
+    anonymously, the exact failure this probe exists to prevent.  The
+    memo flag is set only *after* ``probe_chip_health`` returns, and an
+    unexpected probe exception (e.g. a spawn failure) memoizes like a
+    failed verdict (ADVICE r5: flagging "probed" before probing meant one
+    raised exception skipped the probe forever on an unverified chip).
     """
     del num_gpus  # GPU pinning has no TPU meaning
     import os
@@ -563,16 +580,19 @@ def single_node_env(num_gpus: int = 0) -> None:
 
     global _SERVING_PROBED, _SERVING_PROBE_ERROR
     if not _SERVING_PROBED:
-        _SERVING_PROBED = True
         if health.should_probe_serving():
             timeout_s = float(os.environ.get(
                 "TFOS_HEALTH_PROBE_TIMEOUT_S", health.DEFAULT_TIMEOUT_S))
-            reason = health.probe_chip_health(timeout_s)
+            try:
+                reason = health.probe_chip_health(timeout_s)
+            except Exception as e:
+                reason = f"health probe raised unexpectedly: {e!r}"
             if reason:
                 import socket
 
                 _SERVING_PROBE_ERROR = (
                     f"serving executor on {socket.gethostname()}: {reason}")
+        _SERVING_PROBED = True
     if _SERVING_PROBE_ERROR:
         raise RuntimeError(_SERVING_PROBE_ERROR)
     util.ensure_jax_platform()

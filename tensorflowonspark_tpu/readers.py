@@ -31,6 +31,7 @@ from __future__ import annotations
 import logging
 import queue as _queue_mod
 import threading
+import time as _time_mod
 from typing import Any, Callable, Iterator, Sequence
 
 import numpy as np
@@ -200,19 +201,34 @@ def tfrecord_batches(
     rng = np.random.default_rng(seed)
 
     def batch_gen() -> Iterator[dict[str, Any]]:
+        from tensorflowonspark_tpu import obs
+
         for epoch in range(num_epochs):
             epoch_files = list(files)
             if shuffle_files:
                 np.random.default_rng(seed + epoch).shuffle(epoch_files)
             rows: list[dict[str, Any]] = []
+            # the epoch is recorded as a manually-timed complete event, NOT
+            # a `with obs.span(...)` around the loop: a generator suspends
+            # inside the with-block at every yield, which would leave
+            # "readers.epoch" on the CONSUMER thread's span stack and
+            # mis-parent unrelated spans recorded between batches (and an
+            # abandoned iterator might never pop it at all)
+            t0_wall, t0 = _time_mod.time(), _time_mod.perf_counter()
             for payload in _record_stream(epoch_files, readers,
                                           shuffle_buffer, rng):
                 rows.append(parse(payload))
                 if len(rows) == batch_size:
+                    obs.counter("reader_records_total").inc(len(rows))
                     yield _stage(_columnarize(rows))
                     rows = []
             if rows and not drop_remainder:
+                obs.counter("reader_records_total").inc(len(rows))
                 yield _stage(_columnarize(rows))
+            obs.get_tracer().record(
+                "readers.epoch", "X", t0_wall * 1e6,
+                (_time_mod.perf_counter() - t0) * 1e6,
+                {"epoch": epoch, "files": len(epoch_files)})
 
     _stage = _stager(device_put)
 
@@ -348,6 +364,8 @@ def parquet_batches(
         return pq.ParquetFile(handle), handle
 
     def batch_gen() -> Iterator[dict[str, Any]]:
+        from tensorflowonspark_tpu import obs
+
         for epoch in range(num_epochs):
             epoch_files = list(files)
             if shuffle_files:
@@ -380,12 +398,15 @@ def parquet_batches(
                             batch, pending, count = _slice_batch(
                                 pending, count, batch_size
                             )
+                            obs.counter("reader_records_total").inc(
+                                batch_size)
                             yield _stage(batch)
                 finally:
                     pf.close()
                     if handle is not None:
                         handle.close()
             if count and not drop_remainder:
+                obs.counter("reader_records_total").inc(count)
                 batch, pending, count = _slice_batch(pending, count, count)
                 yield _stage(batch)
 

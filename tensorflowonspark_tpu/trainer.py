@@ -57,9 +57,17 @@ class Trainer:
         devices: Any = None,
         step_timeout_s: float | None = None,
         error_sink: Any = None,
+        profile_steps: bool | None = None,
     ):
         import jax
         import optax
+
+        from tensorflowonspark_tpu import obs
+
+        # init is the single biggest pre-training phase (sharded init +
+        # two jit compiles); span it manually rather than re-indenting the
+        # whole constructor
+        _t0_wall, _t0 = time.time(), time.perf_counter()
 
         if isinstance(model, str):
             self.module_lib = model_zoo.get_model(model)
@@ -179,6 +187,20 @@ class Trainer:
             collection_shardings=col_overrides or None,
         )
 
+        # optional jax.profiler annotations around the jitted step: the
+        # XLA-side twin of the obs spans — step markers show up in captured
+        # profiles (TFSparkNode's profiler server / jax.profiler.trace)
+        if profile_steps is None:
+            profile_steps = os.environ.get(
+                "TFOS_PROFILE_STEPS", "") not in ("", "0", "false", "no")
+        self._profile_steps = bool(profile_steps)
+        self._steps_done = 0
+        obs.get_tracer().record(
+            "trainer.init", "X", _t0_wall * 1e6,
+            (time.perf_counter() - _t0) * 1e6,
+            {"model": self.model_name or "custom",
+             "mesh": dict(self.mesh.shape)})
+
     # -- stepping ------------------------------------------------------------
 
     def shard(self, batch):
@@ -198,52 +220,97 @@ class Trainer:
         """One sharded optimizer step; returns the (replicated) loss."""
         if self._watchdog is not None:
             return self._watchdogged_step(batch)
-        self.state, loss = self.train_step(self.state, self.shard(batch))
+        with self._step_annotation():
+            self.state, loss = self.train_step(self.state, self.shard(batch))
         return self._after_step(loss, batch)
 
+    def _step_annotation(self):
+        """Optional ``jax.profiler.StepTraceAnnotation`` around the jitted
+        step (``profile_steps=True`` / ``TFOS_PROFILE_STEPS=1``) — a no-op
+        context otherwise.  Best-effort: a backend without profiler support
+        must not break training."""
+        import contextlib
+
+        if not self._profile_steps:
+            return contextlib.nullcontext()
+        try:
+            import jax
+
+            return jax.profiler.StepTraceAnnotation(
+                "train_step", step_num=self._steps_done)
+        except Exception:
+            return contextlib.nullcontext()
+
     def _after_step(self, loss, batch):
-        """Shared post-step accounting: wall-time + examples → callbacks."""
-        if self._step_callbacks:
-            now = time.perf_counter()
-            dt = now - self._last_step_t if self._last_step_t else 0.0
-            self._last_step_t = now
-            n = _batch_examples(batch)
-            for cb in self._step_callbacks:
-                cb(loss, n, dt)
+        """Shared post-step accounting: wall-time + examples → callbacks
+        and the obs registry (steps/examples counters, step-time
+        histogram — the per-node series ``TFCluster.metrics()`` rolls
+        up)."""
+        from tensorflowonspark_tpu import obs
+
+        now = time.perf_counter()
+        dt = now - self._last_step_t if self._last_step_t else 0.0
+        self._last_step_t = now
+        n = _batch_examples(batch)
+        self._steps_done += 1
+        obs.counter("trainer_steps_total").inc()
+        if n:
+            obs.counter("trainer_examples_total").inc(n)
+        if dt > 0:
+            obs.histogram("trainer_step_seconds").observe(dt)
+        for cb in self._step_callbacks:
+            cb(loss, n, dt)
         return loss
+
+    @staticmethod
+    def _batch_signature(batch):
+        """Hashable fingerprint of a batch's full (structure, shape, dtype)
+        tree — the watchdog's warm-shape key.  Leaf dtypes are included and
+        non-dict batches key by their whole pytree (ADVICE r5: a dtype-only
+        change with identical shapes, or any reshape of a non-dict batch —
+        which the old key collapsed to one ``None`` — recompiles, and an
+        armed window across that compile would read minutes of XLA as a
+        wedge and ``os._exit`` a healthy trainer)."""
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(batch)
+        return (treedef, tuple(
+            (tuple(getattr(leaf, "shape", ())),
+             str(getattr(leaf, "dtype", type(leaf).__name__)))
+            for leaf in leaves))
 
     def _watchdogged_step(self, batch) -> float:
         """step() under the mid-run wedge watchdog: the loss is forced to
         the host inside the armed window, so a wedged chip trips the
         watchdog instead of deferring the hang to a later fetch.
 
-        The watchdog only arms for batch shapes it has already seen
+        The watchdog only arms for batch signatures it has already seen
         complete once: jit compiles lazily on first call (and recompiles on
-        a shape change, e.g. a short final batch), and minutes of XLA
-        compilation inside an armed window would read as a wedge and kill a
-        healthy trainer.  Unarmed steps still hang forever on a truly
-        wedged chip — but the first step of a run meeting a wedged chip is
-        the rendezvous health probe's job (health.probe_chip_health), not
-        this watchdog's.
+        a shape OR dtype change, e.g. a short final batch), and minutes of
+        XLA compilation inside an armed window would read as a wedge and
+        kill a healthy trainer.  Unarmed steps still hang forever on a
+        truly wedged chip — but the first step of a run meeting a wedged
+        chip is the rendezvous health probe's job
+        (health.probe_chip_health), not this watchdog's.
         """
         import jax
 
-        shapes = tuple(sorted(
-            (k, tuple(getattr(v, "shape", ())))
-            for k, v in batch.items())) if isinstance(batch, dict) else None
-        armed = shapes in self._watchdog_warm_shapes
+        signature = self._batch_signature(batch)
+        armed = signature in self._watchdog_warm_shapes
         if armed:
             self._watchdog.arm()
             if os.environ.get("TFOS_STEP_WATCHDOG_TEST_HANG"):
                 time.sleep(3600)  # simulated mid-run wedge (tests)
         try:
-            self.state, loss = self.train_step(self.state, self.shard(batch))
-            loss = jax.block_until_ready(loss)
+            with self._step_annotation():
+                self.state, loss = self.train_step(
+                    self.state, self.shard(batch))
+                loss = jax.block_until_ready(loss)
         finally:
             # disarm on ANY exit: an exception a caller handles must not
             # leave a stale armed timestamp that later reads as a stall
             self._watchdog.beat()
-        self._watchdog_warm_shapes.add(shapes)
+        self._watchdog_warm_shapes.add(signature)
         return self._after_step(loss, batch)
 
     def predict(self, batch):

@@ -16,6 +16,8 @@ import sys
 import time
 import unittest
 
+import pytest
+
 BENCH = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "bench.py")
 
@@ -100,6 +102,55 @@ class TestOutageProofing(unittest.TestCase):
         self.assertGreater(result["secondary"]["value"], 0.0)
         self.assertFalse(result["probe"]["ok"])
         self.assertTrue(result["probe"]["reprobe"]["ok"])
+
+    @pytest.mark.slow  # ~60 s of subprocess work; the fast trace-schema
+    # gate for tier-1 lives in tests/test_check_trace.py
+    def test_degraded_probe_run_emits_trace_with_probe_phase(self):
+        # ISSUE 1 acceptance: bench.py emits a Chrome-trace artifact even
+        # in degraded/probe-failure mode, and the trace ATTRIBUTES the
+        # probe phase — the round-5 degraded run burned its 60 s probe
+        # window with no record of where the time went.
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            trace_path = os.path.join(td, "bench_trace.json")
+            result, proc, _ = _run_bench(
+                ["--model", "mnist_mlp", "--steps", "2", "--warmup", "1"],
+                {
+                    "TFOS_BENCH_SIMULATE_HANG": "99",
+                    "TFOS_BENCH_PROBE_TIMEOUT_S": "5",
+                    "TFOS_BENCH_WALL_BUDGET_S": "300",
+                    "TFOS_BENCH_TRACE_PATH": trace_path,
+                },
+                timeout=360,
+            )
+            self.assertEqual(proc.returncode, 0, proc.stderr[-2000:])
+            self.assertIn("degraded", result)
+            self.assertEqual(result.get("trace_artifact"), trace_path)
+            with open(trace_path) as f:
+                doc = json.load(f)
+            probes = [e for e in doc["traceEvents"]
+                      if e.get("name") == "bench.probe"]
+            self.assertTrue(probes, doc["traceEvents"])
+            probe_span = probes[0]
+            self.assertEqual(probe_span["ph"], "X")
+            self.assertFalse(probe_span["args"]["ok"])
+            self.assertIn("timeout", probe_span["args"]["error"])
+            # the span's duration shows the probe consumed its window (µs)
+            self.assertGreater(probe_span["dur"], 4.5e6)
+            names = {e.get("name") for e in doc["traceEvents"]}
+            # the CPU fallback phase is attributed too, and the primary was
+            # skipped (probe verdict shared), so no bench.primary span
+            self.assertIn("bench.fallback", names)
+            self.assertNotIn("bench.primary", names)
+            self.assertIn("bench.primary_skipped", names)
+            # the artifact passes the tier-1 schema validator
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "tools"))
+            import check_trace
+
+            self.assertEqual(check_trace.validate_doc(doc), [])
 
     def test_healthy_path_emits_undegraded_json(self):
         # No hang knob: on this machine the probe runs on the CPU backend and

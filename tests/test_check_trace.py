@@ -1,0 +1,86 @@
+"""tools/check_trace.py — the tier-1 gate on emitted trace files: a
+malformed event fails the suite here, not a downstream trace viewer."""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import check_trace  # noqa: E402
+
+from tensorflowonspark_tpu import obs  # noqa: E402
+from tensorflowonspark_tpu.obs.trace import Tracer  # noqa: E402
+
+
+def _emit(tmp_path, by_node):
+    path = str(tmp_path / "trace.json")
+    obs.chrome.write(path, by_node)
+    return path
+
+
+def test_emitted_trace_validates(tmp_path):
+    tr = Tracer(node="driver")
+    with tr.span("cluster.reserve", num_executors=2):
+        tr.event("mark")
+    path = _emit(tmp_path, {"driver": tr.snapshot(),
+                            "worker:0": tr.snapshot()})
+    assert check_trace.validate_file(path) == []
+
+
+def test_malformed_traces_fail(tmp_path):
+    cases = [
+        ([], "top level"),  # not an object
+        ({"events": []}, "traceEvents"),  # wrong key
+        ({"traceEvents": [{"ph": "Q", "pid": 1, "tid": 0}]}, "phase"),
+        ({"traceEvents": [  # X event without dur
+            {"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+             "args": {"name": "n"}},
+            {"ph": "X", "name": "a", "ts": 1.0, "pid": 1, "tid": 0}]},
+         "dur"),
+        ({"traceEvents": [  # events owned by an unnamed pid
+            {"ph": "i", "name": "a", "ts": 1.0, "pid": 7, "tid": 0}]},
+         "process_name"),
+        ({"traceEvents": [  # out of order
+            {"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+             "args": {"name": "n"}},
+            {"ph": "i", "name": "a", "ts": 5.0, "pid": 1, "tid": 0},
+            {"ph": "i", "name": "b", "ts": 1.0, "pid": 1, "tid": 0}]},
+         "order"),
+    ]
+    for i, (doc, expect) in enumerate(cases):
+        p = str(tmp_path / f"bad{i}.json")
+        with open(p, "w") as f:
+            json.dump(doc, f)
+        problems = check_trace.validate_file(p)
+        assert problems and any(expect in msg for msg in problems), (
+            doc, expect, problems)
+
+
+def test_unparseable_file_fails(tmp_path):
+    p = str(tmp_path / "junk.json")
+    with open(p, "w") as f:
+        f.write("{not json")
+    assert check_trace.validate_file(p)
+
+
+def test_cli_exit_codes(tmp_path):
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "check_trace.py")
+    tr = Tracer(node="driver")
+    tr.event("a")
+    good = _emit(tmp_path, {"driver": tr.snapshot()})
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        json.dump({"traceEvents": [{"ph": "Z"}]}, f)
+
+    ok = subprocess.run([sys.executable, tool, good], capture_output=True)
+    assert ok.returncode == 0
+    fail = subprocess.run([sys.executable, tool, good, bad],
+                          capture_output=True, text=True)
+    assert fail.returncode == 1
+    assert "bad.json" in fail.stderr
+    none = subprocess.run([sys.executable, tool], capture_output=True)
+    assert none.returncode == 2

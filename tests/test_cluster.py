@@ -179,6 +179,59 @@ def test_train_time_metrics_polling_and_stale_retention(sc):
     assert after["mean_loss"] is not None
 
 
+def test_dump_trace_merges_driver_and_executors(sc, tmp_path):
+    """ISSUE 1 acceptance: TFCluster.dump_trace() produces ONE Chrome-trace
+    file merging the driver and ≥2 executor nodes — lifecycle spans from
+    the driver (reserve/train/shutdown), the bootstrap tasks
+    (manager_start/register_await), and the spawned trainers (map_fun),
+    all shipped over the TFManager kv blackboard, schema-valid per
+    tools/check_trace.py."""
+    import json
+    import os
+
+    data = _make_regression_data(n=256)
+    cluster = TFCluster.run(sc, metered_train_fun, tf_args=None,
+                            num_executors=2,
+                            input_mode=TFCluster.InputMode.SPARK)
+    cluster.train(sc.parallelize(data, 2), num_epochs=2, feed_timeout=120)
+    cluster.shutdown(grace_secs=30)
+
+    path = str(tmp_path / "cluster_trace.json")
+    assert cluster.dump_trace(path) == path
+    with open(path) as f:
+        doc = json.load(f)
+    tracks = {e["args"]["name"] for e in doc["traceEvents"]
+              if e["ph"] == "M"}
+    assert "driver" in tracks
+    assert {"worker:0", "worker:1"} <= tracks, tracks
+    names = {e["name"] for e in doc["traceEvents"]}
+    # driver lifecycle phases
+    assert {"cluster.reserve", "cluster.train", "cluster.feed_epoch",
+            "cluster.shutdown"} <= names, names
+    # executor bootstrap + trainer phases (shipped via the blackboard)
+    assert {"node.manager_start", "node.register_await",
+            "node.map_fun"} <= names, names
+
+    # the emitted artifact passes the tier-1 schema validator
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    import check_trace
+
+    assert check_trace.validate_doc(doc) == []
+
+    # generalized metrics: the same cluster serves a Prometheus exposition
+    # (per-node step gauges + the merged obs registry of feed counters)
+    text = cluster.metrics_prometheus()
+    assert 'tfos_node_step{node="worker:0"}' in text
+    assert 'tfos_node_step{node="worker:1"}' in text
+    assert "# TYPE tfos_cluster_num_reporting gauge" in text
+    assert "tfos_datafeed_batches_total" in text  # merged registry
+    # exposition-format validity: ONE "# TYPE" line per metric family
+    # (a duplicate fails the whole scrape in real Prometheus)
+    type_lines = [ln for ln in text.splitlines() if ln.startswith("# TYPE")]
+    assert len(type_lines) == len(set(type_lines)), type_lines
+
+
 def test_spark_mode_inference_round_trip(sc):
     cluster = TFCluster.run(sc, predict_fun, tf_args=None, num_executors=2)
     values = [(float(i),) for i in range(40)]

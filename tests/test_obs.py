@@ -1,0 +1,340 @@
+"""Observability subsystem unit tests (ISSUE 1 tentpole): span
+nesting/ordering, ring-buffer bounds, blackboard shipping, Chrome-trace
+merge determinism, registry semantics, and Prometheus exposition."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tensorflowonspark_tpu import obs
+from tensorflowonspark_tpu.obs import chrome, registry as reg
+from tensorflowonspark_tpu.obs.trace import Tracer
+
+
+# ---------------------------------------------------------------------------
+# tracing: spans + events + ring buffer
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_and_ordering():
+    tr = Tracer(node="t")
+    with tr.span("outer", phase="reserve"):
+        with tr.span("inner"):
+            time.sleep(0.002)
+        tr.event("mark", k=1)
+    evs = tr.snapshot()
+    names = [e["name"] for e in evs]
+    # completion order: inner closes before outer; the instant lands between
+    assert names == ["inner", "mark", "outer"]
+    inner, mark, outer = evs
+    assert inner["attrs"]["parent"] == "outer"
+    assert "parent" not in (outer.get("attrs") or {})
+    assert outer["attrs"]["phase"] == "reserve"
+    assert mark["ph"] == "i" and mark["attrs"] == {"k": 1, "parent": "outer"}
+    # the outer span contains the inner span on the timeline
+    assert outer["ts"] <= inner["ts"]
+    assert outer["ts"] + outer["dur"] >= inner["ts"] + inner["dur"]
+
+
+def test_span_decorator_and_error_capture():
+    tr = Tracer(node="t")
+
+    @tr.span("work", kind="decorated")
+    def work(x):
+        return x * 2
+
+    assert work(21) == 42
+    with pytest.raises(ValueError):
+        with tr.span("failing"):
+            raise ValueError("boom")
+    evs = {e["name"]: e for e in tr.snapshot()}
+    assert evs["work"]["attrs"]["kind"] == "decorated"
+    assert "ValueError: boom" in evs["failing"]["attrs"]["error"]
+
+
+def test_span_set_attaches_outcome():
+    tr = Tracer(node="t")
+    with tr.span("probe", timeout_s=5) as sp:
+        sp.set(ok=False, reason="hung")
+    ev = tr.snapshot()[0]
+    assert ev["attrs"] == {"timeout_s": 5, "ok": False, "reason": "hung"}
+
+
+def test_ring_buffer_bounds_memory_and_counts_drops():
+    tr = Tracer(node="t", capacity=10)
+    for i in range(25):
+        tr.event(f"e{i}")
+    evs = tr.snapshot()
+    assert len(evs) == 10
+    assert tr.dropped == 15
+    assert evs[0]["name"] == "e15"  # oldest evicted first
+
+
+def test_tracer_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("TFOS_TRACE", "0")
+    tr = Tracer(node="t")
+    tr.event("never")
+    with tr.span("also-never"):
+        pass
+    assert tr.snapshot() == []
+
+
+def test_threaded_spans_do_not_cross_nest():
+    """Each thread keeps its own span stack: a span opened in thread A must
+    not become the parent of a span in thread B."""
+    tr = Tracer(node="t")
+    ready = threading.Event()
+
+    def other():
+        ready.wait(5)
+        with tr.span("b"):
+            pass
+
+    th = threading.Thread(target=other)
+    th.start()
+    with tr.span("a"):
+        ready.set()
+        th.join(5)
+    evs = {e["name"]: e for e in tr.snapshot()}
+    assert "parent" not in (evs["b"].get("attrs") or {})
+
+
+# ---------------------------------------------------------------------------
+# executor→driver shipping through the (fake) kv blackboard
+# ---------------------------------------------------------------------------
+
+
+class FakeMgr:
+    def __init__(self):
+        self.kv = {}
+
+    def set(self, k, v):
+        self.kv[k] = v
+
+    def kv_snapshot(self):
+        return dict(self.kv)
+
+
+def test_flush_ships_snapshot_to_own_kv_key():
+    tr = Tracer(node="worker:0")
+    mgr = FakeMgr()
+    with tr.span("node.bootstrap"):
+        pass
+    assert tr.flush(mgr)
+    (key,) = mgr.kv.keys()
+    assert key.startswith(obs.TRACE_KV_PREFIX + "worker:0:")
+    payload = mgr.kv[key]
+    assert payload["node"] == "worker:0"
+    assert [e["name"] for e in payload["events"]] == ["node.bootstrap"]
+
+
+def test_flush_survives_broken_mgr():
+    class Broken:
+        def set(self, k, v):
+            raise ConnectionError("gone")
+
+    tr = Tracer(node="worker:0")
+    tr.event("x")
+    assert tr.flush(Broken()) is False  # must not raise
+
+
+def test_auto_flush_on_event_threshold():
+    tr = Tracer(node="worker:0")
+    mgr = FakeMgr()
+    tr.configure(mgr=mgr)
+    tr.flush_interval = 5
+    tr.flush_interval_s = 3600.0  # only the count threshold may trigger
+    for i in range(4):
+        tr.event(f"e{i}")
+    assert not mgr.kv  # under threshold: nothing shipped yet
+    tr.event("e4")
+    assert mgr.kv  # fifth event crossed the threshold
+
+
+def test_collect_blackboard_merges_processes_of_one_node():
+    """A node has two publishing processes (bootstrap task + spawned
+    trainer): their events merge under one node name, time-ordered."""
+    t1 = Tracer(node="worker:0")
+    t2 = Tracer(node="worker:0")
+    mgr = FakeMgr()
+    t1.event("bootstrap.early")
+    time.sleep(0.002)
+    t2.event("trainer.late")
+    t1.flush(mgr)
+    # fake a distinct pid for the second process's key
+    payload = {"node": "worker:0", "pid": 99999, "events": t2.snapshot(),
+               "dropped": 0, "flushed_at": time.time()}
+    mgr.set(f"{obs.TRACE_KV_PREFIX}worker:0:99999", payload)
+    by_node = obs.collect_blackboard(mgr.kv_snapshot())
+    assert list(by_node) == ["worker:0"]
+    assert [e["name"] for e in by_node["worker:0"]] == [
+        "bootstrap.early", "trainer.late"]
+
+
+def test_collect_blackboard_ignores_non_trace_keys():
+    kv = {"metrics": {"step": 3}, "state": "running",
+          "trace:w:1": {"node": "w", "events": [
+              {"name": "a", "ph": "i", "ts": 1.0, "pid": 1, "tid": 1}]},
+          "trace:junk": "not-a-payload"}
+    by_node = obs.collect_blackboard(kv)
+    assert list(by_node) == ["w"]
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace merge
+# ---------------------------------------------------------------------------
+
+
+def _mk_event(name, ts, node="n", ph="X", dur=5.0, tid=1):
+    ev = {"name": name, "ph": ph, "ts": ts, "node": node, "pid": 1,
+          "tid": tid}
+    if ph == "X":
+        ev["dur"] = dur
+    return ev
+
+
+def test_chrome_merge_is_deterministic_and_stable(tmp_path):
+    by_node = {
+        "worker:1": [_mk_event("b", 200.0), _mk_event("a", 100.0)],
+        "driver": [_mk_event("run", 50.0, dur=500.0)],
+        "worker:0": [_mk_event("c", 150.0, ph="i")],
+    }
+    p1, p2 = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    chrome.write(p1, by_node)
+    # same logical input, different dict insertion order → identical bytes
+    shuffled = {k: list(reversed(v)) for k, v in reversed(by_node.items())}
+    chrome.write(p2, shuffled)
+    assert open(p1, "rb").read() == open(p2, "rb").read()
+
+    doc = json.load(open(p1))
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    rows = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+    # driver gets pid 1 (first track); workers follow sorted
+    names_by_pid = {m["pid"]: m["args"]["name"] for m in meta}
+    assert names_by_pid == {1: "driver", 2: "worker:0", 3: "worker:1"}
+    # events globally time-ordered
+    assert [r["ts"] for r in rows] == sorted(r["ts"] for r in rows)
+    # instant events carry scope, complete events carry dur
+    assert all("dur" in r for r in rows if r["ph"] == "X")
+    assert all(r.get("s") == "t" for r in rows if r["ph"] == "i")
+
+
+def test_chrome_merge_skips_malformed_phases():
+    doc = chrome.merge({"n": [_mk_event("ok", 1.0),
+                              _mk_event("bad", 2.0, ph="Z")]})
+    names = [e["name"] for e in doc["traceEvents"] if e["ph"] != "M"]
+    assert names == ["ok"]
+
+
+# ---------------------------------------------------------------------------
+# registry: counters / gauges / histograms, Prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+def test_registry_instruments_and_snapshot():
+    r = reg.Registry()
+    r.counter("steps").inc()
+    r.counter("steps").inc(2)  # get-or-create returns the same instrument
+    r.gauge("util").set(0.75)
+    h = r.histogram("lat", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(99.0)
+    snap = r.snapshot()
+    assert snap["counters"]["steps"] == 3
+    assert snap["gauges"]["util"] == 0.75
+    assert snap["histograms"]["lat"]["count"] == 3
+    assert snap["histograms"]["lat"]["buckets"] == [
+        [0.1, 1], [1.0, 2], ["+Inf", 3]]  # cumulative
+    assert snap["histograms"]["lat"]["sum"] == pytest.approx(99.55)
+    json.dumps(snap)  # strict-JSON serializable (+Inf encoded as string)
+
+
+def test_registry_counter_rejects_negative_and_type_conflicts():
+    r = reg.Registry()
+    with pytest.raises(ValueError):
+        r.counter("c").inc(-1)
+    r.counter("x")
+    with pytest.raises(TypeError):
+        r.gauge("x")
+
+
+def test_prometheus_exposition_format():
+    r = reg.Registry()
+    r.counter("rows_total").inc(42)
+    r.gauge("queue_depth").set(7)
+    r.histogram("step_seconds", buckets=(0.5,)).observe(0.2)
+    text = r.to_prometheus(labels={"node": "worker:0"})
+    assert '# TYPE tfos_rows_total counter' in text
+    assert 'tfos_rows_total{node="worker:0"} 42' in text
+    assert 'tfos_queue_depth{node="worker:0"} 7' in text
+    assert 'tfos_step_seconds_bucket{le="0.5",node="worker:0"} 1' in text
+    assert 'tfos_step_seconds_bucket{le="+Inf",node="worker:0"} 1' in text
+    assert 'tfos_step_seconds_sum{node="worker:0"} 0.2' in text
+    assert 'tfos_step_seconds_count{node="worker:0"} 1' in text
+    # every non-comment line is "name{labels} value"
+    for line in text.strip().splitlines():
+        if not line.startswith("#"):
+            assert line.count(" ") == 1, line
+
+
+def test_merge_snapshots_sums_counters_histograms_keeps_gauges_per_node():
+    def one(n):
+        r = reg.Registry()
+        r.counter("rows").inc(n)
+        r.gauge("depth").set(n)
+        r.histogram("lat", buckets=(1.0,)).observe(n)
+        return r.snapshot()
+
+    merged = reg.merge_snapshots({"w0": one(1), "w1": one(10)})
+    assert merged["counters"]["rows"] == 11
+    assert merged["gauges"]["depth"] == {"w0": 1, "w1": 10}
+    assert merged["histograms"]["lat"]["count"] == 2
+    assert merged["histograms"]["lat"]["buckets"][-1] == ["+Inf", 2]
+    text = reg.merged_to_prometheus(merged)
+    assert "tfos_rows 11" in text
+    assert 'tfos_depth{node="w0"} 1' in text
+
+
+def test_metrics_reporter_carries_registry_and_aggregate_merges():
+    """The kv-published step-metrics snapshot carries the registry section,
+    and metrics.aggregate rolls registries up cluster-wide."""
+    from tensorflowonspark_tpu import metrics
+
+    class KV:
+        def __init__(self):
+            self.kv = {}
+
+        def set(self, k, v):
+            self.kv[k] = v
+
+    r = reg.Registry()
+    r.counter("trainer_steps_total").inc(5)
+    kv = KV()
+    rep = metrics.MetricsReporter(mgr=kv, interval=1, registry=r)
+    rep(loss=1.0, examples=4, dt=0.1)
+    snap = kv.kv["metrics"]
+    assert snap["registry"]["counters"]["trainer_steps_total"] == 5
+
+    agg = metrics.aggregate({"worker:0": snap,
+                             "worker:1": dict(snap)})
+    assert agg["registry"]["counters"]["trainer_steps_total"] == 10
+
+
+def test_trainer_steps_feed_the_default_registry():
+    """trainer.Trainer records step counters/histograms into the process
+    registry (the series TFCluster.metrics() aggregates)."""
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    before = obs.get_registry().snapshot()["counters"].get(
+        "trainer_steps_total", 0)
+    t = Trainer("mnist_mlp", config=mnist.Config.tiny())
+    batch = mnist.example_batch(t.config, batch_size=8)
+    t.step(batch)
+    t.step(batch)
+    after = obs.get_registry().snapshot()
+    assert after["counters"]["trainer_steps_total"] == before + 2
+    assert "trainer_step_seconds" in after["histograms"]

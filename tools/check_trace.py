@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Schema validator for emitted Chrome-trace files (fast, stdlib-only).
+
+Checks the artifact ``TFCluster.dump_trace`` / ``bench.py`` write:
+
+- top level is an object with a ``traceEvents`` list;
+- every event has a valid phase (``X`` complete span, ``i`` instant,
+  ``M`` metadata) and integer ``pid``/``tid``;
+- ``X`` events carry a name and non-negative numeric ``ts``/``dur``;
+- ``i`` events carry a name and numeric ``ts``;
+- every ``pid`` that owns events is named by a ``process_name`` metadata
+  event (the merged-node contract of ``obs.chrome.merge``);
+- non-metadata events are sorted by ``(ts, pid, tid, name)`` — the
+  determinism guarantee ``tests/test_obs.py`` relies on;
+- ``args``, when present, is a JSON object.
+
+Usage::
+
+    python tools/check_trace.py TRACE.json [TRACE2.json ...]
+
+Exit code 0 when every file validates, 1 otherwise (problems on stderr).
+Wired into tier-1 via ``tests/test_check_trace.py`` so a malformed event
+fails the suite, not a downstream trace viewer.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+VALID_PHASES = {"X", "i", "M"}
+
+
+def validate_doc(doc: object) -> list[str]:
+    """Validate a parsed trace document; returns a list of problems."""
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return [f"top level must be an object, got {type(doc).__name__}"]
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["missing/invalid 'traceEvents' (must be a list)"]
+
+    named_pids: set = set()
+    used_pids: set = set()
+    prev_key = None
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in VALID_PHASES:
+            problems.append(f"{where}: invalid phase {ph!r} "
+                            f"(expected one of {sorted(VALID_PHASES)})")
+            continue
+        for field in ("pid", "tid"):
+            if not isinstance(ev.get(field), int):
+                problems.append(f"{where}: {field!r} must be an int, "
+                                f"got {ev.get(field)!r}")
+        if "args" in ev and not isinstance(ev["args"], dict):
+            problems.append(f"{where}: 'args' must be an object")
+        if ph == "M":
+            if ev.get("name") == "process_name":
+                name = (ev.get("args") or {}).get("name")
+                if not isinstance(name, str) or not name:
+                    problems.append(
+                        f"{where}: process_name metadata without a name")
+                named_pids.add(ev.get("pid"))
+            continue
+        # X and i events
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            problems.append(f"{where}: missing event name")
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            problems.append(f"{where}: 'ts' must be a non-negative number, "
+                            f"got {ts!r}")
+            ts = 0.0
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(
+                    f"{where}: 'dur' must be a non-negative number on "
+                    f"complete events, got {dur!r}")
+        # only int pids join the named-pid cross-check: a missing/non-int
+        # pid was already reported above, and mixing None with ints would
+        # crash the sorted() in that check instead of reporting cleanly
+        if isinstance(ev.get("pid"), int):
+            used_pids.add(ev["pid"])
+        key = (float(ts), ev.get("pid") if isinstance(ev.get("pid"), int)
+               else 0, ev.get("tid") if isinstance(ev.get("tid"), int)
+               else 0, ev.get("name") or "")
+        if prev_key is not None and key < prev_key:
+            problems.append(
+                f"{where}: events out of (ts, pid, tid, name) order — "
+                "the merge is supposed to be deterministic")
+        prev_key = key
+
+    for pid in sorted(p for p in used_pids if p not in named_pids):
+        problems.append(
+            f"pid {pid} owns events but has no process_name metadata")
+    return problems
+
+
+def validate_file(path: str) -> list[str]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"cannot read/parse {path}: {e}"]
+    return validate_doc(doc)
+
+
+def main(argv: list[str]) -> int:
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    rc = 0
+    for path in argv:
+        problems = validate_file(path)
+        if problems:
+            rc = 1
+            for p in problems:
+                print(f"{path}: {p}", file=sys.stderr)
+        else:
+            print(f"{path}: OK")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
