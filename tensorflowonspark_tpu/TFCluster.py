@@ -55,6 +55,21 @@ class TFCluster:
         self._last_node_metrics: dict[str, dict] = {}
         #: (wall_time, aggregate) samples appended by the train-time poller
         self.metrics_history: list[tuple[float, dict]] = []
+        #: node error-queue messages drained eagerly (before the manager
+        #: orphan-watch grace window can reap the evidence)
+        self._node_error_cache: list[str] = []
+        #: cache index up to which messages were already attached to a
+        #: raised exception (so train() surfaces poller-drained evidence
+        #: exactly once instead of dropping or repeating it)
+        self._node_errors_surfaced = 0
+        #: anomaly keys already recorded as driver trace events (dedup)
+        self._reported_anomalies: set = set()
+        #: last state string seen per node (health() keeps a finished
+        #: node's verdict after its manager is reaped)
+        self._last_node_state: dict[str, str] = {}
+        #: last anomaly report from :meth:`check_anomalies`
+        self.last_anomaly_report: dict | None = None
+        self._obs_server = None
 
     # -- data plane --------------------------------------------------------
 
@@ -86,6 +101,24 @@ class TFCluster:
                                               feed_timeout, qname)
                         )
                     self._check_bootstrap_error()
+        except Exception as e:
+            # drain node error queues NOW: the evidence (a StepWatchdog
+            # stall attribution, a map_fun traceback) lives on managers
+            # whose orphan watch reaps them ~15 s after their trainer dies
+            # (ADVICE r5 #3) — by the time the user handles this exception
+            # it may be gone.  Attach every attribution not yet SURFACED
+            # in an exception: that includes messages the anomaly
+            # poller's node_died handler drained into the cache moments
+            # before the feed failed (fresh-only would drop exactly the
+            # watchdog's last words).  An unrelated exception with
+            # nothing new to attribute keeps its type.
+            self._drain_node_errors()
+            pending = self._node_error_cache[self._node_errors_surfaced:]
+            if pending:
+                self._node_errors_surfaced = len(self._node_error_cache)
+                detail = "".join(f"\n  node error: {m}" for m in pending)
+                raise RuntimeError(f"training failed{detail}") from e
+            raise
         finally:
             if poller is not None:
                 poller()
@@ -113,6 +146,10 @@ class TFCluster:
                     "cluster metrics: %s nodes, %s examples/sec, loss %s",
                     agg.get("num_reporting"),
                     agg.get("total_examples_per_sec"), agg.get("mean_loss"))
+                try:  # straggler/stall judgment rides every sample
+                    self.check_anomalies(agg)
+                except Exception as e:
+                    logger.warning("anomaly check failed: %s", e)
 
         t = threading.Thread(target=poll, daemon=True,
                              name="tfos-metrics-poller")
@@ -190,6 +227,12 @@ class TFCluster:
                     )
                 self._check_bootstrap_error()
         finally:
+            if self._obs_server is not None:
+                try:
+                    self._obs_server.stop()
+                except Exception:
+                    pass
+                self._obs_server = None
             self.server.stop()
 
     def _drain_and_stop_streaming(self, ssc, timeout: float, qname: str) -> None:
@@ -244,6 +287,20 @@ class TFCluster:
             except Exception as e:
                 logger.warning("metrics: node %s unreachable: %s", name, e)
                 snap = None
+            else:
+                # remember each node's lifecycle state while its manager
+                # is reachable: health() consults this memo so a node
+                # that finished cleanly and was then reaped reads
+                # "finished", not a 503-triggering "unreachable" (the
+                # train-time poller calls this every sample, keeping the
+                # memo fresher than /healthz's own scrape cadence).  Own
+                # try: a failure HERE must not void the good snapshot.
+                try:
+                    state = mgr.get("state")
+                    if state:
+                        self._last_node_state[name] = state
+                except Exception:
+                    pass
             if snap:
                 per_node[name] = dict(snap)
                 self._last_node_metrics[name] = dict(snap)
@@ -310,6 +367,16 @@ class TFCluster:
         point of a trace); executor-side buffers are cleared when a reused
         worker bootstraps a new cluster, so node tracks never mix runs.
         """
+        by_node = self._trace_events_by_node()
+        logger.info("dump_trace: %d nodes, %d events → %s", len(by_node),
+                    sum(len(v) for v in by_node.values()), path)
+        return obs.chrome.write(path, by_node)
+
+    def _trace_events_by_node(self) -> dict[str, list[dict]]:
+        """Driver buffer + every reachable node's shipped trace events —
+        the shared collection step behind :meth:`dump_trace`, the
+        ``/trace`` endpoint, and stall attribution
+        (:meth:`check_anomalies`)."""
         from tensorflowonspark_tpu import TFManager
 
         tracer = obs.get_tracer()
@@ -321,13 +388,236 @@ class TFCluster:
                 mgr = TFManager.connect(tuple(meta["addr"]), authkey)
                 shipped = obs.collect_blackboard(mgr.kv_snapshot())
             except Exception as e:
-                logger.warning("dump_trace: node %s unreachable: %s", name, e)
+                logger.warning("trace collect: node %s unreachable: %s",
+                               name, e)
                 continue
             for node, events in shipped.items():
                 by_node.setdefault(node, []).extend(events)
-        logger.info("dump_trace: %d nodes, %d events → %s", len(by_node),
-                    sum(len(v) for v in by_node.values()), path)
-        return obs.chrome.write(path, by_node)
+        return by_node
+
+    # -- anomaly attribution -------------------------------------------------
+
+    def check_anomalies(self, agg: dict | None = None, *,
+                        factor: float = 1.75,
+                        stall_after_s: float = 60.0,
+                        scan_traces: bool | None = None) -> dict:
+        """Judge the cluster for stragglers and stalls; returns the report.
+
+        Straggler detection runs over the per-node step-time histograms
+        already riding the metrics publications
+        (:func:`tensorflowonspark_tpu.obs.anomaly.detect`); stall
+        attribution additionally scans the shipped trace events for the
+        StepWatchdog's ``health.step_stall`` last words.  Each *new*
+        finding is recorded once as a driver trace event
+        (``anomaly.straggler`` / ``anomaly.stall``) and logged at WARNING
+        — so a degraded run's trace and logs name the sick node instead
+        of leaving a bare dead executor.  Runs automatically on every
+        train-time metrics-poll sample.
+
+        ``scan_traces`` controls the expensive half (pulling every node's
+        kv blackboard to look for shipped ``health.step_stall`` events):
+        default (None) scans only when the cheap judgment over the
+        already-collected aggregate found something to attribute — a
+        healthy poll tick costs no extra RPCs.  Pass True to force a scan
+        (post-mortem inspection), False to skip it.
+        """
+        import time as _time
+
+        from tensorflowonspark_tpu.obs import anomaly
+
+        if agg is None:
+            agg = self.metrics()
+        # a single LIVE reporting node has no peer to lag behind: judge
+        # its heartbeat against the driver's wall clock instead.  Stale
+        # (finished, manager-reaped) nodes' gauges linger in the merge
+        # and must not count as peers — a sole survivor wedging after its
+        # peers finished would otherwise never be judged.  Multi-node
+        # keeps peer comparison, which stays quiet through collective
+        # pauses like a cluster-wide recompile (tradeoff: with exactly
+        # one live reporter the wall clock can flag a >stall_after_s
+        # feed/compile pause as a stall — a WARNING, not a kill).
+        heartbeats = ((agg.get("registry") or {}).get("gauges") or {}).get(
+            anomaly.LAST_STEP_GAUGE) or {}
+        stale_nodes = {n for n, s in (agg.get("nodes") or {}).items()
+                       if s and s.get("stale")}
+        live_heartbeats = {n: ts for n, ts in heartbeats.items()
+                           if n not in stale_nodes}
+        now = _time.time() if len(live_heartbeats) == 1 else None
+        report = anomaly.detect(agg, factor=factor,
+                                stall_after_s=stall_after_s, now=now)
+        # a node whose manager became unreachable WITHOUT reporting
+        # "finished" died mid-run (watchdog os._exit, executor loss): the
+        # shipped evidence is on a ~15 s fuse (orphan-watch grace), so
+        # attribute NOW rather than waiting out the heartbeat window
+        report["died"] = [
+            {"node": n, "last_state": self._last_node_state.get(n,
+                                                                "unknown")}
+            for n, s in sorted((agg.get("nodes") or {}).items())
+            if s and s.get("stale")
+            and self._last_node_state.get(n) != "finished"]
+        if scan_traces is None:
+            # only a finding not yet reported justifies the RPCs: a node
+            # that STAYS stalled would otherwise re-pull every blackboard
+            # on every poll tick for the rest of the run
+            scan_traces = any(
+                (kind, f["node"]) not in self._reported_anomalies
+                for kind, findings in (("straggler", report["stragglers"]),
+                                       ("stalled", report["stalled"]),
+                                       ("died", report["died"]))
+                for f in findings)
+        report["stall_events"] = []
+        if scan_traces:
+            try:
+                report["stall_events"] = anomaly.stall_events(
+                    self._trace_events_by_node())
+            except Exception as e:
+                logger.warning("stall-event collection failed: %s", e)
+        for s in report["stragglers"]:
+            key = ("straggler", s["node"])
+            if key not in self._reported_anomalies:
+                self._reported_anomalies.add(key)
+                logger.warning(
+                    "straggler: node %s step-time %s %.1fx the cluster "
+                    "median (p50 %.4fs vs %.4fs)", s["node"],
+                    "/".join(s["quantiles_flagged"]), s["ratio"],
+                    s["p50"], s["cluster_p50"])
+                obs.event("anomaly.straggler", **s)
+        for s in report["stalled"]:
+            key = ("stalled", s["node"])
+            if key not in self._reported_anomalies:
+                self._reported_anomalies.add(key)
+                logger.warning("stalled: node %s last step %.0fs behind "
+                               "the freshest node", s["node"], s["behind_s"])
+                obs.event("anomaly.stall", **s)
+        for s in report["died"]:
+            key = ("died", s["node"])
+            if key not in self._reported_anomalies:
+                self._reported_anomalies.add(key)
+                logger.warning(
+                    "node %s became unreachable without finishing (last "
+                    "state: %s) — draining its error queue for the "
+                    "attribution before the evidence is reaped",
+                    s["node"], s["last_state"])
+                obs.event("anomaly.node_died", **s)
+                try:  # preserve error-queue evidence while it exists
+                    self._drain_node_errors()
+                except Exception:
+                    pass
+        for s in report["stall_events"]:
+            key = ("stall_event", s["node"], s.get("ts"))
+            if key not in self._reported_anomalies:
+                self._reported_anomalies.add(key)
+                logger.warning("watchdog stall on node %s: %s", s["node"],
+                               s["reason"])
+                obs.event("anomaly.stall", node=s["node"],
+                          reason=s["reason"], stalled_s=s.get("stalled_s"))
+        self.last_anomaly_report = report
+        return report
+
+    # -- live endpoint -------------------------------------------------------
+
+    def health(self, key: str = "state",
+               node_timeout_s: float = 5.0) -> dict:
+        """Node-health rollup from the per-node kv blackboards.
+
+        ``{"status": "ok"|"degraded", "nodes": {name: state}}`` — a node
+        is unhealthy when unreachable or in state ``"failed"``.  Each
+        node read is bounded by ``node_timeout_s`` (a black-holed host
+        must not hang every ``/healthz`` scrape for the kernel TCP
+        timeout), and a node that was last seen ``"finished"`` before its
+        manager went away reports ``"finished"`` instead of flipping a
+        *completed* run to a permanent 503.
+        """
+        import threading
+        import time as _time
+
+        from tensorflowonspark_tpu import TFManager
+
+        authkey = bytes.fromhex(self.cluster_meta["authkey_hex"])
+        results: dict[str, str] = {}
+
+        def read_state(name, meta) -> None:
+            try:
+                results[name] = TFManager.connect(
+                    tuple(meta["addr"]), authkey).get(key) or "unknown"
+            except Exception:
+                pass  # absent result = unreachable
+
+        threads = {}
+        for meta in self.cluster_info:
+            name = f"{meta['job_name']}:{meta['task_index']}"
+            # daemon threads: one blocked on a black-holed host must hold
+            # hostage neither this scrape nor interpreter exit
+            t = threading.Thread(target=read_state, args=(name, meta),
+                                 name=f"tfos-health-{name}", daemon=True)
+            t.start()
+            threads[name] = t
+        deadline = _time.monotonic() + node_timeout_s
+        nodes: dict[str, str] = {}
+        healthy = True
+        for name, t in threads.items():
+            t.join(timeout=max(0.0, deadline - _time.monotonic()))
+            state = results.get(name)
+            if state is not None:
+                self._last_node_state[name] = state
+            elif self._last_node_state.get(name) == "finished":
+                # unreachable, but its last word was "finished": the run
+                # completed cleanly and the manager was reaped — not a
+                # reason to flip a healthy endpoint to a permanent 503
+                state = "finished"
+            else:
+                state = "unreachable"
+                healthy = False
+            if state == "failed":
+                healthy = False
+            nodes[name] = state
+        return {"status": "ok" if healthy else "degraded", "nodes": nodes,
+                "num_nodes": len(nodes)}
+
+    def serve_observability(self, port: int = 0, host: str = "127.0.0.1"):
+        """Start the live driver HTTP endpoint; returns the server.
+
+        Routes (stdlib ``http.server`` thread, no new dependencies):
+        ``/metrics`` → Prometheus text of :meth:`metrics_prometheus`,
+        ``/healthz`` → JSON from :meth:`health` (HTTP 503 when degraded),
+        ``/trace`` → the merged Chrome-trace document (the
+        :meth:`dump_trace` content, served live).  The returned server
+        exposes ``.port`` / ``.url(path)`` / ``.stop()``; it is stopped
+        automatically by :meth:`shutdown`.
+        """
+        import json as _json
+
+        from tensorflowonspark_tpu.obs import httpd
+
+        def _metrics():
+            return (200, httpd.PROMETHEUS_CONTENT_TYPE,
+                    self.metrics_prometheus())
+
+        def _healthz():
+            doc = self.health()
+            return (200 if doc["status"] == "ok" else 503,
+                    "application/json", _json.dumps(doc))
+
+        def _trace():
+            doc = obs.chrome.merge(self._trace_events_by_node())
+            return (200, "application/json", _json.dumps(doc))
+
+        if self._obs_server is not None:
+            # re-serving (e.g. to move ports) must not leak the previous
+            # listener thread + socket until process exit
+            try:
+                self._obs_server.stop()
+            except Exception:
+                pass
+            self._obs_server = None
+        server = httpd.ObservabilityServer(
+            {"/metrics": _metrics, "/healthz": _healthz, "/trace": _trace},
+            host=host, port=port)
+        addr = server.start()
+        logger.info("observability endpoint serving on http://%s:%s "
+                    "(/metrics /healthz /trace)", *addr)
+        self._obs_server = server
+        return server
 
     def tensorboard_url(self, timeout: float = 0.0) -> str | None:
         """URL of the cluster's TensorBoard, if one was started.
@@ -358,6 +648,7 @@ class TFCluster:
             detail = ""
             for msg in self._drain_node_errors():
                 detail += f"\n  node error: {msg}"
+            self._node_errors_surfaced = len(self._node_error_cache)
             raise RuntimeError(
                 "cluster bootstrap/training job failed" + detail
             ) from self._thread_error[0]
@@ -367,10 +658,37 @@ class TFCluster:
         attributed its own death (e.g. the mid-run wedge watchdog's
         ``ctx.report_error`` before ``os._exit``) names itself in the
         driver's exception instead of leaving only the substrate's generic
-        'executor died' message."""
+        'executor died' message.
+
+        Drained messages are *cached* on the cluster (the queues are
+        consumed destructively, and the node managers themselves are
+        reaped by the orphan watch ~15 s after their trainer dies) —
+        whoever drains first preserves the evidence for every later
+        caller.  The bootstrap job thread drains eagerly the moment it
+        fails (ADVICE r5 #3), so the attribution survives even when the
+        driver only inspects the error minutes later.
+        """
         from tensorflowonspark_tpu import TFManager
 
-        msgs = []
+        msgs = list(self._node_error_cache)
+        seen = set(msgs)
+
+        def add(msg) -> None:
+            if isinstance(msg, str) and msg not in seen:
+                seen.add(msg)
+                self._node_error_cache.append(msg)
+                msgs.append(msg)
+
+        # durable copies first: ctx.report_error mirrors every attributed
+        # failure onto the rendezvous kv (this process!), which outlives
+        # the node managers — a watchdog stall is recoverable here even
+        # minutes after the orphan watch reaped the node's queue
+        try:
+            for value in self.server.kv_items("node_error:").values():
+                for msg in (value if isinstance(value, list) else [value]):
+                    add(msg)
+        except Exception:
+            pass
         try:
             authkey = bytes.fromhex(self.cluster_meta["authkey_hex"])
         except Exception:
@@ -380,7 +698,7 @@ class TFCluster:
                 q = TFManager.connect(
                     tuple(meta["addr"]), authkey).get_queue("error")
                 while True:  # drain until Empty (raises) or manager gone
-                    msgs.append(q.get(block=False))
+                    add(q.get(block=False))
             except Exception:
                 continue
         return msgs
@@ -495,6 +813,17 @@ def run(
         except BaseException as e:  # surfaced via _check_bootstrap_error
             logger.error("cluster bootstrap job failed: %s", e)
             thread_error.append(e)
+            # drain the node error queues NOW, while their managers are
+            # still alive: the orphan watch reaps a dead trainer's manager
+            # after ~15 s, and with it the stall/stacktrace attribution
+            # (ADVICE r5 #3).  Cached on the cluster for
+            # _check_bootstrap_error to attach later.
+            cluster = cluster_holder.get("cluster")
+            if cluster is not None:
+                try:
+                    cluster._drain_node_errors()
+                except Exception:
+                    pass
 
     t = threading.Thread(target=_bootstrap_job, name="tfos-bootstrap", daemon=True)
     t.start()
@@ -533,4 +862,5 @@ def run(
 
     cluster = TFCluster(sc, cluster_meta, cluster_info, server, input_mode, t)
     cluster._thread_error = thread_error
+    cluster_holder["cluster"] = cluster  # lets the job thread drain eagerly
     return cluster

@@ -67,6 +67,8 @@ class TFNodeContext:
         cluster_info: list[dict[str, Any]],
         cluster_id: str,
         num_ps: int = 0,
+        server_addr: tuple[str, int] | list | None = None,
+        auth_token: str | None = None,
     ):
         self.executor_id = executor_id
         self.job_name = job_name
@@ -79,6 +81,11 @@ class TFNodeContext:
         self.cluster_info = cluster_info
         self.cluster_id = cluster_id
         self.num_ps = num_ps
+        #: driver-side rendezvous endpoint — report_error's DURABLE sink
+        #: (the rendezvous kv outlives this node's own manager)
+        self.server_addr = tuple(server_addr) if server_addr else None
+        self.auth_token = auth_token
+        self._durable_errors: list[str] = []
         self._mgr = None
 
     @property
@@ -113,13 +120,41 @@ class TFNodeContext:
 
     def report_error(self, message: str) -> None:
         """Push an attributed failure onto this node's error queue (the
-        queue the driver re-raises from at ``train``/``shutdown``).  Wire
-        it as ``Trainer(error_sink=ctx.report_error)`` so the mid-run wedge
+        queue the driver re-raises from at ``train``/``shutdown``) AND
+        onto the driver-side rendezvous kv.  Wire it as
+        ``Trainer(error_sink=ctx.report_error)`` so the mid-run wedge
         watchdog (``health.StepWatchdog``) names the sick executor before
-        hard-exiting the trainer process."""
-        self.mgr.get_queue("error").put(
-            f"executor {self.executor_id} ({self.job_name}:{self.task_index})"
-            f": {message}")
+        hard-exiting the trainer process.
+
+        The rendezvous copy is the DURABLE one: the error queue lives in
+        this node's manager, which the orphan watch reaps ~15 s after the
+        trainer dies — a driver that looks minutes later would find
+        nothing.  The rendezvous server runs in the driver process and
+        lives until ``TFCluster.shutdown``, so
+        ``TFCluster._drain_node_errors`` can always recover the
+        attribution from ``node_error:<job>:<idx>`` there.
+        """
+        msg = (f"executor {self.executor_id} "
+               f"({self.job_name}:{self.task_index}): {message}")
+        try:
+            self.mgr.get_queue("error").put(msg)
+        except Exception:
+            pass  # manager may already be gone; the durable path remains
+        self._report_durable(msg)
+
+    def _report_durable(self, msg: str) -> None:
+        """Best-effort publish onto the rendezvous kv (never raises)."""
+        if not (self.server_addr and self.auth_token):
+            return
+        try:
+            from tensorflowonspark_tpu import reservation
+
+            self._durable_errors.append(msg)
+            reservation.Client(self.server_addr, self.auth_token).put(
+                f"node_error:{self.job_name}:{self.task_index}",
+                list(self._durable_errors))
+        except Exception:
+            pass  # best-effort: never mask the original failure
 
     def __getstate__(self):
         state = self.__dict__.copy()
@@ -197,11 +232,17 @@ def _run_map_fun(fn_blob: bytes, args_blob: bytes, ctx: TFNodeContext,
 
         tb = traceback.format_exc()
         logger.error("map_fun failed on executor %s:\n%s", ctx.executor_id, tb)
+        # the SAME prefixed text on both channels: the driver's drain
+        # dedups by exact string, and the durable rendezvous copy must
+        # collapse with the queue copy, not double the traceback
+        msg = (f"executor {ctx.executor_id} "
+               f"({ctx.job_name}:{ctx.task_index}): {tb}")
         try:
-            mgr.get_queue("error").put(tb)
+            mgr.get_queue("error").put(msg)
             mgr.set("state", "failed")
         except Exception:
             pass
+        ctx._report_durable(msg)
         raise
     finally:
         obs.flush(mgr)
@@ -211,6 +252,9 @@ def _background_main(fn_blob: bytes, args_blob: bytes, ctx: TFNodeContext) -> No
     """Entry point of the spawned trainer process (SPARK input mode)."""
     util.ensure_jax_platform()
     mgr = ctx.mgr
+    # start tick BEFORE pid: the orphan watch keys liveness on the pair,
+    # and a pid without its tick degrades to the reusable pid-only check
+    mgr.set("trainer_pid_start", TFManager.proc_start_time(os.getpid()))
     mgr.set("trainer_pid", os.getpid())
     mgr.set("state", "running")
     # the spawned trainer is a fresh process: give its tracer the node
@@ -348,6 +392,8 @@ class _MapFn:
             cluster_info=cluster_info,
             cluster_id=cluster_id,
             num_ps=meta.get("num_ps", 0),
+            server_addr=meta.get("server_addr"),
+            auth_token=meta.get("auth_token"),
         )
 
         if self.tensorboard and job_name in ("chief", "worker") and task_index == 0:
@@ -367,7 +413,10 @@ class _MapFn:
             # the manager's orphan watch keys liveness to this pid: the
             # bootstrap worker may be reaped long before the trainer is
             # done (spark.python.worker.reuse=false), and the data plane
-            # must outlive the worker, not the trainer
+            # must outlive the worker, not the trainer.  The start tick
+            # rides along so a recycled pid cannot impersonate the trainer
+            # (TFManager._pid_alive)
+            mgr.set("trainer_pid_start", TFManager.proc_start_time(p.pid))
             mgr.set("trainer_pid", p.pid)
             logger.info(
                 "executor %s: trainer started in background pid %s", executor_id, p.pid
@@ -379,6 +428,8 @@ class _MapFn:
         else:
             util.ensure_jax_platform()
             mgr.set("state", "running")
+            mgr.set("trainer_pid_start",
+                    TFManager.proc_start_time(os.getpid()))
             mgr.set("trainer_pid", os.getpid())
             _run_map_fun(self.fn_blob, self.args_blob, ctx, mgr)
 

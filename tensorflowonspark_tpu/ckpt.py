@@ -74,7 +74,13 @@ def load_pytree(path: str, target: Any | None = None) -> Any:
             import numpy as np
 
             ckptr = _checkpointer()
-            meta_tree = ckptr.metadata(path).item_metadata.tree
+            # orbax API drift: PyTreeCheckpointer.metadata returns the
+            # metadata tree directly (≤0.7-era), or an object carrying it
+            # under .item_metadata.tree (newer composite handlers)
+            meta_tree = ckptr.metadata(path)
+            item_md = getattr(meta_tree, "item_metadata", None)
+            if item_md is not None:
+                meta_tree = getattr(item_md, "tree", item_md)
             restore_args = jax.tree.map(
                 lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta_tree)
             return ckptr.restore(

@@ -165,8 +165,13 @@ class StepWatchdog:
             try:
                 from tensorflowonspark_tpu import obs
 
+                # the attributed record the driver's anomaly detector
+                # (obs.anomaly.stall_events) later lifts off the
+                # blackboard: pid + timings, not just a reason string
+                obs.counter("watchdog_stalls_total").inc()
                 obs.event("health.step_stall", reason=reason,
-                          stalled_s=round(stalled, 1))
+                          stalled_s=round(stalled, 1), pid=os.getpid(),
+                          timeout_s=self.timeout_s)
                 obs.flush()  # last chance before the hard exit below
             except Exception:
                 pass

@@ -159,4 +159,11 @@ def aggregate(node_metrics: dict[str, dict[str, Any]]) -> dict[str, Any]:
         from tensorflowonspark_tpu import obs
 
         out["registry"] = obs.merge_snapshots(registries)
+        # per-node step-time p50/p95 straight in the rollup: the merged
+        # registry sums histograms cluster-wide, but straggler judgment
+        # (obs.anomaly) and operators both need the PER-NODE view without
+        # digging through raw buckets
+        quantiles = obs.anomaly.step_time_quantiles(out)
+        if quantiles:
+            out["step_time_quantiles"] = quantiles
     return out

@@ -16,6 +16,17 @@ reference had "Python logging ... no metrics registry"):
   step metrics and aggregated by ``TFCluster.metrics()`` /
   ``TFCluster.metrics_prometheus()``.
 
+Plus the measurement-integrity layer on top (ISSUE 3 tentpole):
+
+- **roofline probes** (:mod:`.roofline`) — in-run delivered HBM and
+  interconnect bandwidth measurements, stamped into every BENCH JSON and
+  mirrored as registry gauges;
+- **anomaly attribution** (:mod:`.anomaly`) — driver-side straggler /
+  stall detection over the shipped per-node step-time histograms
+  (``TFCluster.check_anomalies()``);
+- **live endpoint** (:mod:`.httpd`) — ``TFCluster.serve_observability``'s
+  stdlib HTTP server (``/metrics`` Prometheus, ``/healthz``, ``/trace``).
+
 Instrumented out of the box: cluster lifecycle (``TFCluster`` /
 ``TFSparkNode`` bootstrap, reserve, probe, shutdown), the trainer
 (``trainer.Trainer`` init + step counters, optional ``jax.profiler`` step
@@ -26,7 +37,12 @@ writes a trace artifact even for degraded runs, attributing the probe
 timeout).  ``TFOS_TRACE=0`` disables recording.
 """
 
-from tensorflowonspark_tpu.obs import chrome  # noqa: F401
+from tensorflowonspark_tpu.obs import (  # noqa: F401
+    anomaly,
+    chrome,
+    httpd,
+    roofline,
+)
 from tensorflowonspark_tpu.obs.registry import (  # noqa: F401
     Counter,
     Gauge,
@@ -52,7 +68,7 @@ from tensorflowonspark_tpu.obs.trace import (  # noqa: F401
 )
 
 __all__ = [
-    "chrome",
+    "anomaly", "chrome", "httpd", "roofline",
     "Counter", "Gauge", "Histogram", "Registry",
     "counter", "gauge", "histogram", "get_registry",
     "merge_snapshots", "merged_to_prometheus", "snapshot_to_prometheus",

@@ -1,0 +1,173 @@
+"""Live observability endpoint: a stdlib ``http.server`` thread.
+
+``TFCluster.serve_observability(port)`` mounts the driver's live views on
+a plain ThreadingHTTPServer — no framework dependency, matching the
+reference's "bring your own serving" posture while still giving operators
+(and Prometheus) a scrape target during a run instead of only post-mortem
+artifacts:
+
+- ``GET /metrics``  → Prometheus text exposition (v0.0.4) of the merged
+  cluster metrics (``TFCluster.metrics_prometheus()``);
+- ``GET /healthz``  → JSON node-health rollup from the per-node kv
+  blackboards; HTTP 200 when every node is reachable and un-failed,
+  503 otherwise (load-balancer semantics);
+- ``GET /trace``    → the merged Chrome-trace JSON document
+  (``TFCluster.dump_trace`` content, without touching disk).
+
+The server itself is generic: routes are ``{path: callable}`` where each
+callable returns ``(status_code, content_type, body)``.  A handler that
+raises becomes a 500 with the error text — the endpoint must never take
+the driver down.  Request logging goes to the module logger at DEBUG (the
+default ``BaseHTTPRequestHandler`` stderr spam would pollute driver logs).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+
+logger = logging.getLogger(__name__)
+
+#: content type for Prometheus text exposition format
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+Route = Callable[[], tuple[int, str, Any]]
+
+
+class ObservabilityServer:
+    """Threaded HTTP server over a route table; start() → (host, port)."""
+
+    def __init__(self, routes: dict[str, Route], host: str = "127.0.0.1",
+                 port: int = 0):
+        self.routes = dict(routes)
+        self._host = host
+        self._port = port
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> tuple[str, int]:
+        routes = self.routes
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                route = routes.get(path)
+                if route is None:
+                    body = json.dumps(
+                        {"error": "not found",
+                         "routes": sorted(routes)}).encode()
+                    self._reply(404, "application/json", body)
+                    return
+                try:
+                    status, ctype, body = route()
+                except Exception as e:  # endpoint must never kill the driver
+                    logger.warning("observability route %s failed: %s",
+                                   path, e)
+                    self._reply(500, "text/plain; charset=utf-8",
+                                f"handler error: {e}".encode())
+                    return
+                if isinstance(body, str):
+                    body = body.encode()
+                self._reply(status, ctype, body)
+
+            def _reply(self, status: int, ctype: str, body: bytes) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt: str, *args: Any) -> None:
+                logger.debug("observability http: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tfos-observability-http",
+            daemon=True)
+        self._thread.start()
+        return self.address
+
+    @property
+    def address(self) -> tuple[str, int]:
+        if self._httpd is None:
+            raise RuntimeError("server not started")
+        return self._httpd.server_address[:2]
+
+    @property
+    def port(self) -> int:
+        return self.address[1]
+
+    def url(self, path: str = "/") -> str:
+        host, port = self.address
+        return f"http://{host}:{port}{path}"
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+def validate_prometheus_text(text: str) -> list[str]:
+    """Schema-check Prometheus text exposition; returns problems.
+
+    The ``tools/check_trace.py``-style gate for the ``/metrics`` route:
+    every non-comment line must parse as ``name{labels} value``, every
+    ``# TYPE`` names a known type, no metric family gets two TYPE lines
+    (the text-format violation scrapers reject), and every sample's family
+    was declared.  Empty exposition is valid (no instruments yet).
+    """
+    import re
+
+    problems: list[str] = []
+    typed: dict[str, str] = {}
+    sample_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+    for i, line in enumerate(text.splitlines()):
+        line = line.rstrip()
+        if not line:
+            continue
+        where = f"line {i + 1}"
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 2 and parts[1] == "TYPE":
+                if len(parts) < 4 or parts[3] not in (
+                        "counter", "gauge", "histogram", "summary",
+                        "untyped"):
+                    problems.append(f"{where}: malformed TYPE comment")
+                    continue
+                name = parts[2]
+                if name in typed:
+                    problems.append(
+                        f"{where}: duplicate TYPE for {name} "
+                        "(one family, one TYPE line)")
+                typed[name] = parts[3]
+            continue
+        m = sample_re.match(line)
+        if not m:
+            problems.append(f"{where}: unparseable sample {line!r}")
+            continue
+        value = m.group(3)
+        if value not in ("+Inf", "-Inf", "NaN"):
+            try:
+                float(value)
+            except ValueError:
+                problems.append(
+                    f"{where}: non-numeric sample value {value!r}")
+        name = m.group(1)
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in typed:
+                base = name[: -len(suffix)]
+                break
+        if base not in typed:
+            problems.append(f"{where}: sample {name!r} has no TYPE "
+                            "declaration")
+    return problems

@@ -192,6 +192,17 @@ class Server:
         with self._kv_lock:
             return self._kv.get(key, default)
 
+    def kv_items(self, prefix: str = "") -> dict[str, Any]:
+        """In-process snapshot of kv entries under ``prefix`` (driver
+        side).  Lets the driver enumerate per-node keys it cannot name in
+        advance — e.g. the durable ``node_error:<job>:<idx>`` attributions
+        nodes publish here precisely because this kv OUTLIVES their own
+        managers (the orphan watch reaps a dead trainer's blackboard
+        after ~15 s; this server lives until ``TFCluster.shutdown``)."""
+        with self._kv_lock:
+            return {k: v for k, v in self._kv.items()
+                    if k.startswith(prefix)}
+
     def stop(self) -> None:
         self._stop.set()
         if self._listener is not None:

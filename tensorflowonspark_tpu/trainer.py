@@ -258,6 +258,10 @@ class Trainer:
             obs.counter("trainer_examples_total").inc(n)
         if dt > 0:
             obs.histogram("trainer_step_seconds").observe(dt)
+        # wall-clock heartbeat for the driver's stall detector
+        # (obs.anomaly): a node whose gauge falls behind the freshest
+        # peer is wedged — visible from the rollup without any new RPC
+        obs.gauge("trainer_last_step_unix_ts").set(time.time())
         for cb in self._step_callbacks:
             cb(loss, n, dt)
         return loss

@@ -1,0 +1,116 @@
+"""Driver-side straggler / stall attribution (obs.anomaly)."""
+
+import pytest
+
+from tensorflowonspark_tpu import metrics as metrics_lib, obs
+from tensorflowonspark_tpu.obs import anomaly
+
+
+def _node_snapshot(step_seconds, registry=None, extra_gauges=None):
+    """Fabricate one node's published snapshot: a registry whose
+    trainer_step_seconds saw ``step_seconds`` observations."""
+    reg = registry or obs.Registry()
+    h = reg.histogram("trainer_step_seconds")
+    for s in step_seconds:
+        h.observe(s)
+    for name, v in (extra_gauges or {}).items():
+        reg.gauge(name).set(v)
+    return {"step": len(step_seconds), "loss": 0.5,
+            "examples_per_sec": 100.0, "total_examples": 100,
+            "registry": reg.snapshot()}
+
+
+def test_hist_quantile_interpolates():
+    buckets = [[0.01, 0], [0.05, 8], [0.1, 10], ["+Inf", 10]]
+    assert anomaly.hist_quantile(buckets, 0.5) == pytest.approx(0.035)
+    assert anomaly.hist_quantile(buckets, 0.95) == pytest.approx(0.0875)
+    assert anomaly.hist_quantile([], 0.5) is None
+    assert anomaly.hist_quantile([["+Inf", 0]], 0.5) is None
+
+
+def test_uniform_cluster_stays_quiet():
+    agg = metrics_lib.aggregate({
+        f"worker:{i}": _node_snapshot([0.010] * 20) for i in range(4)})
+    report = anomaly.detect(agg)
+    assert report["stragglers"] == []
+    assert report["stalled"] == []
+    assert report["num_nodes"] == 4
+    # the per-node quantiles surfaced in the rollup itself too
+    assert set(agg["step_time_quantiles"]) == {f"worker:{i}"
+                                               for i in range(4)}
+
+
+def test_synthetic_slow_node_is_flagged():
+    nodes = {f"worker:{i}": _node_snapshot([0.010] * 20) for i in range(3)}
+    nodes["worker:3"] = _node_snapshot([0.100] * 20)  # 10x the peers
+    report = anomaly.detect(metrics_lib.aggregate(nodes))
+    assert [s["node"] for s in report["stragglers"]] == ["worker:3"]
+    s = report["stragglers"][0]
+    assert "p50" in s["quantiles_flagged"]
+    assert s["ratio"] > 2.0
+    assert s["cluster_p50"] < s["p50"]
+
+
+def test_single_node_and_cold_nodes_not_judged():
+    # one node has no peers to deviate from; a 2-step node is still
+    # compiling — neither may be flagged
+    report = anomaly.detect(metrics_lib.aggregate(
+        {"worker:0": _node_snapshot([5.0] * 20)}))
+    assert report["stragglers"] == []
+    report = anomaly.detect(metrics_lib.aggregate({
+        "worker:0": _node_snapshot([0.01] * 20),
+        "worker:1": _node_snapshot([9.0, 9.0]),  # < min_count steps
+    }))
+    assert report["stragglers"] == []
+
+
+def test_stalled_node_detected_from_heartbeat_gauge():
+    nodes = {
+        "worker:0": _node_snapshot(
+            [0.01] * 10,
+            extra_gauges={"trainer_last_step_unix_ts": 1000.0}),
+        "worker:1": _node_snapshot(
+            [0.01] * 10,
+            extra_gauges={"trainer_last_step_unix_ts": 1200.0}),
+    }
+    report = anomaly.detect(metrics_lib.aggregate(nodes), stall_after_s=60.0)
+    assert [s["node"] for s in report["stalled"]] == ["worker:0"]
+    assert report["stalled"][0]["behind_s"] == pytest.approx(200.0)
+    # within the window → quiet
+    report = anomaly.detect(metrics_lib.aggregate(nodes),
+                            stall_after_s=300.0)
+    assert report["stalled"] == []
+
+
+def test_finished_stale_node_not_reported_stalled():
+    """A node whose manager died AFTER finishing keeps its last snapshot
+    (stale-marked) — its old heartbeat is a completed run, not a stall."""
+    nodes = {
+        "worker:0": _node_snapshot(
+            [0.01] * 10,
+            extra_gauges={"trainer_last_step_unix_ts": 1000.0}),
+        "worker:1": _node_snapshot(
+            [0.01] * 10,
+            extra_gauges={"trainer_last_step_unix_ts": 1200.0}),
+    }
+    nodes["worker:0"]["stale"] = True  # finished early, uneven shards
+    report = anomaly.detect(metrics_lib.aggregate(nodes),
+                            stall_after_s=60.0)
+    assert report["stalled"] == []
+
+
+def test_stall_events_extracted_from_shipped_trace():
+    events_by_node = {
+        "worker:1": [
+            {"name": "node.map_fun", "ph": "X", "ts": 1.0},
+            {"name": "health.step_stall", "ph": "i", "ts": 9.0,
+             "attrs": {"reason": "train step stalled for 33s",
+                       "stalled_s": 33.1}},
+        ],
+        "worker:0": [{"name": "trainer.init", "ph": "X", "ts": 2.0}],
+    }
+    stalls = anomaly.stall_events(events_by_node)
+    assert len(stalls) == 1
+    assert stalls[0]["node"] == "worker:1"
+    assert "stalled for 33s" in stalls[0]["reason"]
+    assert stalls[0]["stalled_s"] == 33.1

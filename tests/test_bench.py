@@ -51,6 +51,9 @@ class TestOutageProofing(unittest.TestCase):
                 "TFOS_BENCH_SIMULATE_HANG": "99",
                 "TFOS_BENCH_PROBE_TIMEOUT_S": "5",
                 "TFOS_BENCH_WALL_BUDGET_S": str(budget),
+                # small roofline working set: the probe must STILL run in
+                # the fallback children, just cheaply
+                "TFOS_ROOFLINE_BYTES": str(4 * 1024 * 1024),
             },
             timeout=budget + 60,
         )
@@ -64,6 +67,12 @@ class TestOutageProofing(unittest.TestCase):
             self.assertGreater(half["value"], 0.0)
             self.assertIn("metric", half)
             self.assertIn("vs_baseline", half)
+            # ISSUE 3 acceptance: EVERY run — including degraded/CPU
+            # fallback — emits the roofline fields beside the number;
+            # the fallback measured its own (CPU) delivered bandwidth
+            self.assertIn("mem_bw_gbps", half)
+            self.assertIn("ici_bw_gbps", half)
+            self.assertGreater(half["mem_bw_gbps"], 0.0)
         # both probe verdicts are carried in the artifact for the judge
         self.assertFalse(result["probe"]["ok"])
         self.assertFalse(result["probe"]["reprobe"]["ok"])
@@ -90,6 +99,7 @@ class TestOutageProofing(unittest.TestCase):
                 # re-probe too and mask the recovery
                 "TFOS_BENCH_PROBE_TIMEOUT_S": "45",
                 "TFOS_BENCH_WALL_BUDGET_S": str(budget),
+                "TFOS_ROOFLINE_BYTES": str(4 * 1024 * 1024),
             },
             timeout=budget + 60,
         )

@@ -1,0 +1,227 @@
+"""tools/bench_gate.py — the tier-1 gate on the BENCH artifact trajectory:
+perf regressions and silently-degraded artifacts fail loudly, loudly-
+degraded runs skip, and the in-tree trajectory itself must gate clean."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import bench_gate  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(tmp_path, name, parsed, n=None, rc=0):
+    doc = {"n": n if n is not None else bench_gate._round_of(name),
+           "cmd": "python bench.py", "rc": rc, "tail": "", "parsed": parsed}
+    path = tmp_path / name
+    path.write_text(json.dumps(doc))
+    return str(path)
+
+
+def _half(value, *, metric="resnet50_images_per_sec_per_chip",
+          platform="tpu", degraded=None, **extra):
+    half = {"metric": metric, "value": value, "unit": "images/sec/chip",
+            "vs_baseline": round(value / 2000.0, 4), "platform": platform,
+            "mem_bw_gbps": 700.0, "ici_bw_gbps": 40.0}
+    if degraded:
+        half["degraded"] = degraded
+    half.update(extra)
+    return half
+
+
+# -- the acceptance check: the in-tree trajectory gates clean ----------------
+
+
+def test_in_tree_trajectory_produces_machine_readable_verdict():
+    paths = bench_gate.discover(REPO)
+    assert paths, "no BENCH_r*.json in the repo"
+    verdict = bench_gate.gate(paths)
+    # round-trips through strict JSON (machine-readable contract)
+    assert json.loads(json.dumps(verdict))["verdict"] == verdict["verdict"]
+    # the in-tree history must never fail the gate: r05 is LOUDLY degraded
+    # (skip), r01/r04 are prior-round empties (warn)
+    assert verdict["verdict"] in ("pass", "skip")
+    assert verdict["reasons"] == []
+
+
+def test_in_tree_artifacts_all_schema_validate():
+    for path in bench_gate.discover(REPO):
+        art = bench_gate.load_artifact(path)
+        assert art["problems"] == [], f"{path}: {art['problems']}"
+        if art["parsed"] is None:
+            continue
+        for label, half in bench_gate.halves(art["parsed"]):
+            require = art["n"] >= bench_gate.DEFAULT_REQUIRE_ROOFLINE_FROM
+            problems = bench_gate.validate_half(
+                half, require_roofline=require)
+            assert problems == [], f"{path}:{label}: {problems}"
+
+
+# -- crafted trajectories ----------------------------------------------------
+
+
+def test_healthy_trajectory_passes(tmp_path):
+    paths = [
+        _write(tmp_path, "BENCH_r01.json", _half(2400.0)),
+        _write(tmp_path, "BENCH_r02.json", _half(2450.0)),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass"
+    assert verdict["newest"] == "BENCH_r02.json"
+    assert any(c["name"].startswith("regression:") and c["status"] == "pass"
+               for c in verdict["checks"])
+
+
+def test_regression_fails(tmp_path):
+    paths = [
+        _write(tmp_path, "BENCH_r01.json", _half(2400.0)),
+        _write(tmp_path, "BENCH_r02.json", _half(1200.0)),  # half the perf
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("regression" in r for r in verdict["reasons"])
+
+
+def test_degraded_newest_skips_and_prior_degraded_not_compared(tmp_path):
+    paths = [
+        _write(tmp_path, "BENCH_r01.json", _half(2400.0)),
+        # a degraded CPU-fallback round between the healthy ones
+        _write(tmp_path, "BENCH_r02.json",
+               _half(6000.0, platform="cpu", degraded="probe failed")),
+        _write(tmp_path, "BENCH_r03.json",
+               _half(100.0, platform="cpu", degraded="probe failed")),
+    ]
+    verdict = bench_gate.gate(paths)
+    # newest is loudly degraded: no perf judgment possible
+    assert verdict["verdict"] == "skip"
+    assert verdict["reasons"] == []
+
+
+def test_half_degraded_newest_skips_not_passes(tmp_path):
+    """A degraded primary with a healthy secondary is NOT a clean pass:
+    the headline number is fallback evidence with no regression
+    judgment — the verdict must say skip."""
+    wd = _half(103.0, metric="wide_deep_steps_per_sec")
+    wd["vs_baseline"] = 1.03
+    mixed = dict(_half(6000.0, platform="cpu", degraded="probe failed"),
+                 secondary=wd)
+    verdict = bench_gate.gate(
+        [_write(tmp_path, "BENCH_r01.json", mixed)])
+    assert verdict["verdict"] == "skip"
+    assert verdict["reasons"] == []
+
+
+def test_silently_degraded_newest_fails(tmp_path):
+    paths = [
+        _write(tmp_path, "BENCH_r01.json", _half(2400.0)),
+        _write(tmp_path, "BENCH_r02.json", None, rc=124),  # the r04 mode
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("silently degraded" in r for r in verdict["reasons"])
+
+
+def test_prior_empty_rounds_only_warn(tmp_path):
+    paths = [
+        _write(tmp_path, "BENCH_r01.json", None, rc=1),
+        _write(tmp_path, "BENCH_r02.json", _half(2400.0)),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass"
+    assert any(c["status"] == "warn" for c in verdict["checks"])
+
+
+def test_target_floor_breach_fails(tmp_path):
+    paths = [_write(tmp_path, "BENCH_r01.json", _half(100.0))]  # vs 2000
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("target" in r for r in verdict["reasons"])
+
+
+def test_roofline_fields_required_from_round_6(tmp_path):
+    half = _half(2400.0)
+    del half["mem_bw_gbps"], half["ici_bw_gbps"]
+    # round 5: grandfathered
+    verdict = bench_gate.gate(
+        [_write(tmp_path, "BENCH_r05.json", dict(half))])
+    assert verdict["verdict"] == "pass"
+    # round 6+: the schema is total — measure or stamp null + reason
+    verdict = bench_gate.gate(
+        [_write(tmp_path, "BENCH_r06.json", dict(half))])
+    assert verdict["verdict"] == "fail"
+    assert any("mem_bw_gbps" in r for r in verdict["reasons"])
+    # explicit null + reason is fine
+    ok = dict(half, mem_bw_gbps=None, mem_bw_reason="probe crashed",
+              ici_bw_gbps=None, ici_bw_reason="single device")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r06.json", ok)])
+    assert verdict["verdict"] == "pass"
+
+
+def test_rebaselined_batch_size_not_compared_across_configs(tmp_path):
+    """The wide_deep re-baseline pins batch 1024; steps/sec at batch 4096
+    is a different experiment — neither direction may read as a
+    regression (BASELINE.md 'wide_deep re-baseline')."""
+    old = _half(103.0, metric="wide_deep_steps_per_sec", batch_size=1024)
+    old["vs_baseline"] = 1.03
+    new = _half(43.0, metric="wide_deep_steps_per_sec", batch_size=4096)
+    new["vs_baseline"] = 0.43
+    paths = [
+        _write(tmp_path, "BENCH_r01.json", old),
+        _write(tmp_path, "BENCH_r02.json", new),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    assert any("no comparable prior" in c["detail"]
+               for c in verdict["checks"]
+               if c["name"].startswith("regression:"))
+
+
+def test_timing_suspect_priors_excluded_from_comparison(tmp_path):
+    paths = [
+        _write(tmp_path, "BENCH_r01.json",
+               _half(99999.0, timing_suspect=True)),
+        _write(tmp_path, "BENCH_r02.json", _half(2400.0)),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass"
+
+
+def test_secondary_half_judged_too(tmp_path):
+    wd_prior = _half(100.0, metric="wide_deep_steps_per_sec")
+    wd_prior["vs_baseline"] = 1.0
+    wd_bad = _half(10.0, metric="wide_deep_steps_per_sec")
+    wd_bad["vs_baseline"] = 0.1
+    paths = [
+        _write(tmp_path, "BENCH_r01.json",
+               dict(_half(2400.0), secondary=wd_prior)),
+        _write(tmp_path, "BENCH_r02.json",
+               dict(_half(2400.0), secondary=wd_bad)),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("wide_deep" in r for r in verdict["reasons"])
+
+
+def test_cli_exit_codes(tmp_path):
+    gate_py = os.path.join(REPO, "tools", "bench_gate.py")
+    ok = _write(tmp_path, "BENCH_r01.json", _half(2400.0))
+    proc = subprocess.run([sys.executable, gate_py, ok],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(proc.stdout)["verdict"] == "pass"
+    bad = _write(tmp_path, "BENCH_r02.json", _half(10.0))
+    proc = subprocess.run([sys.executable, gate_py, ok, bad],
+                          capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert json.loads(proc.stdout)["verdict"] == "fail"
+    proc = subprocess.run(
+        [sys.executable, gate_py, "--repo", str(tmp_path / "empty")],
+        capture_output=True, text=True)
+    assert proc.returncode == 2

@@ -73,3 +73,42 @@ def test_wrong_authkey_rejected(mgr):
     ok = TFManager.connect(mgr.address, b"secret")
     ok.get_queue("input").put(1)
     assert ok.get_queue("input").get(timeout=5) == 1
+
+
+def test_pid_identity_detects_reuse():
+    """The orphan watch keys trainer liveness on (pid, start tick), not
+    pid alone — a recycled pid naming an unrelated process must read as
+    DEAD or the manager server leaks forever (ADVICE r5 #3)."""
+    import os
+
+    me = os.getpid()
+    start = TFManager.proc_start_time(me)
+    assert start is not None and start > 0  # Linux CI: /proc available
+    # same process, matching tick → alive
+    assert TFManager._pid_alive(me, start) is True
+    # recorded tick from a DIFFERENT incarnation of this pid → dead
+    assert TFManager._pid_alive(me, start + 12345) is False
+    # no recorded tick (legacy writer) degrades to the pid-only check
+    assert TFManager._pid_alive(me, None) is True
+    # a pid that is actually gone → dead regardless of tick
+    import multiprocessing
+
+    p = multiprocessing.get_context("spawn").Process(target=int)
+    p.start()
+    dead_pid = p.pid
+    p.join()
+    # reaped pid → dead; if the OS already recycled it, the recorded tick
+    # (1: boot-time, unmatchable) still reads as a different process
+    assert TFManager._pid_alive(dead_pid, 1) is False
+
+
+def test_trainer_pid_start_rides_the_kv(mgr):
+    """The node runtime records the start tick beside the pid; both are
+    plain kv values any process can read back."""
+    import os
+
+    mgr.set("trainer_pid_start", TFManager.proc_start_time(os.getpid()))
+    mgr.set("trainer_pid", os.getpid())
+    assert mgr.get("trainer_pid") == os.getpid()
+    assert mgr.get("trainer_pid_start") == TFManager.proc_start_time(
+        os.getpid())

@@ -1,0 +1,112 @@
+"""Live observability endpoint (obs.httpd): real-socket round-trips."""
+
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import check_trace  # noqa: E402
+
+from tensorflowonspark_tpu import obs  # noqa: E402
+from tensorflowonspark_tpu.obs import httpd  # noqa: E402
+from tensorflowonspark_tpu.obs.trace import Tracer  # noqa: E402
+
+
+@pytest.fixture()
+def server():
+    reg = obs.Registry()
+    reg.counter("requests_total").inc(3)
+    reg.gauge("roofline_mem_bw_gbps").set(123.4)
+    reg.histogram("step_seconds").observe(0.02)
+    tracer = Tracer(node="driver")
+    with tracer.span("cluster.reserve"):
+        tracer.event("mark")
+    health = {"status": "ok", "nodes": {"worker:0": "running"}}
+
+    def _healthz():
+        return (200 if health["status"] == "ok" else 503,
+                "application/json", json.dumps(health))
+
+    srv = httpd.ObservabilityServer({
+        "/metrics": lambda: (200, httpd.PROMETHEUS_CONTENT_TYPE,
+                             reg.to_prometheus()),
+        "/healthz": _healthz,
+        "/trace": lambda: (200, "application/json", json.dumps(
+            obs.chrome.merge({"driver": tracer.snapshot()}))),
+        "/boom": lambda: (_ for _ in ()).throw(RuntimeError("handler died")),
+    })
+    srv.start()
+    srv._test_health = health
+    yield srv
+    srv.stop()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.headers.get("Content-Type"), \
+            resp.read().decode()
+
+
+def test_metrics_round_trip_is_valid_prometheus(server):
+    status, ctype, body = _get(server.url("/metrics"))
+    assert status == 200
+    assert ctype == httpd.PROMETHEUS_CONTENT_TYPE
+    assert "tfos_requests_total 3" in body
+    assert "tfos_roofline_mem_bw_gbps 123.4" in body
+    assert "tfos_step_seconds_bucket" in body
+    assert httpd.validate_prometheus_text(body) == []
+
+
+def test_healthz_flips_to_503_when_degraded(server):
+    status, _, body = _get(server.url("/healthz"))
+    assert status == 200
+    assert json.loads(body)["status"] == "ok"
+    server._test_health["status"] = "degraded"
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(server.url("/healthz"))
+    assert exc.value.code == 503
+    assert json.loads(exc.value.read().decode())["status"] == "degraded"
+
+
+def test_trace_round_trip_passes_schema_gate(server):
+    status, ctype, body = _get(server.url("/trace"))
+    assert status == 200
+    assert ctype == "application/json"
+    assert check_trace.validate_doc(json.loads(body)) == []
+
+
+def test_unknown_route_404_lists_routes(server):
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(server.url("/nope"))
+    assert exc.value.code == 404
+    assert "/metrics" in json.loads(exc.value.read().decode())["routes"]
+
+
+def test_handler_error_becomes_500_not_crash(server):
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(server.url("/boom"))
+    assert exc.value.code == 500
+    assert "handler died" in exc.value.read().decode()
+    # the server survived and still serves other routes
+    assert _get(server.url("/metrics"))[0] == 200
+
+
+def test_prometheus_validator_catches_violations():
+    assert httpd.validate_prometheus_text("") == []
+    good = "# TYPE tfos_x counter\ntfos_x 1\n"
+    assert httpd.validate_prometheus_text(good) == []
+    dup = good + "# TYPE tfos_x counter\ntfos_x 2\n"
+    assert any("duplicate TYPE" in p
+               for p in httpd.validate_prometheus_text(dup))
+    undeclared = "tfos_mystery 5\n"
+    assert any("no TYPE" in p
+               for p in httpd.validate_prometheus_text(undeclared))
+    garbage = "# TYPE tfos_y gauge\ntfos_y not-a-number\n"
+    assert any("non-numeric" in p
+               for p in httpd.validate_prometheus_text(garbage))

@@ -1,0 +1,84 @@
+"""In-run roofline probes (obs.roofline): the measurement-integrity layer
+must itself be measured — a CPU run still produces sane positive delivered
+bandwidth, the probe never raises, and the values land in the registry."""
+
+import pytest
+
+from tensorflowonspark_tpu import obs
+from tensorflowonspark_tpu.obs import roofline
+
+_SMALL = 8 * 1024 * 1024  # probe working set for tests: fast, still real
+# (comfortably above the dispatch-overhead floor that marks a pattern
+# unmeasurable — see measure_memory_bandwidth's 2×overhead guard)
+
+
+def test_probe_emits_sane_positive_mem_bw_on_cpu():
+    rf = roofline.probe(size_bytes=_SMALL, repeats=2)
+    assert rf["platform"] == "cpu"
+    # delivered CPU memory bandwidth is somewhere between "a floppy" and
+    # "physically impossible" — the sanity band, not a perf assertion
+    assert 0.01 < rf["mem_bw_gbps"] < 10000.0
+    patterns = [rf[k] for k in ("mem_bw_elementwise_gbps",
+                                "mem_bw_reduction_gbps") if k in rf]
+    assert patterns and all(p > 0 for p in patterns)
+    assert rf["mem_bw_gbps"] == pytest.approx(max(patterns), abs=0.02)
+    assert rf["probe_s"] > 0
+
+
+def test_overhead_dominated_probe_reports_unmeasurable(monkeypatch):
+    # when the timed op is not comfortably above the dispatch overhead,
+    # the probe must say "unmeasurable", never an absurd number
+    monkeypatch.setattr(roofline, "_dispatch_overhead",
+                        lambda repeats: 3600.0)  # op can never beat this
+    rf = roofline.probe(size_bytes=_SMALL, repeats=1)
+    assert rf["mem_bw_gbps"] is None
+    assert "dispatch overhead" in rf["mem_bw_reason"]
+
+
+def test_probe_measures_interconnect_over_host_devices():
+    # conftest forces 8 host devices — the "pod" of the unit-test world;
+    # the psum all-reduce must produce a positive algorithmic bandwidth
+    rf = roofline.probe(size_bytes=_SMALL, repeats=2)
+    assert rf["n_devices"] == 8
+    assert rf["ici_bw_gbps"] is not None and rf["ici_bw_gbps"] > 0
+
+
+def test_probe_sets_registry_gauges():
+    reg = obs.Registry()
+    rf = roofline.probe(size_bytes=_SMALL, repeats=1, registry=reg)
+    snap = reg.snapshot()
+    assert snap["gauges"]["roofline_mem_bw_gbps"] == rf["mem_bw_gbps"]
+    assert snap["gauges"]["roofline_ici_bw_gbps"] == rf["ici_bw_gbps"]
+
+
+def test_single_device_ici_is_null_with_reason(monkeypatch):
+    # on a single device there is no interconnect to measure: the probe
+    # must say so explicitly instead of emitting a bogus number
+    import jax
+
+    monkeypatch.setattr(jax, "device_count", lambda *a: 1)
+    res = roofline.measure_ici_bandwidth(size_bytes_per_device=_SMALL)
+    assert res["gbps"] is None
+    assert "single device" in res["reason"]
+
+
+def test_probe_never_raises_and_stamps_reasons(monkeypatch):
+    # a broken backend mid-probe must degrade to null + reason, not kill
+    # the bench child that calls it
+    monkeypatch.setattr(roofline, "measure_memory_bandwidth",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            RuntimeError("synthetic probe failure")))
+    monkeypatch.setattr(roofline, "measure_ici_bandwidth",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            RuntimeError("synthetic probe failure")))
+    rf = roofline.probe(size_bytes=_SMALL)
+    assert rf["mem_bw_gbps"] is None
+    assert "synthetic probe failure" in rf["mem_bw_reason"]
+    assert rf["ici_bw_gbps"] is None
+    assert "synthetic probe failure" in rf["ici_bw_reason"]
+
+
+def test_hbm_peak_lookup():
+    assert roofline.hbm_peak_gbps("TPU v5e chip") == 819.0
+    assert roofline.hbm_peak_gbps("TPU v4") == 1228.0
+    assert roofline.hbm_peak_gbps("mystery accelerator") is None

@@ -1,0 +1,285 @@
+#!/usr/bin/env python
+"""Bench regression gate: judge the newest BENCH artifact against history.
+
+Reads the ``BENCH_r*.json`` trajectory (the per-round wrappers the driver
+writes: ``{"n", "cmd", "rc", "tail", "parsed"}``), schema-validates every
+artifact, and compares the newest run's numbers against (a) the self-set
+targets already baked into each artifact's ``vs_baseline`` and (b) the best
+prior *comparable* run — same metric, same platform, non-degraded, timing
+not suspect.  Emits ONE machine-readable verdict JSON line:
+
+- ``"verdict": "pass"`` — newest run is healthy and within ``--threshold``
+  of the best prior comparable number;
+- ``"verdict": "skip"`` — newest run is loudly degraded (CPU fallback with
+  a ``degraded`` stamp): its numbers are not performance evidence, so no
+  regression judgment is possible — but the artifact itself validated;
+- ``"verdict": "fail"`` — a perf regression, a target-floor breach, a
+  malformed artifact, or a **silently** degraded newest artifact
+  (``parsed: null`` — the round-4 failure mode: a wedged run that left no
+  number and no explanation).
+
+Prior-round empty artifacts are recorded as ``warn`` checks, not failures —
+they are history, already explained in BENCH_NOTES.md; only the *newest*
+run must stand on its own.  From round ``--require-roofline-from`` (default
+6, the round that introduced in-run roofline probes) every half must also
+carry ``mem_bw_gbps``/``ici_bw_gbps`` (explicit ``null`` + reason allowed)
+so the artifact schema stays total.
+
+Usage::
+
+    python tools/bench_gate.py                  # repo-root BENCH_r*.json
+    python tools/bench_gate.py --repo /path     # another trajectory dir
+    python tools/bench_gate.py A.json B.json    # explicit artifact list
+
+Exit code 0 on pass/skip, 1 on fail, 2 on usage error.  Wired into tier-1
+via ``tests/test_bench_gate.py`` (in-tree trajectory must gate clean).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any
+
+#: newest/next-vs-best-prior ratio below which a number is a regression
+DEFAULT_THRESHOLD = 0.85
+#: minimum vs_baseline (value / self-set target) a healthy run must clear
+DEFAULT_TARGET_FLOOR = 0.25
+#: first round whose artifacts must carry the roofline fields
+DEFAULT_REQUIRE_ROOFLINE_FROM = 6
+
+_REQUIRED_HALF_KEYS = ("metric", "value", "unit", "vs_baseline")
+_ROOFLINE_KEYS = ("mem_bw_gbps", "ici_bw_gbps")
+
+
+def discover(repo_dir: str) -> list[str]:
+    """The trajectory: ``BENCH_r*.json`` sorted by round number."""
+    paths = glob.glob(os.path.join(repo_dir, "BENCH_r*.json"))
+    return sorted(paths, key=_round_of)
+
+
+def _round_of(path: str) -> int:
+    m = re.search(r"r(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def load_artifact(path: str) -> dict[str, Any]:
+    """Parse one wrapper; returns {"path", "n", "parsed", "problems"}."""
+    out: dict[str, Any] = {"path": path, "n": _round_of(path),
+                           "parsed": None, "problems": []}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        out["problems"].append(f"cannot read/parse: {e}")
+        return out
+    if not isinstance(doc, dict):
+        out["problems"].append("wrapper must be a JSON object")
+        return out
+    for key in ("cmd", "rc", "parsed"):
+        if key not in doc:
+            out["problems"].append(f"wrapper missing {key!r}")
+    if isinstance(doc.get("n"), int):
+        out["n"] = doc["n"]
+    parsed = doc.get("parsed")
+    if parsed is not None and not isinstance(parsed, dict):
+        out["problems"].append("'parsed' must be an object or null")
+        parsed = None
+    out["parsed"] = parsed
+    return out
+
+
+def halves(parsed: dict[str, Any]) -> list[tuple[str, dict[str, Any]]]:
+    """A headline artifact carries two results: primary + "secondary"."""
+    out = [("primary", parsed)]
+    sec = parsed.get("secondary")
+    if isinstance(sec, dict):
+        out.append(("secondary", sec))
+    return out
+
+
+def validate_half(half: dict[str, Any], *,
+                  require_roofline: bool) -> list[str]:
+    """Schema problems of one measured result (a wrapper's half)."""
+    problems = []
+    for key in _REQUIRED_HALF_KEYS:
+        if key not in half:
+            problems.append(f"missing {key!r}")
+    if "value" in half and not isinstance(half["value"], (int, float)):
+        problems.append(f"'value' must be numeric, got {half['value']!r}")
+    if "degraded" in half and not isinstance(half["degraded"], str):
+        problems.append("'degraded' must be a reason string")
+    present = [k for k in _ROOFLINE_KEYS if k in half]
+    if require_roofline or present:
+        for k in _ROOFLINE_KEYS:
+            if k not in half:
+                problems.append(
+                    f"missing {k!r} (schema is total: measure it or stamp "
+                    "an explicit null + reason)")
+            elif half[k] is None and f"{k.split('_gbps')[0]}_reason" not \
+                    in half and "degraded" not in half:
+                problems.append(
+                    f"{k!r} is null without a "
+                    f"'{k.split('_gbps')[0]}_reason'")
+    return problems
+
+
+def _comparable_prior(artifacts: list[dict], newest: dict, label: str,
+                      half: dict) -> tuple[float, str] | None:
+    """Best prior (value, source) for the same metric on the same
+    platform AND batch size, non-degraded, timing not suspect.
+
+    Batch size is part of the config identity: a re-baseline that pins a
+    different batch (wide_deep 4096→1024, BASELINE.md) must not create
+    cross-config comparisons in either direction — steps/sec at two batch
+    sizes are different experiments.
+    """
+    best: tuple[float, str] | None = None
+    for art in artifacts:
+        if art["n"] >= newest["n"] or not art["parsed"]:
+            continue
+        for plabel, phalf in halves(art["parsed"]):
+            if (phalf.get("metric") != half.get("metric")
+                    or phalf.get("platform") != half.get("platform")
+                    or phalf.get("batch_size") != half.get("batch_size")
+                    or "degraded" in phalf
+                    or phalf.get("timing_suspect")
+                    or not isinstance(phalf.get("value"), (int, float))):
+                continue
+            src = f"{os.path.basename(art['path'])}:{plabel}"
+            if best is None or phalf["value"] > best[0]:
+                best = (float(phalf["value"]), src)
+    return best
+
+
+def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
+         target_floor: float = DEFAULT_TARGET_FLOOR,
+         require_roofline_from: int = DEFAULT_REQUIRE_ROOFLINE_FROM
+         ) -> dict[str, Any]:
+    """Run the gate over a trajectory; returns the verdict document."""
+    checks: list[dict[str, Any]] = []
+
+    def check(name: str, status: str, detail: str) -> None:
+        checks.append({"name": name, "status": status, "detail": detail})
+
+    if not paths:
+        check("trajectory", "fail", "no BENCH_r*.json artifacts found")
+        return _verdict(checks, None, threshold, target_floor)
+
+    artifacts = [load_artifact(p) for p in paths]
+    artifacts.sort(key=lambda a: a["n"])
+    newest = artifacts[-1]
+    newest_name = os.path.basename(newest["path"])
+
+    for art in artifacts:
+        name = os.path.basename(art["path"])
+        is_newest = art is newest
+        for problem in art["problems"]:
+            check(f"schema:{name}", "fail" if is_newest else "warn", problem)
+        if art["parsed"] is None and not art["problems"]:
+            # rc captures whether the run itself reported failure
+            check(f"empty:{name}",
+                  "fail" if is_newest else "warn",
+                  "artifact carries no parsed result (silently degraded "
+                  "run — no number, no reason)" if is_newest else
+                  "prior round left no parsed result")
+            continue
+        if art["parsed"] is None:
+            continue
+        for label, half in halves(art["parsed"]):
+            require_rf = art["n"] >= require_roofline_from
+            for problem in validate_half(half, require_roofline=require_rf):
+                check(f"schema:{name}:{label}",
+                      "fail" if is_newest else "warn", problem)
+
+    if newest["parsed"] is not None and not newest["problems"]:
+        for label, half in halves(newest["parsed"]):
+            cname = f"{half.get('metric', label)}"
+            if "degraded" in half:
+                check(f"degraded:{cname}", "skip",
+                      f"newest run degraded ({half['degraded'][:120]}); "
+                      "numbers are fallback evidence, not performance")
+                continue
+            vsb = half.get("vs_baseline")
+            if isinstance(vsb, (int, float)):
+                if vsb < target_floor:
+                    check(f"target:{cname}", "fail",
+                          f"vs_baseline {vsb} below floor {target_floor}")
+                else:
+                    check(f"target:{cname}", "pass",
+                          f"vs_baseline {vsb} ≥ floor {target_floor}")
+            prior = _comparable_prior(artifacts, newest, label, half)
+            if prior is None:
+                check(f"regression:{cname}", "pass",
+                      "no comparable prior run (same metric+platform, "
+                      "non-degraded) — nothing to regress against")
+            else:
+                best, src = prior
+                value = float(half.get("value", 0.0))
+                if value >= threshold * best:
+                    check(f"regression:{cname}", "pass",
+                          f"{value} vs best prior {best} ({src}): "
+                          f"ratio {round(value / best, 4)} ≥ {threshold}")
+                else:
+                    check(f"regression:{cname}", "fail",
+                          f"{value} is {round(value / best, 4)}× best "
+                          f"prior {best} ({src}) — below {threshold}")
+
+    return _verdict(checks, newest_name, threshold, target_floor)
+
+
+def _verdict(checks: list[dict], newest: str | None, threshold: float,
+             target_floor: float) -> dict[str, Any]:
+    statuses = [c["status"] for c in checks]
+    if "fail" in statuses:
+        verdict = "fail"
+    elif "skip" in statuses:
+        # ANY degraded half means part of the newest run is fallback
+        # evidence that received no regression judgment — a consumer must
+        # not mistake a half-degraded run for a fully healthy one
+        verdict = "skip"
+    else:
+        verdict = "pass"
+    return {
+        "verdict": verdict,
+        "newest": newest,
+        "threshold": threshold,
+        "target_floor": target_floor,
+        "num_checks": len(checks),
+        "checks": checks,
+        "reasons": [f"{c['name']}: {c['detail']}" for c in checks
+                    if c["status"] == "fail"],
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("paths", nargs="*",
+                   help="explicit BENCH artifact paths (default: discover "
+                        "BENCH_r*.json under --repo)")
+    p.add_argument("--repo", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    p.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD)
+    p.add_argument("--target-floor", type=float,
+                   default=DEFAULT_TARGET_FLOOR)
+    p.add_argument("--require-roofline-from", type=int,
+                   default=DEFAULT_REQUIRE_ROOFLINE_FROM)
+    args = p.parse_args(argv)
+    paths = args.paths or discover(args.repo)
+    if not paths:
+        print(f"bench_gate: no BENCH_r*.json under {args.repo}",
+              file=sys.stderr)
+        return 2
+    doc = gate(paths, threshold=args.threshold,
+               target_floor=args.target_floor,
+               require_roofline_from=args.require_roofline_from)
+    print(json.dumps(doc))
+    return 1 if doc["verdict"] == "fail" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
