@@ -202,6 +202,11 @@ def _parse_args(argv=None):
     p.add_argument("--feed", action="store_true",
                    help="measure feed/compute overlap of the input pipeline "
                         "(SURVEY §3.2 hard part (b)) instead of throughput")
+    p.add_argument("--feed-transport", action="store_true",
+                   help="measure the feeder→DataFeed transport alone: "
+                        "rows/sec through the real TFManager data plane, "
+                        "shm columnar vs legacy pickled rows (host-side, "
+                        "no accelerator involved)")
     p.add_argument("--_measure", action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--_probe", action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--_force-cpu", action="store_true", help=argparse.SUPPRESS)
@@ -564,6 +569,147 @@ def _measure_feed_body(tmpdir, lib, config, side, batch_size, n_batches,
     return result
 
 
+def measure_feed_transport(rows_total: int = 4096, chunk_rows: int = 256,
+                           batch_size: int = 1024,
+                           feature_dim: int = 16384) -> dict:
+    """Feed microbench: rows/sec through the REAL feeder→DataFeed path.
+
+    Same wire as SPARK-mode training — chunks encoded feeder-side
+    (``shm.encode_chunk``), pushed through a live TFManager server process,
+    consumed by ``DataFeed.next_batch`` — once over the legacy pickled-rows
+    transport (every chunk pickled twice across the manager, per-row
+    consumer columnarization) and once over the shm columnar transport
+    (feeder-side columnarization, descriptor-only queue).  The ratio is the
+    serialization wall the zero-copy data plane removed; host-side and
+    CPU-only, so the number is valid even on accelerator-degraded runs.
+
+    Default rows are 64 KiB of float32 features (training-shaped payloads,
+    between CIFAR and ImageNet rows): the wall scales with row bytes, and
+    tiny rows are queue-latency-bound on both transports — see
+    BENCH_NOTES.md "Feed transport microbench" for the measured size sweep.
+    """
+    import threading
+
+    import numpy as np
+
+    from tensorflowonspark_tpu import TFManager, marker, shm
+    from tensorflowonspark_tpu.TFNode import DataFeed
+
+    rng = np.random.default_rng(0)
+    feats = rng.standard_normal((rows_total, feature_dim)).astype(np.float32)
+    rows = [(feats[i], i) for i in range(rows_total)]
+
+    def run(transport: str) -> float:
+        m = TFManager.start(b"feed-transport-bench",
+                            ["input", "output", "error"], mode="local")
+        try:
+            q = m.get_queue("input")
+            fallbacks = [0]
+            feeder_err: list = [None]
+
+            def feeder() -> None:
+                # proxies keep per-thread connections: safe from a thread.
+                # Any failure must still deliver StopFeed, or the consumer
+                # loop blocks forever on a healthy-but-starved queue and
+                # the whole bench wedges with no artifact — the exact
+                # failure mode the harness exists to prevent.
+                try:
+                    for i in range(0, rows_total, chunk_rows):
+                        payload = shm.encode_chunk(rows[i:i + chunk_rows],
+                                                   transport=transport)
+                        if (transport == "shm"
+                                and not isinstance(payload,
+                                                   shm.ShmChunkRef)):
+                            fallbacks[0] += 1  # write_chunk fell back
+
+                        q.put(payload)
+                except BaseException as e:  # noqa: BLE001 - re-raised below
+                    feeder_err[0] = e
+                finally:
+                    try:
+                        q.put(marker.StopFeed())
+                    except Exception:
+                        pass  # manager gone: consumer's get will raise
+
+            feed = DataFeed(m, input_mapping=["x", "y"])
+            th = threading.Thread(target=feeder, daemon=True)
+            t0 = time.perf_counter()
+            th.start()
+            n = 0
+            while not feed.should_stop():
+                batch = feed.next_batch(batch_size)
+                if batch:
+                    n += int(batch["y"].shape[0])
+            dt = time.perf_counter() - t0
+            th.join(timeout=30)
+            if feeder_err[0] is not None:
+                raise RuntimeError(
+                    f"feed transport bench feeder failed: "
+                    f"{feeder_err[0]!r}") from feeder_err[0]
+            if n != rows_total:
+                raise RuntimeError(
+                    f"feed transport bench lost rows: {n}/{rows_total}")
+            if fallbacks[0]:
+                # a number measured on a mixed shm/pickle wire must not be
+                # stamped with feed_transport="shm" — the gate compares
+                # within a transport; fail loudly into null + reason
+                raise RuntimeError(
+                    f"shm transport fell back to pickled columnar on "
+                    f"{fallbacks[0]} chunk(s) (/dev/shm full or "
+                    "unwritable?) — refusing to mislabel the measurement")
+            return rows_total / dt
+        finally:
+            m.shutdown()
+
+    out = {
+        "feed_rows_total": rows_total,
+        "feed_chunk_rows": chunk_rows,
+        "feed_batch_size": batch_size,
+        "feed_row_bytes": int(feats[0].nbytes + 8),
+    }
+    pickle_rps = run("rows")
+    out["feed_rows_per_sec_pickle"] = round(pickle_rps, 1)
+    if shm.shm_available():
+        shm_rps = run("shm")
+        out["feed_rows_per_sec"] = round(shm_rps, 1)
+        out["feed_transport"] = "shm"
+        out["feed_transport_speedup"] = round(shm_rps / pickle_rps, 2)
+    else:
+        out["feed_rows_per_sec"] = round(pickle_rps, 1)
+        out["feed_transport"] = "pickle"
+        out["feed_transport_reason"] = ("shared memory unavailable on this "
+                                        "host; pickled columnar fallback")
+    return out
+
+
+def _stamp_feed_transport(result: dict, deadline: _Deadline) -> None:
+    """Stamp the feed-transport microbench into the headline result.
+
+    Runs even when the accelerator half degraded — the data plane is
+    host-side, so its number stays performance evidence either way.  The
+    schema is total: failure or an exhausted wall budget stamps an explicit
+    null + ``feed_transport_reason`` (``tools/bench_gate.py`` requires the
+    field from r07)."""
+    from tensorflowonspark_tpu import obs
+
+    if deadline.remaining() < 60:
+        result["feed_rows_per_sec"] = None
+        result["feed_transport_reason"] = ("wall budget exhausted before "
+                                           "feed microbench")
+        return
+    with obs.span("bench.feed_transport") as sp:
+        try:
+            result.update(measure_feed_transport())
+            sp.set(ok=True,
+                   rows_per_sec=result.get("feed_rows_per_sec"),
+                   speedup=result.get("feed_transport_speedup"))
+        except Exception as e:
+            result["feed_rows_per_sec"] = None
+            result["feed_transport_reason"] = (
+                f"feed microbench failed: {e!r}"[:200])
+            sp.set(ok=False, error=str(e)[:200])
+
+
 def probe_device(args) -> dict:
     """Liveness probe (child side): prove a tiny device op completes.
 
@@ -762,6 +908,16 @@ def main() -> None:
 
     obs.configure(node="bench")
     deadline = _Deadline(_WALL_BUDGET_S)
+
+    if args.feed_transport:
+        # host-side data-plane measurement: no accelerator, no probe
+        result = {"metric": "feed_rows_per_sec", "unit": "rows/sec"}
+        _stamp_feed_transport(result, deadline)
+        result["value"] = result.get("feed_rows_per_sec")
+        _write_trace_artifact(result)
+        print(json.dumps(result))
+        return
+
     probe = _probe_accelerator(deadline)
     probe_failed_at_start = not probe.get("ok")
     health = {"ok": bool(probe.get("ok")),
@@ -840,6 +996,7 @@ def main() -> None:
             health["ok"] = True
             health["why"] = "accelerator healthy on re-probe"
     result["secondary"] = _bench_one("wide_deep", args, deadline, health)
+    _stamp_feed_transport(result, deadline)
     if not probe.get("ok"):
         result["probe"] = probe
     _ensure_roofline_fields(

@@ -13,19 +13,29 @@ the node runtime published into ``cluster_info``.
 
 Departures from the reference:
 
-- Queue payloads in the TPU rebuild are **columnar batches** (dict of numpy
-  arrays), not single pickled rows — the row-at-a-time queue was the
-  reference's main bottleneck (``SURVEY.md §3.2``).  The manager itself is
-  payload-agnostic.
+- Queue payloads in the TPU rebuild are **columnar chunks**, not single
+  pickled rows — the row-at-a-time queue was the reference's main
+  bottleneck (``SURVEY.md §3.2``).  On the zero-copy path
+  (:mod:`tensorflowonspark_tpu.shm`) the queue carries only small
+  ``ShmChunkRef`` descriptors and this server never touches the payload.
+  The manager itself is payload-agnostic.
+- Queues are **byte-bounded** as well as chunk-bounded
+  (:class:`_ByteBoundedQueue`, ``TFOS_FEED_MAX_INFLIGHT_MB``): with
+  columnar chunks, a chunk-count bound alone can pin gigabytes.
+- The orphan watch doubles as the ``/dev/shm`` janitor: it periodically
+  runs :func:`tensorflowonspark_tpu.shm.sweep_orphans` so segments from
+  killed feeder tasks are reclaimed.
 - kv get/set round-trips go through one proxied dict (method calls on a proxy
   return plain values), avoiding the reference's proxy-wrapped scalars.
 """
 
 from __future__ import annotations
 
+import collections
 import multiprocessing
 import os
 import queue as _queue_mod
+import time as _time_mod
 from multiprocessing.managers import BaseManager
 from typing import Any, Iterable
 
@@ -34,6 +44,113 @@ from typing import Any, Iterable
 _queues: dict[str, _queue_mod.Queue] = {}
 _kv: dict[str, Any] = {}
 _maxsize: list[int] = [1024]
+_max_bytes: list[int] = [0]
+
+#: default in-flight payload bound per queue, MB (``TFOS_FEED_MAX_INFLIGHT_MB``
+#: overrides; 0 disables).  The chunk-count bound alone stopped meaning much
+#: once chunks went columnar: 1024 queued 256-row float image chunks is
+#: gigabytes of pinned host (or /dev/shm) memory.
+DEFAULT_MAX_INFLIGHT_MB = 512
+
+
+def _payload_nbytes(item: Any) -> int:
+    """Descriptor-side byte accounting: columnar payloads (ShmChunkRef /
+    ColumnarChunk / raw ndarray) declare ``nbytes``; legacy row lists and
+    markers count 0 and stay bounded by chunk count alone."""
+    try:
+        return int(getattr(item, "nbytes", 0) or 0)
+    except Exception:
+        return 0
+
+
+class _ByteBoundedQueue(_queue_mod.Queue):
+    """``queue.Queue`` with an additional in-flight payload-byte bound.
+
+    ``put`` blocks (or raises ``Full``) while admitting the item would push
+    queued payload bytes past ``max_bytes`` — ON TOP of the chunk-count
+    bound, which remains as floor.  A single item larger than ``max_bytes``
+    is admitted when the queue is byte-empty (otherwise it could never be
+    fed at all); the byte bound is back-pressure, not a message-size limit.
+    Shm descriptors are accounted at their referenced segment size, and
+    bytes are held from ``put`` until ``get`` — queue residency.  The true
+    ``/dev/shm`` high-water mark can therefore exceed the bound by what the
+    consumer holds between dequeue and ``read_chunk``'s unlink (at most the
+    DataFeed buffer plus ``prefetch`` staged batches), so size the bound
+    with that headroom in mind; it is back-pressure on the unbounded term,
+    not a hard memory cap.
+    """
+
+    def __init__(self, maxsize: int, max_bytes: int = 0):
+        super().__init__(maxsize)
+        self.max_bytes = int(max_bytes)
+        self._queued_bytes = 0
+        self._nbytes_fifo: collections.deque = collections.deque()
+
+    def _over(self, nb: int) -> bool:
+        if 0 < self.maxsize <= self._qsize():
+            return True
+        return (self.max_bytes > 0 and self._queued_bytes > 0
+                and self._queued_bytes + nb > self.max_bytes)
+
+    def put(self, item, block=True, timeout=None):
+        nb = _payload_nbytes(item)
+        with self.not_full:
+            if not block:
+                if self._over(nb):
+                    raise _queue_mod.Full
+            elif timeout is None:
+                while self._over(nb):
+                    self.not_full.wait()
+            elif timeout < 0:
+                raise ValueError("'timeout' must be a non-negative number")
+            else:
+                endtime = _time_mod.monotonic() + timeout
+                while self._over(nb):
+                    remaining = endtime - _time_mod.monotonic()
+                    if remaining <= 0.0:
+                        raise _queue_mod.Full
+                    self.not_full.wait(remaining)
+            self._put(item)
+            self._nbytes_fifo.append(nb)
+            self._queued_bytes += nb
+            self.unfinished_tasks += 1
+            self.not_empty.notify()
+
+    def get(self, block=True, timeout=None):
+        with self.not_empty:
+            if not block:
+                if not self._qsize():
+                    raise _queue_mod.Empty
+            elif timeout is None:
+                while not self._qsize():
+                    self.not_empty.wait()
+            elif timeout < 0:
+                raise ValueError("'timeout' must be a non-negative number")
+            else:
+                endtime = _time_mod.monotonic() + timeout
+                while not self._qsize():
+                    remaining = endtime - _time_mod.monotonic()
+                    if remaining <= 0.0:
+                        raise _queue_mod.Empty
+                    self.not_empty.wait(remaining)
+            item = self._get()
+            if self._nbytes_fifo:
+                self._queued_bytes -= self._nbytes_fifo.popleft()
+            self.not_full.notify()
+            return item
+
+    def inflight_bytes(self) -> int:
+        with self.mutex:
+            return self._queued_bytes
+
+
+def _configured_max_bytes() -> int:
+    raw = os.environ.get("TFOS_FEED_MAX_INFLIGHT_MB")
+    try:
+        mb = float(raw) if raw not in (None, "") else DEFAULT_MAX_INFLIGHT_MB
+    except ValueError:
+        mb = DEFAULT_MAX_INFLIGHT_MB
+    return int(max(0.0, mb) * 1e6)
 
 
 def proc_start_time(pid: int) -> int | None:
@@ -85,8 +202,9 @@ def _pid_alive(pid: int, recorded_start: int | None) -> bool | None:
 def _setup(qnames: Iterable[str], maxsize: int,
            parent_pid: int | None = None) -> None:
     _maxsize[0] = maxsize
+    _max_bytes[0] = _configured_max_bytes()  # spawn child inherits env
     for name in qnames:
-        _queues[name] = _queue_mod.Queue(maxsize)
+        _queues[name] = _ByteBoundedQueue(maxsize, _max_bytes[0])
     _start_orphan_watch(parent_pid)
 
 
@@ -129,9 +247,50 @@ def _start_orphan_watch(parent_pid: int | None) -> None:
         alive = _pid_alive(int(owner), _kv.get("trainer_pid_start"))
         return True if alive is None else alive  # indeterminate: serve
 
+    def _sweep_shm(do_sweep: bool = True) -> None:
+        # each executor host polices its own /dev/shm: feed segments whose
+        # creator (a Spark task pid, identified by the same (pid, start
+        # tick) pair as the trainer liveness check) died without handing
+        # off are reaped so killed tasks never leak host memory.  Segments
+        # referenced by descriptors still sitting in OUR queues are in
+        # flight no matter how old — a short-lived feeder pid exits the
+        # moment its put() returns, long before a slow trainer drains the
+        # (possibly hundreds-of-MB) backlog — so they are excluded AND
+        # mtime-touched: the touch is what protects them from OTHER
+        # managers' sweeps on the same host (one server per executor, each
+        # blind to the others' queues) and from the snapshot→unlink race.
+        try:
+            from tensorflowonspark_tpu import shm
+
+            queued: set[str] = set()
+            for q in list(_queues.values()):
+                try:
+                    with q.mutex:
+                        items = list(q.queue)
+                except Exception:
+                    continue
+                for it in items:
+                    if isinstance(it, shm.ShmChunkRef):
+                        queued.add(it.name)
+            # keepalive runs EVERY watch cycle (2 s against the 60 s sweep
+            # grace — a 30× margin): the touch cadence, not the sweep
+            # cadence, is what a throttled/stalled watch thread must not
+            # let slip past a sibling manager's grace window
+            shm.keepalive(queued)
+            if do_sweep:
+                shm.sweep_orphans(exclude=queued)
+        except Exception:
+            pass  # the watch must never die to a sweep hiccup
+
     def watch() -> None:
+        last_sweep = 0.0
         while True:
             time.sleep(2.0)
+            now = time.monotonic()
+            do_sweep = now - last_sweep >= 30.0
+            if do_sweep:
+                last_sweep = now
+            _sweep_shm(do_sweep)
             if os.getppid() == parent_pid:
                 continue
             if _trainer_alive():
@@ -153,7 +312,8 @@ def _get_queue(qname: str) -> _queue_mod.Queue:
     if q is None:
         if ":" not in qname:
             raise KeyError(qname)
-        q = _queues.setdefault(qname, _queue_mod.Queue(_maxsize[0]))
+        q = _queues.setdefault(qname,
+                               _ByteBoundedQueue(_maxsize[0], _max_bytes[0]))
     return q
 
 

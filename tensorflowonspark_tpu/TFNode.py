@@ -7,10 +7,16 @@ The central class is :class:`DataFeed`, the trainer-side endpoint of the
 SPARK input mode.  Deliberate TPU-first departure from the reference
 (``SURVEY.md §3.2``): the reference's feed was row-at-a-time — one pickled
 row per ``queue.get`` — which was its main bottleneck.  Here the feeder ships
-**chunks** (lists of rows) and ``next_batch`` returns **columnar numpy
-arrays** (optionally already ``jax.device_put`` into HBM), so the hot loop
-does O(batch/chunk) queue operations and one host→device transfer per batch
-instead of O(batch) pickled gets feeding a ``feed_dict``.
+**chunks** — preferably pre-columnarized, either as shared-memory segment
+descriptors (:class:`tensorflowonspark_tpu.shm.ShmChunkRef`, zero-copy) or
+pickled :class:`~tensorflowonspark_tpu.marker.ColumnarChunk` columns, with
+plain row lists as the legacy fallback — and ``next_batch`` returns
+**columnar numpy arrays** (optionally already ``jax.device_put`` into HBM).
+Pre-columnarized chunks are assembled with ``np.concatenate`` (a batch
+covered by a single chunk is handed out as zero-copy views), so the hot
+loop does O(batch/chunk) queue operations, O(columns) assembly work, and
+one host→device transfer per batch instead of O(batch) pickled gets
+feeding a ``feed_dict``.
 """
 
 from __future__ import annotations
@@ -22,7 +28,7 @@ from typing import Any, Iterable, Sequence
 
 import numpy as np
 
-from tensorflowonspark_tpu import marker
+from tensorflowonspark_tpu import marker, shm
 
 logger = logging.getLogger(__name__)
 
@@ -64,7 +70,12 @@ class DataFeed:
         self.done_feeding = False
         self._queue_in = mgr.get_queue(qname_in)
         self._queue_out = mgr.get_queue(qname_out)
-        self._buffer: list[Any] = []  # rows not yet returned
+        # not-yet-returned data as FIFO *pieces*: a list of rows (legacy
+        # feeders) or a marker.ColumnarChunk of pre-columnarized arrays
+        # (shm / pickled-columnar feeders) — split at batch boundaries by
+        # numpy views, never row loops
+        self._buffer: list[Any] = []
+        self._buffered_rows = 0
         # provenance of buffered / handed-out rows, as [tag, count] runs in
         # FIFO order (tag None = untagged feeder). batch_results uses
         # _out_route to send each result to its feeding task's own queue —
@@ -75,6 +86,7 @@ class DataFeed:
         self._stop_seen = False  # StopFeed consumed by the assembling side
         self._pf_thread = None
         self._pf_out: _std_queue.Queue | None = None
+        self._pf_args: tuple | None = None
 
     # -- input -------------------------------------------------------------
 
@@ -94,18 +106,25 @@ class DataFeed:
         """
         if self.prefetch > 0:
             return self._next_batch_prefetched(batch_size, device_put)
-        rows, runs, stopped = self._assemble(batch_size)
+        pieces, runs, stopped = self._assemble(batch_size)
         if stopped:
             self.done_feeding = True
         for tag, count in runs:
             self._note_rows(self._out_route, tag, count)
-        return self._columnarize(rows, device_put)
+        return self._columnarize(pieces, device_put)
 
     def _assemble(self, batch_size: int):
         """Pull queue items until ``batch_size`` rows are buffered, a marker
         ends the batch early, or the stop marker arrives.  Returns
-        ``(rows, provenance_runs, stop_seen)``; does NOT touch
-        ``_out_route`` — the caller does, at hand-out time.
+        ``(pieces, provenance_runs, stop_seen)`` — pieces are row lists or
+        ``marker.ColumnarChunk`` column sets, already cut to the batch; does
+        NOT touch ``_out_route`` — the caller does, at hand-out time.
+
+        Shm descriptors are materialized here (zero-copy views over the
+        consumed segment); pickled ``ColumnarChunk`` payloads pass through
+        as-is.  ``datafeed_bytes_{shm,pickle}_total`` count the columnar
+        payload bytes per transport (plain-row chunks have no cheap byte
+        measure and are counted by ``datafeed_rows_total`` only).
 
         Feed observability (one histogram + two counters per batch, all
         O(1)): ``datafeed_assemble_seconds`` is the time the trainer spent
@@ -114,14 +133,25 @@ class DataFeed:
         from tensorflowonspark_tpu import obs
 
         t0 = _time_mod.perf_counter()
-        while len(self._buffer) < batch_size and not self._stop_seen:
+        while self._buffered_rows < batch_size and not self._stop_seen:
             item = self._queue_in.get()
             if isinstance(item, marker.StopFeed):
                 self._stop_seen = True
+            elif isinstance(item, shm.ShmChunkRef):
+                cols, tag = shm.read_chunk(item)
+                obs.counter("datafeed_bytes_shm_total").inc(item.nbytes)
+                self._push_piece(marker.ColumnarChunk(cols), tag,
+                                 item.nrows)
+                if self._buffered_rows >= batch_size:
+                    break
+            elif isinstance(item, marker.ColumnarChunk):
+                obs.counter("datafeed_bytes_pickle_total").inc(item.nbytes)
+                self._push_piece(item, item.tag, item.nrows)
+                if self._buffered_rows >= batch_size:
+                    break
             elif isinstance(item, marker.TaggedChunk):
-                self._buffer.extend(item.rows)
-                self._note_rows(self._buffer_tags, item.tag, len(item.rows))
-                if len(self._buffer) >= batch_size:
+                self._push_piece(item.rows, item.tag, len(item.rows))
+                if self._buffered_rows >= batch_size:
                     break
             elif isinstance(item, marker.Marker):
                 # EndPartition / generic marker: release what we have (the
@@ -129,24 +159,84 @@ class DataFeed:
                 break
             else:
                 rows = item if isinstance(item, list) else [item]
-                self._buffer.extend(rows)
-                self._note_rows(self._buffer_tags, None, len(rows))
-                if len(self._buffer) >= batch_size:
+                self._push_piece(rows, None, len(rows))
+                if self._buffered_rows >= batch_size:
                     break
-        rows = self._buffer[:batch_size]
-        self._buffer = self._buffer[batch_size:]
-        runs = self._take_tags(len(rows))
+        pieces = self._take_pieces(batch_size)
+        taken = sum(self._piece_len(p) for p in pieces)
+        runs = self._take_tags(taken)
         obs.histogram("datafeed_assemble_seconds").observe(
             _time_mod.perf_counter() - t0)
         obs.counter("datafeed_batches_total").inc()
-        if rows:
-            obs.counter("datafeed_rows_total").inc(len(rows))
-        return rows, runs, self._stop_seen
+        if taken:
+            obs.counter("datafeed_rows_total").inc(taken)
+        return pieces, runs, self._stop_seen
+
+    def _push_piece(self, piece, tag, nrows: int) -> None:
+        if nrows <= 0:
+            return
+        self._buffer.append(piece)
+        self._buffered_rows += nrows
+        self._note_rows(self._buffer_tags, tag, nrows)
+
+    @staticmethod
+    def _piece_len(piece) -> int:
+        return (piece.nrows if isinstance(piece, marker.ColumnarChunk)
+                else len(piece))
+
+    def _take_pieces(self, count: int) -> list[Any]:
+        """Detach up to ``count`` rows' worth of pieces from the buffer,
+        splitting the boundary piece with numpy views (columnar) or a list
+        slice (rows) — no per-row work either way."""
+        out: list[Any] = []
+        while count > 0 and self._buffer:
+            piece = self._buffer[0]
+            n = self._piece_len(piece)
+            if n <= count:
+                out.append(self._buffer.pop(0))
+                self._buffered_rows -= n
+                count -= n
+            else:
+                if isinstance(piece, marker.ColumnarChunk):
+                    out.append(marker.ColumnarChunk(
+                        [c[:count] for c in piece.cols], tag=piece.tag))
+                    self._buffer[0] = marker.ColumnarChunk(
+                        [c[count:] for c in piece.cols], tag=piece.tag)
+                else:
+                    out.append(piece[:count])
+                    self._buffer[0] = piece[count:]
+                self._buffered_rows -= count
+                count = 0
+        return out
 
     def _next_batch_prefetched(self, batch_size: int, device_put):
         """Double-buffered path: batches staged by a pipeline thread."""
         if self.done_feeding:  # pump already drained; mirror sync behavior
+            # post-drain calls are fine with ANY arguments — nothing is in
+            # flight to mis-stage, so the consistency guard below must not
+            # fire here
             return self._columnarize([], device_put)
+        if self._pf_args is not None:
+            pf_bs, pf_dp = self._pf_args
+            # equality, not identity: `feed.next_batch(bs, obj.method)`
+            # builds a fresh bound-method object per call, and bound
+            # methods compare equal while never being identical
+            try:
+                dp_same = device_put is pf_dp or bool(device_put == pf_dp)
+            except Exception:
+                dp_same = False
+            if batch_size != pf_bs or not dp_same:
+                # the pump stages batches with the FIRST call's arguments;
+                # a change mid-stream would silently hand out wrong-sized
+                # or wrongly-staged batches already in flight
+                raise ValueError(
+                    f"DataFeed(prefetch={self.prefetch}): batch_size/"
+                    f"device_put changed after the prefetch pump started "
+                    f"(pump has batch_size={pf_bs}, got {batch_size}; "
+                    f"device_put {'unchanged' if dp_same else 'changed'}). "
+                    "Keep them constant across next_batch calls, or use a "
+                    "new DataFeed (or prefetch=0) for the new "
+                    "configuration.")
         if self._pf_thread is None:
             self._start_prefetch(batch_size, device_put)
         item = self._pf_out.get()
@@ -162,13 +252,14 @@ class DataFeed:
     def _start_prefetch(self, batch_size: int, device_put) -> None:
         import threading
 
+        self._pf_args = (batch_size, device_put)
         self._pf_out = _std_queue.Queue(maxsize=self.prefetch)
 
         def pump() -> None:
             try:
                 while True:
-                    rows, runs, stopped = self._assemble(batch_size)
-                    batch = self._columnarize(rows, device_put)
+                    pieces, runs, stopped = self._assemble(batch_size)
+                    batch = self._columnarize(pieces, device_put)
                     self._pf_out.put((batch, runs, stopped))
                     if stopped:
                         return
@@ -250,11 +341,18 @@ class DataFeed:
                     break
         while True:
             try:
-                self._queue_in.get(timeout=1.0)
+                item = self._queue_in.get(timeout=1.0)
             except _std_queue.Empty:
                 return
             except (EOFError, BrokenPipeError):
                 return
+            if isinstance(item, shm.ShmChunkRef):
+                # a drained descriptor is never read: unlink its segment
+                # here or nothing will until the orphan sweep
+                try:
+                    shm.unlink_ref(item)
+                except Exception:
+                    pass
 
     # -- internals ---------------------------------------------------------
 
@@ -283,15 +381,45 @@ class DataFeed:
                 self._buffer_tags[0][1] = c - n
         return runs
 
-    def _columnarize(self, rows: list[Any], device_put):
-        if not rows:
-            return {} if self.input_mapping else []
+    @staticmethod
+    def _rows_to_cols(rows: list[Any]) -> list[np.ndarray]:
+        """Legacy per-row columnarization of ONE rows piece (the loop the
+        columnar transports moved to the feeder side).  Delegates to
+        :func:`shm.columnarize` — the ONE place the row→column convention
+        lives — and keeps the permissive local loop only for rows that
+        cannot columnarize (object-dtype payloads the legacy path has
+        always accepted as object arrays)."""
+        cols = shm.columnarize(rows)
+        if cols is not None:
+            return cols
         first = rows[0]
         if isinstance(first, (list, tuple)) and not np.isscalar(first):
-            ncols = len(first)
-            cols = [np.asarray([r[c] for r in rows]) for c in range(ncols)]
+            return [np.asarray([r[c] for r in rows])
+                    for c in range(len(first))]
+        return [np.asarray(rows)]
+
+    def _columnarize(self, pieces: list[Any], device_put):
+        """Assemble one batch's pieces into columnar arrays.
+
+        Pre-columnarized pieces concatenate per column (``np.concatenate``
+        — one memcpy per column); a batch covered by a single columnar
+        piece is handed out as-is: zero-copy views over the (already
+        unlinked) shm segment, from which ``device_put`` transfers
+        directly."""
+        if not pieces:
+            return {} if self.input_mapping else []
+        col_sets = [piece.cols if isinstance(piece, marker.ColumnarChunk)
+                    else self._rows_to_cols(piece) for piece in pieces]
+        ncols = len(col_sets[0])
+        if any(len(cs) != ncols for cs in col_sets):
+            raise ValueError(
+                "inconsistent column arity across feed chunks in one batch: "
+                f"{sorted({len(cs) for cs in col_sets})} columns")
+        if len(col_sets) == 1:
+            cols = list(col_sets[0])
         else:
-            cols = [np.asarray(rows)]
+            cols = [np.concatenate([cs[i] for cs in col_sets])
+                    for i in range(ncols)]
         if self.input_mapping and len(self.input_mapping) != len(cols):
             raise ValueError(
                 f"input_mapping has {len(self.input_mapping)} names but rows "

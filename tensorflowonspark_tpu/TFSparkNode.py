@@ -33,7 +33,7 @@ import time
 from typing import Any, Callable, Iterator
 
 from tensorflowonspark_tpu import (TFManager, chip_info, health, marker,
-                                   obs, reservation, util)
+                                   obs, reservation, shm, util)
 
 logger = logging.getLogger(__name__)
 
@@ -470,7 +470,12 @@ class _MapFn:
 class _TrainFn:
     """Feed one RDD partition into the co-located node's input queue.
 
-    Reference anchor: ``TFSparkNode.py::train``.  Ships chunks, not rows.
+    Reference anchor: ``TFSparkNode.py::train``.  Ships chunks, not rows —
+    and columnarizes each chunk ONCE here on the Spark-task side
+    (``shm.encode_chunk``): fixed-dtype columns ride a shared-memory
+    segment (only the descriptor crosses the manager), or one pickled
+    ``ColumnarChunk`` when shm is unavailable/opted out; ragged or
+    object-dtype rows keep the legacy pickled-rows path.
     """
 
     def __init__(self, cluster_info, cluster_meta, feed_timeout, qname):
@@ -498,10 +503,10 @@ class _TrainFn:
             for row in iterator:
                 chunk.append(row)
                 if len(chunk) >= chunk_size:
-                    self._put(q, chunk, deadline)
+                    self._put(q, shm.encode_chunk(chunk), deadline)
                     chunk = []
             if chunk:
-                self._put(q, chunk, deadline)
+                self._put(q, shm.encode_chunk(chunk), deadline)
             self._put(q, marker.EndPartition(), deadline)
         except _queue_mod.Full:
             raise RuntimeError(
@@ -525,7 +530,13 @@ class _TrainFn:
 
     def _put(self, q, item, deadline) -> None:
         timeout = max(0.0, deadline - time.monotonic())
-        q.put(item, block=True, timeout=timeout)
+        try:
+            q.put(item, block=True, timeout=timeout)
+        except Exception:
+            # a descriptor that never made it onto the queue references a
+            # segment nobody will ever consume — reclaim it now
+            shm.maybe_unlink_payload(item)
+            raise
 
 
 class _InferenceFn:
@@ -559,20 +570,27 @@ class _InferenceFn:
 
         count = 0
         chunk: list[Any] = []
+
+        def send(payload) -> None:
+            # tagged chunks columnarize feeder-side too (shm or pickled
+            # columnar, TaggedChunk fallback); a payload that fails to
+            # enqueue must not strand its shm segment
+            try:
+                qin.put(payload, timeout=max(0.0, deadline - time.monotonic()))
+            except Exception:
+                shm.maybe_unlink_payload(payload)
+                raise
+
         try:
             for row in iterator:
                 chunk.append(row)
                 count += 1
                 if len(chunk) >= chunk_size:
-                    qin.put(marker.TaggedChunk(tag, chunk),
-                            timeout=max(0.0, deadline - time.monotonic()))
+                    send(shm.encode_chunk(chunk, tag=tag))
                     chunk = []
             if chunk:
-                qin.put(marker.TaggedChunk(tag, chunk),
-                        timeout=max(0.0, deadline - time.monotonic()))
-            qin.put(
-                marker.EndPartition(), timeout=max(0.0, deadline - time.monotonic())
-            )
+                send(shm.encode_chunk(chunk, tag=tag))
+            send(marker.EndPartition())
         except _queue_mod.Full:
             _raise_worker_error(mgr)
             raise RuntimeError(

@@ -58,3 +58,36 @@ class TaggedChunk:
 
     def __repr__(self) -> str:  # pragma: no cover - trivial
         return f"<TaggedChunk {self.tag} n={len(self.rows)}>"
+
+
+class ColumnarChunk:
+    """A chunk already columnarized on the feeder side.
+
+    ``cols`` is one contiguous numpy array per column, all sharing the same
+    leading (row) dimension.  This is the pickled FALLBACK of the zero-copy
+    transport (:mod:`tensorflowonspark_tpu.shm`): when shared memory is
+    unavailable or opted out, the columns ride the manager queue as one
+    pickle — still a single feeder-side columnarization, still O(columns)
+    consumer-side assembly, just not zero-copy.  ``tag`` carries the
+    feeding task's identity exactly like :class:`TaggedChunk` (None for the
+    untagged training path).  ``nbytes`` is what the byte-aware queue bound
+    accounts (descriptor-side accounting).
+    """
+
+    __slots__ = ("cols", "tag")
+
+    def __init__(self, cols: list, tag: str | None = None):
+        self.cols = cols
+        self.tag = tag
+
+    @property
+    def nrows(self) -> int:
+        return int(self.cols[0].shape[0]) if self.cols else 0
+
+    @property
+    def nbytes(self) -> int:
+        return int(sum(int(c.nbytes) for c in self.cols))
+
+    def __repr__(self) -> str:  # pragma: no cover - trivial
+        return (f"<ColumnarChunk tag={self.tag} rows={self.nrows} "
+                f"cols={len(self.cols)}>")

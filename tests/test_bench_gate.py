@@ -164,6 +164,139 @@ def test_roofline_fields_required_from_round_6(tmp_path):
     assert verdict["verdict"] == "pass"
 
 
+def _feed_fields(rps=2000.0, transport="shm", **extra):
+    fields = {"feed_rows_per_sec": rps, "feed_transport": transport,
+              "feed_rows_per_sec_pickle": rps / 3.5,
+              "feed_transport_speedup": 3.5,
+              "feed_rows_total": 4096,
+              "feed_chunk_rows": 256, "feed_batch_size": 1024,
+              "feed_row_bytes": 65544}
+    fields.update(extra)
+    return fields
+
+
+def test_feed_field_required_on_primary_from_round_7(tmp_path):
+    # round 6: grandfathered
+    verdict = bench_gate.gate(
+        [_write(tmp_path, "BENCH_r06.json", _half(2400.0))])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # round 7+: the primary must carry the feed microbench
+    verdict = bench_gate.gate(
+        [_write(tmp_path, "BENCH_r07.json", _half(2400.0))])
+    assert verdict["verdict"] == "fail"
+    assert any("feed_rows_per_sec" in r for r in verdict["reasons"])
+    # measured value + transport attribution satisfies
+    verdict = bench_gate.gate([_write(
+        tmp_path, "BENCH_r07.json", _half(2400.0, **_feed_fields()))])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # explicit null + reason satisfies too (degraded host, spent budget)
+    verdict = bench_gate.gate([_write(
+        tmp_path, "BENCH_r07.json",
+        _half(2400.0, feed_rows_per_sec=None,
+              feed_transport_reason="wall budget exhausted"))])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # the secondary half never needs it (stamped once per run)
+    wd = _half(103.0, metric="wide_deep_steps_per_sec")
+    wd["vs_baseline"] = 1.03
+    verdict = bench_gate.gate([_write(
+        tmp_path, "BENCH_r07.json",
+        dict(_half(2400.0, **_feed_fields()), secondary=wd))])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+
+
+def test_feed_value_without_transport_attribution_fails(tmp_path):
+    fields = _feed_fields()
+    del fields["feed_transport"]
+    verdict = bench_gate.gate([_write(
+        tmp_path, "BENCH_r07.json", _half(2400.0, **fields))])
+    assert verdict["verdict"] == "fail"
+    assert any("feed_transport" in r for r in verdict["reasons"])
+
+
+def test_feed_regression_gated_within_same_transport(tmp_path):
+    paths = [
+        _write(tmp_path, "BENCH_r07.json",
+               _half(2400.0, **_feed_fields(rps=2000.0))),
+        _write(tmp_path, "BENCH_r08.json",
+               _half(2400.0, **_feed_fields(rps=500.0))),  # data plane 4× off
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("feed_rows_per_sec" in r and "data plane" in r
+               for r in verdict["reasons"])
+
+
+def test_feed_not_compared_across_transports_or_configs(tmp_path):
+    # transport changed (shm host → pickle fallback host): different
+    # experiment, no regression judgment in either direction
+    paths = [
+        _write(tmp_path, "BENCH_r07.json",
+               _half(2400.0, **_feed_fields(rps=2000.0))),
+        _write(tmp_path, "BENCH_r08.json",
+               _half(2400.0, **_feed_fields(
+                   rps=500.0, transport="pickle",
+                   feed_transport_reason="shm unavailable"))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    assert any(c["name"] == "regression:feed_rows_per_sec"
+               and "no comparable prior" in c["detail"]
+               for c in verdict["checks"])
+    # feed config changed (row size sweep): also incomparable
+    paths = [
+        _write(tmp_path, "BENCH_r07.json",
+               _half(2400.0, **_feed_fields(rps=2000.0))),
+        _write(tmp_path, "BENCH_r08.json",
+               _half(2400.0, **_feed_fields(rps=500.0, feed_row_bytes=264))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # total row count is config identity too: per-run fixed cost (manager
+    # startup/teardown) amortizes over rows_total, so rows/sec at a
+    # different total is a different experiment
+    paths = [
+        _write(tmp_path, "BENCH_r07.json",
+               _half(2400.0, **_feed_fields(rps=2000.0))),
+        _write(tmp_path, "BENCH_r08.json",
+               _half(2400.0, **_feed_fields(rps=500.0,
+                                            feed_rows_total=1024))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+
+
+def test_feed_prior_from_degraded_round_still_compared(tmp_path):
+    """The feed number is host-side: a CPU-fallback (degraded) prior still
+    measured the same data plane and still counts as a prior."""
+    degraded_prior = _half(6000.0, platform="cpu", degraded="probe failed",
+                           **_feed_fields(rps=2000.0))
+    healthy_bad_feed = _half(2400.0, **_feed_fields(rps=500.0))
+    paths = [
+        _write(tmp_path, "BENCH_r07.json", degraded_prior),
+        _write(tmp_path, "BENCH_r08.json", healthy_bad_feed),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("feed_rows_per_sec" in r for r in verdict["reasons"])
+
+
+def test_feed_regression_judged_even_on_degraded_newest(tmp_path):
+    """Symmetric case: when the NEWEST run's accelerator half degraded, its
+    host-side feed measurement is still performance evidence — the degraded
+    skip must not short-circuit the feed regression judgment."""
+    healthy_prior = _half(2400.0, **_feed_fields(rps=2000.0))
+    degraded_bad_feed = _half(600.0, platform="cpu", degraded="probe failed",
+                              **_feed_fields(rps=500.0))
+    paths = [
+        _write(tmp_path, "BENCH_r07.json", healthy_prior),
+        _write(tmp_path, "BENCH_r08.json", degraded_bad_feed),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("feed_rows_per_sec" in r and "data plane" in r
+               for r in verdict["reasons"])
+
+
 def test_rebaselined_batch_size_not_compared_across_configs(tmp_path):
     """The wide_deep re-baseline pins batch 1024; steps/sec at batch 4096
     is a different experiment — neither direction may read as a

@@ -1,12 +1,13 @@
 """Unit tests for TFNode.DataFeed and hdfs_path (fake manager, no Spark)."""
 
+import os
 import queue
 import types
 
 import numpy as np
 import pytest
 
-from tensorflowonspark_tpu import marker
+from tensorflowonspark_tpu import marker, shm
 from tensorflowonspark_tpu.TFNode import DataFeed, hdfs_path
 
 
@@ -257,6 +258,206 @@ def test_device_put_returns_jax_arrays():
     feed = DataFeed(mgr, input_mapping=["x", "y"])
     batch = feed.next_batch(4, device_put=True)
     assert isinstance(batch["x"], jax.Array)
+
+
+# -- the columnar transports through DataFeed (the zero-copy data plane) --
+
+
+def _feed_rows(n=7, dim=3):
+    rng = np.random.default_rng(5)
+    feats = rng.standard_normal((n, dim)).astype(np.float32)
+    return [(feats[i], i) for i in range(n)]
+
+
+def _drain(feed, batch_size):
+    xs, ys = [], []
+    while not feed.should_stop():
+        batch = feed.next_batch(batch_size)
+        if batch:
+            xs.append(np.asarray(batch["x"]))
+            ys.append(np.asarray(batch["y"]))
+    return np.concatenate(xs), np.concatenate(ys)
+
+
+@pytest.mark.parametrize("transport", ["rows", "pickle", "shm"])
+def test_transports_deliver_identical_batches(transport):
+    """Equality across the three transports: the zero-copy plane is a pure
+    optimisation — same rows in, same columnar batches out."""
+    if transport == "shm" and not shm.shm_available():
+        pytest.skip("/dev/shm unavailable")
+    rows = _feed_rows(n=7)
+    mgr = FakeMgr()
+    q = mgr.get_queue("input")
+    q.put(shm.encode_chunk(rows[:4], transport=transport))
+    q.put(shm.encode_chunk(rows[4:], transport=transport))
+    q.put(marker.StopFeed())
+    feed = DataFeed(mgr, input_mapping=["x", "y"])
+    xs, ys = _drain(feed, batch_size=3)  # batches cross chunk boundaries
+    np.testing.assert_array_equal(xs, np.stack([r[0] for r in rows]))
+    np.testing.assert_array_equal(ys, np.arange(7))
+    if shm.shm_available():
+        assert not [f for f in os.listdir("/dev/shm")
+                    if f.startswith(shm.SEG_PREFIX)], "segment leaked"
+
+
+def test_columnar_chunk_split_across_batches_is_viewed_not_copied():
+    """A chunk bigger than the batch is split by numpy views at the batch
+    boundary — no per-row work, correct values on both sides."""
+    rows = _feed_rows(n=6)
+    mgr = FakeMgr()
+    mgr.get_queue("input").put(shm.encode_chunk(rows, transport="pickle"))
+    mgr.get_queue("input").put(marker.StopFeed())
+    feed = DataFeed(mgr, input_mapping=["x", "y"])
+    b1 = feed.next_batch(4)
+    b2 = feed.next_batch(4)
+    np.testing.assert_array_equal(b1["y"], [0, 1, 2, 3])
+    np.testing.assert_array_equal(b2["y"], [4, 5])
+    assert b1["x"].shape == (4, 3) and b2["x"].shape == (2, 3)
+
+
+def test_single_columnar_chunk_batch_is_zero_copy():
+    """A batch covered by one pre-columnarized chunk hands out that chunk's
+    arrays themselves (no concatenate, no copy)."""
+    chunk = marker.ColumnarChunk(
+        [np.arange(12, dtype=np.float32).reshape(4, 3), np.arange(4)])
+    mgr = FakeMgr()
+    mgr.get_queue("input").put(chunk)
+    mgr.get_queue("input").put(marker.StopFeed())
+    feed = DataFeed(mgr, input_mapping=["x", "y"])
+    batch = feed.next_batch(4)
+    assert batch["x"] is chunk.cols[0]  # identity: zero-copy hand-out
+
+
+def test_tagged_shm_chunks_route_results_like_tagged_chunks():
+    """Tag provenance survives the shm transport: results go back to the
+    feeding task's own queue, exactly as with TaggedChunk."""
+    if not shm.shm_available():
+        pytest.skip("/dev/shm unavailable")
+    rmgr = FakeMgr()
+    rmgr._queues["output:tA"] = queue.Queue()
+
+    def put_route(name, results, timeout=None):
+        rmgr._queues[name].put(results)
+        return True
+
+    rmgr.put_route = put_route
+    q = rmgr.get_queue("input")
+    q.put(shm.encode_chunk(_feed_rows(n=2), tag="tA", transport="shm"))
+    q.put(shm.encode_chunk(_feed_rows(n=1), transport="pickle"))  # untagged
+    q.put(marker.StopFeed())
+    feed = DataFeed(rmgr, input_mapping=["x", "y"])
+    b1 = feed.next_batch(2)
+    assert len(b1["x"]) == 2
+    feed.batch_results([11, 12])
+    assert rmgr._queues["output:tA"].get_nowait() == [11, 12]
+    b2 = feed.next_batch(2)
+    assert len(b2["x"]) == 1
+    feed.batch_results([13])
+    assert rmgr.get_queue("output").get_nowait() == [13]
+
+
+def test_mixed_transport_chunks_concatenate_in_one_batch():
+    rows = _feed_rows(n=4)
+    mgr = FakeMgr()
+    q = mgr.get_queue("input")
+    q.put(shm.encode_chunk(rows[:2], transport="pickle"))
+    q.put(shm.encode_chunk(rows[2:], transport="rows"))
+    q.put(marker.StopFeed())
+    feed = DataFeed(mgr, input_mapping=["x", "y"])
+    batch = feed.next_batch(4)
+    np.testing.assert_array_equal(batch["y"], [0, 1, 2, 3])
+
+
+def test_inconsistent_column_arity_across_chunks_raises():
+    mgr = FakeMgr()
+    q = mgr.get_queue("input")
+    q.put(marker.ColumnarChunk([np.ones(2), np.ones(2)]))
+    q.put(marker.ColumnarChunk([np.ones(2)]))
+    q.put(marker.StopFeed())
+    feed = DataFeed(mgr)
+    with pytest.raises(ValueError, match="column arity"):
+        feed.next_batch(4)
+
+
+def test_terminate_unlinks_drained_shm_descriptors():
+    """Descriptors drained (never consumed) at terminate must not strand
+    their segments until the orphan sweep."""
+    if not shm.shm_available():
+        pytest.skip("/dev/shm unavailable")
+    mgr = FakeMgr()
+    q = mgr.get_queue("input")
+    ref = shm.encode_chunk(_feed_rows(n=3), transport="shm")
+    assert isinstance(ref, shm.ShmChunkRef)
+    q.put(ref)
+    feed = DataFeed(mgr, input_mapping=["x", "y"])
+    feed.terminate()
+    assert not os.path.exists(os.path.join("/dev/shm", ref.name))
+
+
+def test_prefetch_rejects_changed_batch_size():
+    """Satellite: a changed batch_size after the pump started must raise,
+    not silently hand out wrong-sized staged batches."""
+    mgr = FakeMgr()
+    q = mgr.get_queue("input")
+    for i in range(8):
+        q.put([(float(i),)])
+    q.put(marker.StopFeed())
+    feed = DataFeed(mgr, input_mapping=["x"], prefetch=2)
+    assert len(feed.next_batch(2)["x"]) == 2
+    with pytest.raises(ValueError, match="batch_size"):
+        feed.next_batch(4)
+    # the original configuration keeps working
+    assert len(feed.next_batch(2)["x"]) == 2
+
+
+def test_prefetch_rejects_changed_device_put():
+    mgr = FakeMgr()
+    q = mgr.get_queue("input")
+    for i in range(4):
+        q.put([(float(i),)])
+    q.put(marker.StopFeed())
+    feed = DataFeed(mgr, input_mapping=["x"], prefetch=2)
+    stage = lambda b: b  # noqa: E731
+    feed.next_batch(2, device_put=stage)
+    with pytest.raises(ValueError, match="device_put"):
+        feed.next_batch(2, device_put=lambda b: b)
+
+
+class _Stager:
+    def stage(self, b):
+        return b
+
+
+def test_prefetch_accepts_equal_bound_method_device_put():
+    """``obj.method`` builds a FRESH bound-method object on every attribute
+    access — the guard must compare by equality, not identity, or the
+    recommended per-call ``device_put=trainer.shard`` pattern would falsely
+    raise on the second batch."""
+    s = _Stager()
+    assert s.stage is not s.stage  # the premise: fresh object per access
+    mgr = FakeMgr()
+    q = mgr.get_queue("input")
+    for i in range(4):
+        q.put([(float(i),)])
+    q.put(marker.StopFeed())
+    feed = DataFeed(mgr, input_mapping=["x"], prefetch=2)
+    assert len(feed.next_batch(2, device_put=s.stage)["x"]) == 2
+    assert len(feed.next_batch(2, device_put=s.stage)["x"]) == 2
+
+
+def test_prefetch_post_drain_calls_ignore_changed_args():
+    """After the pump drains, nothing is in flight to mis-stage — post-drain
+    polling with different arguments mirrors the sync path's empty batch
+    instead of tripping the mid-stream consistency guard."""
+    mgr = FakeMgr()
+    q = mgr.get_queue("input")
+    q.put([(1.0,), (2.0,)])
+    q.put(marker.StopFeed())
+    feed = DataFeed(mgr, input_mapping=["x"], prefetch=2)
+    while not feed.should_stop():
+        feed.next_batch(2)
+    assert feed.next_batch(64) == {}  # changed batch_size: no raise
+    assert feed.next_batch(64, device_put=lambda b: b) == {}
 
 
 # -- hdfs_path (reference parity: test/test_TFNode.py) --

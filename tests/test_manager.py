@@ -102,6 +102,90 @@ def test_pid_identity_detects_reuse():
     assert TFManager._pid_alive(dead_pid, 1) is False
 
 
+def test_byte_bound_blocks_puts_over_budget():
+    """The byte-aware back-pressure satellite: with columnar chunks a
+    chunk-count bound alone can pin GBs; queued payload bytes are bounded
+    too (descriptor-side accounting via each payload's ``nbytes``)."""
+    import numpy as np
+
+    from tensorflowonspark_tpu import marker
+
+    q = TFManager._ByteBoundedQueue(maxsize=1024, max_bytes=1000)
+    small = marker.ColumnarChunk([np.zeros(100, np.uint8)])  # 100 B
+    big = marker.ColumnarChunk([np.zeros(950, np.uint8)])    # 950 B
+    q.put(small)
+    with pytest.raises(queue.Full):  # 100 + 950 > 1000
+        q.put(big, block=False)
+    assert q.get() is small  # draining releases the budget
+    q.put(big, block=False)  # now fits
+    assert q.inflight_bytes() == big.nbytes
+
+
+def test_byte_bound_admits_oversized_item_when_empty():
+    """A single item larger than the whole budget is admitted when the
+    queue is byte-empty — back-pressure, not a message-size limit."""
+    import numpy as np
+
+    from tensorflowonspark_tpu import marker
+
+    q = TFManager._ByteBoundedQueue(maxsize=4, max_bytes=100)
+    huge = marker.ColumnarChunk([np.zeros(10_000, np.uint8)])
+    q.put(huge, block=False)
+    assert q.inflight_bytes() == 10_000
+    with pytest.raises(queue.Full):  # but nothing rides alongside it
+        q.put(huge, block=False)
+    q.get()
+    assert q.inflight_bytes() == 0
+
+
+def test_byte_bound_keeps_chunk_count_floor():
+    """Legacy payloads (no nbytes) stay bounded by chunk count alone."""
+    q = TFManager._ByteBoundedQueue(maxsize=2, max_bytes=10**9)
+    q.put([1, 2, 3])
+    q.put([4, 5, 6])
+    with pytest.raises(queue.Full):
+        q.put([7], block=False)
+    assert q.inflight_bytes() == 0  # row lists: no byte accounting
+
+
+def test_byte_bound_configured_from_env(monkeypatch):
+    """TFOS_FEED_MAX_INFLIGHT_MB reaches the spawned server's queues (the
+    env rides the spawn); shm descriptors are accounted at their segment
+    size without the server ever touching the payload."""
+    import numpy as np
+
+    from tensorflowonspark_tpu import shm
+
+    monkeypatch.setenv("TFOS_FEED_MAX_INFLIGHT_MB", "0.001")  # 1000 bytes
+    m = TFManager.start(b"bb", ["input"], mode="local")
+    payloads = []
+    try:
+        q = m.get_queue("input")
+        rows = [(np.zeros(150, np.uint8), i) for i in range(4)]  # ~600B+
+        first = shm.encode_chunk(rows)
+        payloads.append(first)
+        q.put(first)
+        second = shm.encode_chunk(rows)
+        payloads.append(second)
+        with pytest.raises(queue.Full):
+            q.put(second, block=False)
+        q.get()  # drain; budget released
+        third = shm.encode_chunk(rows)
+        payloads.append(third)
+        q.put(third, block=False)
+        q.get()
+    finally:
+        for p in payloads:  # descriptors were never consumed: unlink
+            shm.maybe_unlink_payload(p)
+        m.shutdown()
+        monkeypatch.delenv("TFOS_FEED_MAX_INFLIGHT_MB")
+        # unlinked everything: no segment left behind
+        import os
+
+        assert not [f for f in os.listdir("/dev/shm")
+                    if f.startswith(shm.SEG_PREFIX)]
+
+
 def test_trainer_pid_start_rides_the_kv(mgr):
     """The node runtime records the start tick beside the pid; both are
     plain kv values any process can read back."""

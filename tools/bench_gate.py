@@ -23,7 +23,12 @@ they are history, already explained in BENCH_NOTES.md; only the *newest*
 run must stand on its own.  From round ``--require-roofline-from`` (default
 6, the round that introduced in-run roofline probes) every half must also
 carry ``mem_bw_gbps``/``ici_bw_gbps`` (explicit ``null`` + reason allowed)
-so the artifact schema stays total.
+so the artifact schema stays total.  From round ``--require-feed-from``
+(default 7, the round that introduced the zero-copy data plane) the primary
+half must carry ``feed_rows_per_sec`` with its ``feed_transport``
+attribution (again: explicit ``null`` + ``feed_transport_reason`` allowed);
+a healthy feed number is regression-judged against the best prior run with
+the same transport and feed config.
 
 Usage::
 
@@ -51,9 +56,13 @@ DEFAULT_THRESHOLD = 0.85
 DEFAULT_TARGET_FLOOR = 0.25
 #: first round whose artifacts must carry the roofline fields
 DEFAULT_REQUIRE_ROOFLINE_FROM = 6
+#: first round whose primary half must carry the feed-transport microbench
+#: (``feed_rows_per_sec``, introduced with the zero-copy data plane)
+DEFAULT_REQUIRE_FEED_FROM = 7
 
 _REQUIRED_HALF_KEYS = ("metric", "value", "unit", "vs_baseline")
 _ROOFLINE_KEYS = ("mem_bw_gbps", "ici_bw_gbps")
+_FEED_KEY = "feed_rows_per_sec"
 
 
 def discover(repo_dir: str) -> list[str]:
@@ -103,7 +112,8 @@ def halves(parsed: dict[str, Any]) -> list[tuple[str, dict[str, Any]]]:
 
 
 def validate_half(half: dict[str, Any], *,
-                  require_roofline: bool) -> list[str]:
+                  require_roofline: bool,
+                  require_feed: bool = False) -> list[str]:
     """Schema problems of one measured result (a wrapper's half)."""
     problems = []
     for key in _REQUIRED_HALF_KEYS:
@@ -125,6 +135,23 @@ def validate_half(half: dict[str, Any], *,
                 problems.append(
                     f"{k!r} is null without a "
                     f"'{k.split('_gbps')[0]}_reason'")
+    # feed-transport microbench: host-side, so required even when the
+    # accelerator halves degraded — but a degraded run may legitimately
+    # have spent its wall budget, so null + reason always satisfies
+    if require_feed or _FEED_KEY in half:
+        if _FEED_KEY not in half:
+            problems.append(
+                f"missing {_FEED_KEY!r} (feed microbench is part of the "
+                "schema from r07: measure it or stamp an explicit null + "
+                "'feed_transport_reason')")
+        elif half[_FEED_KEY] is None and "feed_transport_reason" not in half:
+            problems.append(
+                f"{_FEED_KEY!r} is null without a 'feed_transport_reason'")
+        elif (isinstance(half.get(_FEED_KEY), (int, float))
+              and "feed_transport" not in half):
+            problems.append(
+                f"{_FEED_KEY!r} without 'feed_transport' attribution "
+                "(shm|pickle) — transports are different experiments")
     return problems
 
 
@@ -156,9 +183,36 @@ def _comparable_prior(artifacts: list[dict], newest: dict, label: str,
     return best
 
 
+def _comparable_prior_feed(artifacts: list[dict], newest: dict,
+                           half: dict) -> tuple[float, str] | None:
+    """Best prior ``feed_rows_per_sec`` under the same transport and feed
+    config (chunk/batch/row sizes) — the microbench's config identity.
+
+    The feed number is host-side, so priors whose accelerator halves were
+    degraded still count: a CPU-fallback round measured the same data
+    plane.  Transports are different experiments (that is the point of the
+    attribution) and never compared across."""
+    ident_keys = ("feed_transport", "feed_rows_total", "feed_chunk_rows",
+                  "feed_batch_size", "feed_row_bytes")
+    best: tuple[float, str] | None = None
+    for art in artifacts:
+        if art["n"] >= newest["n"] or not art["parsed"]:
+            continue
+        for plabel, phalf in halves(art["parsed"]):
+            if (not isinstance(phalf.get(_FEED_KEY), (int, float))
+                    or any(phalf.get(k) != half.get(k)
+                           for k in ident_keys)):
+                continue
+            src = f"{os.path.basename(art['path'])}:{plabel}"
+            if best is None or phalf[_FEED_KEY] > best[0]:
+                best = (float(phalf[_FEED_KEY]), src)
+    return best
+
+
 def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
          target_floor: float = DEFAULT_TARGET_FLOOR,
-         require_roofline_from: int = DEFAULT_REQUIRE_ROOFLINE_FROM
+         require_roofline_from: int = DEFAULT_REQUIRE_ROOFLINE_FROM,
+         require_feed_from: int = DEFAULT_REQUIRE_FEED_FROM
          ) -> dict[str, Any]:
     """Run the gate over a trajectory; returns the verdict document."""
     checks: list[dict[str, Any]] = []
@@ -192,13 +246,39 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
             continue
         for label, half in halves(art["parsed"]):
             require_rf = art["n"] >= require_roofline_from
-            for problem in validate_half(half, require_roofline=require_rf):
+            # the feed microbench is stamped once per run, on the primary
+            require_fd = (label == "primary"
+                          and art["n"] >= require_feed_from)
+            for problem in validate_half(half, require_roofline=require_rf,
+                                         require_feed=require_fd):
                 check(f"schema:{name}:{label}",
                       "fail" if is_newest else "warn", problem)
 
     if newest["parsed"] is not None and not newest["problems"]:
         for label, half in halves(newest["parsed"]):
             cname = f"{half.get('metric', label)}"
+            # the feed microbench is host-side: a degraded accelerator half
+            # still measured the real data plane, so judge it BEFORE the
+            # degraded skip short-circuits the half
+            if isinstance(half.get(_FEED_KEY), (int, float)):
+                fprior = _comparable_prior_feed(artifacts, newest, half)
+                fname = f"regression:{_FEED_KEY}"
+                fval = float(half[_FEED_KEY])
+                if fprior is None:
+                    check(fname, "pass",
+                          "no comparable prior feed measurement (same "
+                          "transport + feed config) — nothing to regress "
+                          "against")
+                elif fval >= threshold * fprior[0]:
+                    check(fname, "pass",
+                          f"{fval} vs best prior {fprior[0]} "
+                          f"({fprior[1]}): ratio "
+                          f"{round(fval / fprior[0], 4)} ≥ {threshold}")
+                else:
+                    check(fname, "fail",
+                          f"{fval} is {round(fval / fprior[0], 4)}× best "
+                          f"prior {fprior[0]} ({fprior[1]}) — the data "
+                          f"plane regressed below {threshold}")
             if "degraded" in half:
                 check(f"degraded:{cname}", "skip",
                       f"newest run degraded ({half['degraded'][:120]}); "
@@ -268,6 +348,8 @@ def main(argv: list[str] | None = None) -> int:
                    default=DEFAULT_TARGET_FLOOR)
     p.add_argument("--require-roofline-from", type=int,
                    default=DEFAULT_REQUIRE_ROOFLINE_FROM)
+    p.add_argument("--require-feed-from", type=int,
+                   default=DEFAULT_REQUIRE_FEED_FROM)
     args = p.parse_args(argv)
     paths = args.paths or discover(args.repo)
     if not paths:
@@ -276,7 +358,8 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     doc = gate(paths, threshold=args.threshold,
                target_floor=args.target_floor,
-               require_roofline_from=args.require_roofline_from)
+               require_roofline_from=args.require_roofline_from,
+               require_feed_from=args.require_feed_from)
     print(json.dumps(doc))
     return 1 if doc["verdict"] == "fail" else 0
 
