@@ -168,8 +168,68 @@ def run(handle: int) -> None:
     missing = [n for n in st["input_names"] if n not in st["inputs"]]
     if missing:
         raise ValueError(f"inputs not set before run: {missing}")
-    out = st["fn"](st["params"], dict(st["inputs"]))
+    batch = dict(st["inputs"])
+    # bucketed batch shapes (serving data plane, reused): repeated JVM calls
+    # with drifting batch sizes pad to the next power of two, so the jitted
+    # forward compiles O(log n) shapes instead of one per distinct size.
+    # Padding is evidence-gated per handle: slicing padded rows off is only
+    # valid for a per-example forward (every output carries the batch
+    # axis), so calls run at their true shape until per-example output
+    # shapes have been observed at TWO DISTINCT batch sizes — a
+    # batch-aggregating output has a FIXED size, which can coincide with at
+    # most one batch size, so two distinct confirmations can only come from
+    # outputs that genuinely track the batch axis.  Aggregating forwards
+    # (pooled embedding, scalar metric) therefore keep exact-shape
+    # execution and exact results.  Opt out entirely with
+    # TFOS_INFER_BUCKETS=0.
+    import os
+
+    from tensorflowonspark_tpu import serving
+
+    bucketed = os.environ.get("TFOS_INFER_BUCKETS", "1").strip().lower() \
+        not in ("0", "false")
+    n_real = bucket = 0
+    if bucketed:
+        n_real = serving.batch_rows(batch)
+        bucket = serving.pow2_bucket(n_real) if n_real > 0 else 0
+        if bucket > n_real and (st.get("per_example") is not False
+                                and len(st.get("per_example_sizes",
+                                               ())) >= 2):
+            batch = serving.pad_columns(batch, bucket)
+        else:
+            # not enough evidence yet (or evidence against): run at the
+            # true shape — no pad copy is made; this call compiles at its
+            # own size and its output shapes feed the evidence
+            bucket = n_real
+        serving.note_compile(("infer_embed", handle), batch)
+    out = st["fn"](st["params"], batch)
     named = _flatten_named(out)
+    if bucketed and n_real > 0:
+        padded = bucket > n_real
+        per_example = all(v.ndim >= 1 and v.shape[0] == bucket
+                          for v in named.values())
+        if padded and not per_example:
+            # the evidence that enabled padding was wrong (the forward's
+            # output arity changed under a new shape): rerun at the true
+            # shape — correctness over the saved compile
+            logger.warning(
+                "handle %d: padded run produced non-per-example outputs; "
+                "rerunning at the true batch size and disabling bucketing "
+                "for this handle", handle)
+            st["per_example"] = False
+            true_batch = dict(st["inputs"])
+            # the rerun is a genuine fresh compile at the true shape —
+            # keep serving_compiles_total == jit compilation keys honest
+            serving.note_compile(("infer_embed", handle), true_batch)
+            named = _flatten_named(st["fn"](st["params"], true_batch))
+        elif padded:
+            # mask half of pad-and-mask: slice every output back to the
+            # true row count (all carry the batch axis — just verified)
+            named = {k: v[:n_real] for k, v in named.items()}
+        elif per_example:
+            st.setdefault("per_example_sizes", set()).add(n_real)
+        else:
+            st["per_example"] = False
     order = st.get("output_order")
     if order:
         # the signature's declared order wins; anything it doesn't name
@@ -219,5 +279,8 @@ def get_output(handle: int, name: str = "") -> bytes:
 
 
 def close(handle: int) -> None:
+    from tensorflowonspark_tpu import serving
+
+    serving.forget(("infer_embed", handle))
     with _LOCK:
         _HANDLES.pop(handle, None)

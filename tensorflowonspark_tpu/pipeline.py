@@ -158,6 +158,12 @@ HasModelName = _make_has(
     "HasModelName", "model_name",
     "tensorflowonspark_tpu.models zoo name used to rebuild the apply "
     "function at transform time (TPU-native: code/data split)", None)
+HasBucketSizes = _make_has(
+    "HasBucketSizes", "bucket_sizes",
+    "serving batch-shape buckets: every inference batch is zero-padded up "
+    "to the smallest of these row counts (padded rows masked out of the "
+    "output), so the forward compiles once per bucket instead of once per "
+    "distinct partition-tail size.  Default None = just [batch_size]", None)
 
 
 class TFParams(Params):
@@ -266,7 +272,7 @@ _MODEL_CACHE: dict[tuple, tuple[Callable, Any]] = {}
 
 class TFModel(TFParams, HasBatchSize, HasInputMapping, HasOutputMapping,
               HasModelDir, HasExportDir, HasSignatureDefKey, HasTagSet,
-              HasModelName):
+              HasModelName, HasBucketSizes):
     """Spark ML ``Model``: embarrassingly-parallel inference over a DataFrame.
 
     Reference anchor: ``pipeline.py::TFModel`` — no cluster is formed;
@@ -304,32 +310,37 @@ class TFModel(TFParams, HasBatchSize, HasInputMapping, HasOutputMapping,
             output_mapping=self.getOrDefault("output_mapping"),
             columns=df.columns,
             backend=backend,
+            bucket_sizes=self.getOrDefault("bucket_sizes"),
         )
         session = sql_compat.session_of(df)
         out_names = list((self.getOrDefault("output_mapping") or
                           {"prediction": "prediction"}).values())
         # Lazy distributed transform (reference keeps it a mapPartitions —
         # no driver collect).  The exact output schema comes from scoring ONE
-        # sampled row on the driver; the per-process model cache means the
-        # driver pays a single small-batch load+jit.  If the driver cannot
-        # load the export (e.g. path only readable from executors), fall
-        # back to a declared schema from output_mapping — the reference's
-        # own approach.
+        # sampled row on the driver; the sampler variant scores it at its
+        # own (1-row) shape — never padded up to a bucket — so the schema
+        # probe pays a single 1-row load+jit, not a full-batch forward.
+        # If the driver cannot load the export (e.g. path only readable
+        # from executors), fall back to a declared schema from
+        # output_mapping — the reference's own approach.
         sample = df.rdd.take(1)
         if not sample:
             fields = [(n, "double") for n in out_names]
             return sql_compat.create_dataframe(
                 _rdd_of(df, []), fields, backend, session)
         try:
-            first_out = next(iter(run_model(iter(sample))))
+            first_out = next(iter(run_model.sampler()(iter(sample))))
         except Exception as e:
             # driver cannot load/run the export (e.g. export_dir readable
             # only from executors): score ONE row on the cluster instead —
-            # take(1) computes a single partition, not the whole dataset
+            # take(1) computes a single partition, and the sampler variant
+            # scores only the first row of it (the full mapPartitions below
+            # re-scores that partition anyway; scoring all of it here would
+            # pay the first partition twice)
             logger.info(
                 "driver-side schema sampling unavailable (%s); sampling on "
                 "an executor", e)
-            first_out = df.rdd.mapPartitions(run_model).take(1)[0]
+            first_out = df.rdd.mapPartitions(run_model.sampler()).take(1)[0]
         fields = sql_compat.infer_fields(first_out)
         out_rdd = df.rdd.mapPartitions(run_model)
         if backend == sql_compat.SPARKAPI:
@@ -363,16 +374,55 @@ def _cache_token(path: str, export_dir: str):
     return fp if fp is not None else 0.0
 
 
+def _cache_insert(key: tuple, entry: tuple) -> None:
+    """Insert into ``_MODEL_CACHE``, evicting prior entries for the same
+    export path.
+
+    Entries are keyed ``(path, fn_id, mtime)``; without eviction every
+    re-export (new mtime / new fingerprint) would leak the previous params
+    pytree and jit executable for the life of the executor process.  The
+    cache is bounded by construction instead: inserting a path's CURRENT
+    artifact version evicts every entry for an older version of that path
+    — re-exports replace, they don't accumulate, even when the re-export
+    also changes the forward's identity (e.g. an explicit ``predict_fn``
+    replaced by an embedded serialized forward).  Entries for the SAME
+    artifact version under different forwards coexist (two live TFModels
+    may legitimately share one export_dir; evicting per path alone would
+    make their interleaved partitions ping-pong through full reload+jit).
+    Evicted keys also drop their serving shape-signature tracking
+    (``serving.forget``) so the compile accounting dict cannot outgrow the
+    cache either.
+    """
+    from tensorflowonspark_tpu import serving
+
+    stale = [k for k in _MODEL_CACHE if k[0] == key[0] and k[2] != key[2]]
+    for k in stale:
+        _MODEL_CACHE.pop(k, None)
+        serving.forget(k)
+        logger.info("evicted stale model cache entry %s (re-export)", k)
+    _MODEL_CACHE[key] = entry
+
+
 class _RunModel:
     """The ``mapPartitions`` closure of ``TFModel.transform``.
 
     Reference anchor: ``pipeline.py::_run_model``.  Picklable by
     construction (plain attributes); heavyweight state (restored params,
     jitted apply) lives in the per-process ``_MODEL_CACHE``.
+
+    The hot path is the bucketed serving data plane (see
+    :mod:`tensorflowonspark_tpu.serving`): columnar partition ingest →
+    pad to a bucket shape → ``device_put`` from a prefetch pump thread
+    (batch N+1 staged while batch N computes) → masked per-column
+    emission.  ``legacy=True`` preserves the pre-bucketing row loop —
+    per-row ingest, ragged tails compiled at their own size, per-cell
+    ``_pyval`` output materialization — as the measured baseline of
+    ``bench.py --serving``; it is not a production mode.
     """
 
     def __init__(self, export_dir, model_name, predict_fn, batch_size,
-                 input_mapping, output_mapping, columns, backend="sparkapi"):
+                 input_mapping, output_mapping, columns, backend="sparkapi",
+                 bucket_sizes=None, legacy=False):
         self.export_dir = export_dir
         self.model_name = model_name
         self.predict_fn = predict_fn
@@ -381,6 +431,20 @@ class _RunModel:
         self.output_mapping = output_mapping
         self.columns = list(columns)
         self.backend = backend
+        self.bucket_sizes = list(bucket_sizes) if bucket_sizes else None
+        self.legacy = legacy
+        self.sample_rows = None  # sampler(): score only the first N rows
+        self._cache_key = None  # set by _load() on the executor
+
+    def sampler(self) -> "_RunModel":
+        """A copy that scores only the FIRST row of its partition — the
+        schema-sampling fallback of ``TFModel._transform`` (the full
+        ``mapPartitions`` pass re-scores the partition anyway)."""
+        import copy
+
+        clone = copy.copy(self)
+        clone.sample_rows = 1
+        return clone
 
     # -- executor-side ------------------------------------------------------
 
@@ -401,6 +465,10 @@ class _RunModel:
         fn_id = ("saved_forward" if serialized else
                  getattr(self.predict_fn, "__qualname__", self.model_name))
         key = (path, fn_id, mtime)
+        # the serving data plane's compile accounting (serving.note_compile)
+        # tracks shape signatures per loaded model — same key as the cache,
+        # so eviction drops both together (_cache_insert)
+        self._cache_key = key
         if key in _MODEL_CACHE:
             return _MODEL_CACHE[key]
         from tensorflowonspark_tpu import obs
@@ -424,7 +492,7 @@ class _RunModel:
             # self-describing export: serve from the artifact alone — no
             # model code needed (the SavedModel-parity path)
             fn, _sig = saved_model.load_forward(self.export_dir)
-            _MODEL_CACHE[key] = (fn, state)
+            _cache_insert(key, (fn, state))
             logger.info("executor loaded serialized forward from %s",
                         self.export_dir)
             return fn, state
@@ -453,17 +521,79 @@ class _RunModel:
         else:
             raise ValueError("TFModel needs model_name or predict_fn")
         logger.info("executor loaded model from %s", self.export_dir)
-        _MODEL_CACHE[key] = (fn, params)
+        _cache_insert(key, (fn, params))
         return fn, params
 
     def __call__(self, iterator):
-        import numpy as np
+        import itertools
 
-        from tensorflowonspark_tpu import sql_compat
+        from tensorflowonspark_tpu import readers, serving
 
         fn, params = self._load()
         in_map = self.input_mapping or {c: c for c in self.columns}
         out_map = self.output_mapping  # may be None → auto names
+
+        if self.sample_rows:
+            iterator = itertools.islice(iterator, self.sample_rows)
+        if self.legacy:
+            return self._call_legacy(iterator, fn, params, in_map, out_map)
+
+        if self.sample_rows or not serving.bucketing_enabled():
+            # exact-shape mode: schema sampling scores its handful of rows
+            # at their own size (padding one row up to a bucket would pay a
+            # full-batch compile+forward for a schema probe), and
+            # TFOS_SERVING_BUCKETS=0 turns padding off for forwards whose
+            # per-example outputs depend on the whole batch
+            buckets = ()
+        else:
+            buckets = serving.resolve_buckets(self.batch_size,
+                                              self.bucket_sizes)
+        stage = serving.stager()
+
+        def staged_batches():
+            # runs on the pump thread: columnar ingest → pad to a bucket
+            # shape → device_put, all for batch N+1 while the consumer loop
+            # below computes batch N (readers.prefetched double-buffering)
+            for n, cols in serving.ingest_chunks(
+                    iterator, self.batch_size, in_map, self.columns):
+                bucket = serving.choose_bucket(n, buckets)
+                if bucket > n:
+                    cols = serving.pad_columns(cols, bucket)
+                serving.note_rows(n, bucket)
+                yield n, bucket, stage(cols)
+
+        def scored_batches():
+            # emit lags the forward by one batch: jax dispatch is async, so
+            # batch N+1's forward computes (GIL-free, on the accelerator /
+            # XLA threadpool) while the emit of batch N materializes its
+            # outputs (the first np.asarray blocks) and builds Rows — the
+            # output half of the double-buffered pipeline
+            pending = None
+            for n, fed, batch in readers.prefetched(staged_batches,
+                                                    serving.prefetch_depth()):
+                serving.note_compile(self._cache_key, batch)
+                outputs = fn(params, batch)
+                if pending is not None:
+                    yield serving.emit_rows(
+                        _name_outputs(pending[0], out_map), pending[1],
+                        self.backend, fed_rows=pending[2])
+                pending = (outputs, n, fed)
+            if pending is not None:
+                yield serving.emit_rows(
+                    _name_outputs(pending[0], out_map), pending[1],
+                    self.backend, fed_rows=pending[2])
+
+        # one generator-frame resume per BATCH; the per-row hops through
+        # the emitted lists stay C-level inside chain.from_iterable
+        return itertools.chain.from_iterable(scored_batches())
+
+    def _call_legacy(self, iterator, fn, params, in_map, out_map):
+        """The pre-bucketing row loop, kept verbatim as the measured
+        baseline of ``bench.py --serving`` (per-row ingest, ragged tails
+        compiled at their own size, per-cell ``_pyval`` emission)."""
+        import numpy as np
+
+        from tensorflowonspark_tpu import sql_compat
 
         def predict(rows):
             batch = {

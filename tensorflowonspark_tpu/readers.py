@@ -232,7 +232,7 @@ def tfrecord_batches(
 
     _stage = _stager(device_put)
 
-    yield from _prefetched(batch_gen, prefetch)
+    yield from prefetched(batch_gen, prefetch)
 
 
 def _stager(device_put) -> Callable[[dict[str, Any]], dict[str, Any]]:
@@ -253,14 +253,19 @@ def _stager(device_put) -> Callable[[dict[str, Any]], dict[str, Any]]:
     return lambda batch: batch
 
 
-def _prefetched(batch_gen_fn: Callable[[], Iterator[Any]],
-                prefetch: int) -> Iterator[Any]:
+def prefetched(batch_gen_fn: Callable[[], Iterator[Any]],
+               prefetch: int) -> Iterator[Any]:
     """Run ``batch_gen_fn()`` in a pipeline thread, ``prefetch`` items ahead.
 
     ``prefetch <= 0`` degrades to the plain generator.  Producer exceptions
     re-raise on the consumer side; abandoning the iterator (break /
     GeneratorExit) stops the pump and the underlying generator's cleanup
     (``finally`` blocks, reader pools) runs promptly.
+
+    Public because it is the ONE pump of the framework: the TFRecord/Parquet
+    training readers below and the serving data plane
+    (``pipeline._RunModel`` — batch N+1 assembled and ``device_put`` while
+    batch N computes) all double-buffer through it.
     """
     if prefetch <= 0:
         yield from batch_gen_fn()
@@ -410,7 +415,7 @@ def parquet_batches(
                 batch, pending, count = _slice_batch(pending, count, count)
                 yield _stage(batch)
 
-    yield from _prefetched(batch_gen, prefetch)
+    yield from prefetched(batch_gen, prefetch)
 
 
 def _column_to_numpy(path: str, name: str, col) -> np.ndarray:

@@ -351,6 +351,28 @@ def signature_fingerprint(export_dir: str) -> str | None:
         return None
 
 
+def pad_batch(batch: Mapping[str, Any], target: int) -> dict:
+    """Zero-pad every array's leading (batch) axis out to ``target`` rows.
+
+    The ONE padding convention of the serving stack, shared by the
+    fixed-batch artifact caller below (chunk tails) and the bucketed
+    serving data plane (``serving.pad_columns``) so masked-row semantics
+    agree everywhere.  Arrays already ≥ ``target`` rows — and 0-d inputs,
+    which carry no batch axis (mirroring ``_batch_specs``) — pass through
+    unchanged.
+    """
+    import numpy as np
+
+    out = {}
+    for k, v in batch.items():
+        v = np.asarray(v)
+        if v.ndim >= 1 and v.shape[0] < target:
+            pad = [(0, target - v.shape[0])] + [(0, 0)] * (v.ndim - 1)
+            v = np.pad(v, pad)
+        out[k] = v
+    return out
+
+
 def load_forward(export_dir: str):
     """Deserialize the saved forward.  Returns ``(fn, signature)`` with
     ``fn(state, batch) -> outputs``; raises FileNotFoundError when the
@@ -400,15 +422,9 @@ def _fixed_batch_caller(exported, fixed: int,
         n = int(np.asarray(next(iter(batch.values()))).shape[0])
         outs = []
         for start in range(0, max(n, 1), fixed):
-            chunk = {}
-            for k, v in batch.items():
-                v = np.asarray(v)
-                part = v[start:start + fixed]
-                if part.shape[0] < fixed:
-                    pad = [(0, fixed - part.shape[0])] + [(0, 0)] * (
-                        part.ndim - 1)
-                    part = np.pad(part, pad)
-                chunk[k] = part
+            chunk = pad_batch(
+                {k: np.asarray(v)[start:start + fixed]
+                 for k, v in batch.items()}, fixed)
             outs.append(
                 jax.tree.map(np.asarray, exported.call(state, chunk)))
 

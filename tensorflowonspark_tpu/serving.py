@@ -1,0 +1,395 @@
+"""Serving data plane: bucketed batch shapes, columnar ingest, masked emit.
+
+The training side stopped paying per-row Python costs in the zero-copy data
+plane rebuild (:mod:`tensorflowonspark_tpu.shm`); this module brings the
+*serving* hot path (``pipeline.TFModel.transform`` → ``_RunModel``, and the
+JNI shim's :mod:`tensorflowonspark_tpu.infer_embed`) to parity.  Three
+mechanisms, each with the measured failure mode it removes:
+
+- **Shape bucketing with pad-and-mask** (:func:`resolve_buckets` /
+  :func:`choose_bucket` / :func:`pad_columns`): every batch is zero-padded
+  up to a small fixed set of bucket sizes (default: just ``batch_size``), so
+  a jitted forward compiles once per *bucket* instead of once per distinct
+  partition-tail size — on a Spark job every partition has a ragged tail,
+  and each distinct tail size is a fresh XLA compilation (TF-Replicator,
+  arXiv:1902.00465 §3, makes the same fixed-shape argument for TPU
+  execution).  Padded rows are masked out of the emitted output
+  (:func:`emit_rows` slices every column back to the true row count).  The
+  claim is measurable: :func:`note_compile` counts distinct input-shape
+  signatures handed to each loaded forward — exactly the jit/XLA
+  compilation keys — into the ``serving_compiles_total`` counter.
+- **Columnar partition ingest** (:func:`ingest_chunks`): each chunk of
+  rows becomes column arrays via one C-level ``operator.itemgetter`` map
+  per needed column (touching only the columns the model uses — the
+  row→column direction the feed transport's feeder-side columnarization
+  shares) instead of a per-column, per-row ``row[col]`` indexing loop;
+  pyarrow ``RecordBatch``/``Table`` partition elements (real pyspark
+  ``df.mapInArrow``) take a no-per-row-work fast path through
+  ``sql_compat.arrow_batch_columns``.
+- **Masked per-column emission** (:func:`emit_rows`): one ``np.asarray`` +
+  one ``tolist()`` per output column per batch, then a single zip into
+  Rows — replacing the per-row, per-cell ``_pyval(a[i])`` materialization.
+
+The double-buffering itself lives in the caller: ``_RunModel`` runs the
+ingest + pad + ``device_put`` stage (:func:`stager`) inside a
+``readers.prefetched`` pump thread so batch N+1 is assembled and staged onto
+the device while batch N computes.
+
+Registry counters (exported with every metrics snapshot): ``serving_compiles_total``,
+``serving_rows_total``, ``serving_padded_rows_total``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Iterator, Mapping, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+#: distinct input-shape signatures observed per loaded forward — the jit
+#: compilation keys.  Keyed by the model-cache key (or any hashable handle);
+#: :func:`forget` drops entries when the owning model is evicted/closed.
+_SEEN_SHAPES: dict[Any, set] = {}
+
+
+# ---------------------------------------------------------------------------
+# Buckets
+# ---------------------------------------------------------------------------
+
+
+def resolve_buckets(batch_size: int,
+                    bucket_sizes: Sequence[int] | None = None
+                    ) -> tuple[int, ...]:
+    """The effective bucket set: sorted, deduplicated, positive.
+
+    Default (``bucket_sizes`` unset/empty) is the single bucket
+    ``(batch_size,)`` — every batch, ragged tails included, pads to the one
+    compiled shape.  Extra buckets trade padding waste for compile count:
+    ``[batch_size // 4, batch_size]`` wastes at most 75% on a tiny tail
+    while compiling twice.  Two normalizations keep the set sane: buckets
+    larger than ``batch_size`` are DROPPED (with a warning — chunking
+    never produces a batch bigger than ``batch_size``, so an oversize
+    bucket would only ever make :func:`choose_bucket` pad full batches up
+    past their own size), and the terminal ``batch_size`` bucket is always
+    included (a set whose largest bucket is smaller than ``batch_size``
+    would compile every tail above it at its own shape — the per-tail
+    compile explosion buckets exist to prevent).
+    """
+    if bucket_sizes:
+        out = sorted({int(b) for b in bucket_sizes if int(b) > 0})
+        kept = [b for b in out if b <= int(batch_size)]
+        if len(kept) != len(out):
+            logger.warning(
+                "dropping bucket size(s) %s > batch_size %d: a batch never "
+                "exceeds batch_size, so an oversize bucket would only pad "
+                "full batches past their own size",
+                [b for b in out if b > int(batch_size)], int(batch_size))
+        if kept:
+            if kept[-1] < int(batch_size):
+                # the terminal bucket must cover batch_size-row chunks, or
+                # every tail above it compiles at its own shape — the
+                # per-tail compile explosion buckets exist to prevent
+                kept.append(int(batch_size))
+            return tuple(kept)
+    return (int(batch_size),)
+
+
+def bucketing_enabled() -> bool:
+    """``TFOS_SERVING_BUCKETS=0`` disables pad-and-mask in
+    ``TFModel.transform`` (every batch then compiles at its own shape —
+    the legacy compile cost, but the columnar ingest / prefetch pipeline /
+    fast emission stay on).
+
+    The knob exists for forwards whose per-example outputs depend on the
+    WHOLE batch — inference-time batch-stats normalization, in-batch
+    softmax or contrastive scoring: padded zero rows would change the real
+    rows' values while passing every shape check, so padding must be off
+    for them."""
+    return os.environ.get("TFOS_SERVING_BUCKETS", "1").strip().lower() \
+        not in ("0", "false")
+
+
+def choose_bucket(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket that fits ``n`` rows; ``n`` itself when none does
+    (only reachable when the caller's chunk size exceeds every bucket —
+    the batch then compiles at its own shape, exactly the legacy cost)."""
+    for b in buckets:
+        if b >= n:
+            return int(b)
+    return int(n)
+
+
+def pow2_bucket(n: int) -> int:
+    """Next power-of-two ≥ n — the implicit bucket ladder used by callers
+    with no configured geometry (``infer_embed``'s JVM batches)."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def pad_columns(cols: Mapping[str, Any], target: int) -> dict:
+    """Zero-pad every column's leading axis to ``target`` rows.
+
+    Delegates to ``saved_model.pad_batch`` — the ONE padding convention,
+    shared with the fixed-batch serialized-forward caller, so masked-row
+    semantics agree on every serving path."""
+    from tensorflowonspark_tpu import saved_model
+
+    return saved_model.pad_batch(cols, target)
+
+
+def batch_rows(batch: Mapping[str, Any]) -> int:
+    """The batch's paddable row count: the leading dimension EVERY
+    ``ndim >= 1`` input shares — that shared dimension is what makes it a
+    batch axis.  0 when there is no leading axis anywhere or the leading
+    dims disagree (e.g. a per-call side input of shape ``(k,)`` riding
+    along with ``(n, d)`` features — zero-extending *that* would feed the
+    model wrong values, not padding)."""
+    dims = {int(np.shape(v)[0]) for v in batch.values()
+            if np.asarray(v).ndim >= 1}
+    if len(dims) != 1:
+        return 0
+    n = dims.pop()
+    return n if n > 0 else 0
+
+
+# ---------------------------------------------------------------------------
+# Compile accounting
+# ---------------------------------------------------------------------------
+
+
+def note_compile(key: Any, batch: Mapping[str, Any]) -> bool:
+    """Record the batch's shape signature; True when it is new for ``key``.
+
+    The signature — sorted ``(name, shape, dtype)`` per input — is exactly
+    what ``jax.jit`` keys its executable cache on, so for a jitted forward
+    "new signature" == "fresh XLA compile".  Every new signature increments
+    the ``serving_compiles_total`` counter, making the bucketing claim
+    ("compiles == buckets, not distinct tail sizes") measurable in tests,
+    in ``bench.py --serving``, and on a live ``/metrics`` endpoint."""
+    from tensorflowonspark_tpu import obs
+
+    sig = tuple(sorted(
+        (str(name), tuple(np.shape(v)),
+         str(getattr(v, "dtype", type(v).__name__)))
+        for name, v in batch.items()))
+    seen = _SEEN_SHAPES.setdefault(key, set())
+    if sig in seen:
+        return False
+    seen.add(sig)
+    obs.counter(
+        "serving_compiles_total",
+        "distinct input-shape signatures handed to a serving forward "
+        "(jit compilation keys)").inc()
+    return True
+
+
+def note_rows(n_real: int, bucket: int) -> None:
+    """Count scored rows and the padding overhead of their bucket.
+
+    ``serving_padded_rows_total / serving_rows_total`` is the padding-waste
+    ratio of the configured bucket geometry — the number to look at before
+    adding smaller buckets (each one costs a compile)."""
+    from tensorflowonspark_tpu import obs
+
+    obs.counter("serving_rows_total",
+                "rows scored through the serving data plane").inc(n_real)
+    if bucket > n_real:
+        obs.counter(
+            "serving_padded_rows_total",
+            "rows invented by bucket padding (masked out of the output)"
+        ).inc(bucket - n_real)
+
+
+def forget(key: Any = None) -> None:
+    """Drop shape tracking for one model key (or all, with no argument) —
+    called when the owning model-cache entry is evicted or a handle
+    closes, so the tracking dict cannot outgrow the model cache."""
+    if key is None:
+        _SEEN_SHAPES.clear()
+    else:
+        _SEEN_SHAPES.pop(key, None)
+
+
+# ---------------------------------------------------------------------------
+# Columnar ingest
+# ---------------------------------------------------------------------------
+
+
+def ingest_chunks(iterator, chunk_rows: int, in_map: Mapping[str, str],
+                  columns: Sequence[str]
+                  ) -> Iterator[tuple[int, dict[str, np.ndarray]]]:
+    """Partition iterator → ``(n_rows, {feature: column array})`` chunks.
+
+    Row-shaped elements (either backend's ``Row``, plain tuples, dicts) are
+    buffered to ``chunk_rows`` and columnarized in one transpose pass;
+    pyarrow ``RecordBatch``/``Table`` elements (``df.mapInArrow``-style
+    partitions) are sliced straight from their column buffers with no
+    per-row work at all.  ``in_map`` maps DataFrame column → model input
+    name; ``columns`` supplies positional names for rows that don't carry
+    their own fields (plain tuples).
+    """
+    from tensorflowonspark_tpu import sql_compat
+
+    pending: list[Any] = []
+
+    def flush():
+        n, cols = _columnarize_rows(pending, in_map, columns)
+        pending.clear()
+        return n, cols
+
+    for item in iterator:
+        arrow = sql_compat.arrow_batch_columns(item, columns=list(in_map))
+        if arrow is not None:
+            if pending:
+                yield flush()
+            missing = [c for c in in_map if c not in arrow]
+            if missing:
+                raise KeyError(
+                    f"arrow partition batch lacks input column(s) {missing}; "
+                    f"has {sorted(arrow)}")
+            total = int(next(iter(arrow.values())).shape[0]) if arrow else 0
+            for start in range(0, total, chunk_rows):
+                stop = min(start + chunk_rows, total)
+                yield stop - start, {feat: arrow[col][start:stop]
+                                     for col, feat in in_map.items()}
+            continue
+        pending.append(item)
+        if len(pending) >= chunk_rows:
+            yield flush()
+    if pending:
+        yield flush()
+
+
+def _columnarize_rows(rows: list, in_map: Mapping[str, str],
+                      columns: Sequence[str]
+                      ) -> tuple[int, dict[str, np.ndarray]]:
+    """One chunk of rows → columns, one C-level extraction pass per column.
+
+    ``operator.itemgetter(pos)`` over the whole chunk (C speed on
+    tuple-like pyspark Rows, one ``__getitem__`` per row on the substrate
+    Row) touches only the columns the model actually needs — a partition
+    often carries more — instead of transposing every field of every row.
+    Positional extraction assumes the schema-uniform rows a DataFrame
+    partition guarantees; a chunk that violates that (hand-built RDD rows
+    of mixed arity) falls back to the legacy by-name per-row indexing.
+    """
+    import operator
+
+    first = rows[0]
+    if isinstance(first, dict):
+        return len(rows), {feat: np.asarray([r[col] for r in rows])
+                           for col, feat in in_map.items()}
+    fields = getattr(first, "__fields__", None)
+    if fields is not None:  # pyspark attribute / sparkapi method
+        names = list(fields() if callable(fields) else fields)
+    else:
+        names = list(columns)
+    out = {}
+    for col, feat in in_map.items():
+        try:
+            pos = names.index(col)
+        except ValueError:
+            raise KeyError(
+                f"input column {col!r} not found in partition rows "
+                f"(row fields: {names})") from None
+        try:
+            out[feat] = np.asarray(list(map(operator.itemgetter(pos), rows)))
+        except IndexError:
+            # a short row (mixed arity): legacy by-name behavior — numpy /
+            # the model complains about whatever the names produce
+            out[feat] = np.asarray([r[col] for r in rows])
+    return len(rows), out
+
+
+# ---------------------------------------------------------------------------
+# Device staging + pipeline knobs
+# ---------------------------------------------------------------------------
+
+
+def stager():
+    """Batch-staging function for the prefetch pump thread.
+
+    ``jax.device_put`` from the pump overlaps H2D transfer with the
+    consumer's compute on batch N-1 (the readers double-buffering, reused).
+    Fail-soft: a backend that can't stage (or a host-only predict_fn world
+    with no jax) hands back host arrays — numpy consumers accept jax arrays
+    and vice versa, so staging is a throughput knob, never a correctness
+    one.  ``TFOS_SERVING_DEVICE_PUT``: unset/``auto`` stages only when the
+    default backend is a real accelerator (on CPU there is no H2D to
+    overlap — the put is pure per-batch dispatch overhead), ``1`` always,
+    ``0`` never."""
+    mode = os.environ.get("TFOS_SERVING_DEVICE_PUT", "auto").strip().lower()
+    if mode in ("0", "false"):
+        return lambda batch: batch
+    if mode not in ("1", "true"):  # auto
+        try:
+            import jax
+
+            if jax.default_backend() == "cpu":
+                return lambda batch: batch
+        except Exception:
+            return lambda batch: batch
+
+    def put(batch: dict) -> dict:
+        try:
+            import jax
+
+            return {k: jax.device_put(v) for k, v in batch.items()}
+        except Exception:
+            return batch
+
+    return put
+
+
+def prefetch_depth() -> int:
+    """Batches staged ahead by the serving pump (``TFOS_SERVING_PREFETCH``,
+    default 2; 0 degrades to fully synchronous assembly)."""
+    try:
+        return int(os.environ.get("TFOS_SERVING_PREFETCH", "2"))
+    except ValueError:
+        return 2
+
+
+# ---------------------------------------------------------------------------
+# Masked emission
+# ---------------------------------------------------------------------------
+
+
+def emit_rows(named: Mapping[str, Any], n_real: int, backend: str,
+              fed_rows: int | None = None) -> list:
+    """Named output arrays → ``n_real`` Rows, one ``tolist()`` per column.
+
+    Slicing to ``n_real`` is the mask half of pad-and-mask: rows the bucket
+    padding invented are never emitted.  Every output's leading dimension
+    must EQUAL the row count of the batch that was fed (``fed_rows`` — the
+    bucket size for a padded batch; defaults to ``n_real``): that is what
+    makes it a per-example output.  An output of any other length — a
+    pooled embedding, a scalar metric, anything aggregated over the batch —
+    is rejected loudly instead of being sliced into plausible-looking
+    garbage rows (the contract the legacy ``a[i]`` loop silently assumed).
+    Returns a list (not a generator): the whole batch materializes in one
+    comprehension, so the caller's ``yield from`` is the only per-row
+    frame resume."""
+    from tensorflowonspark_tpu import sql_compat
+
+    expect = n_real if fed_rows is None else fed_rows
+    cols = list(named.keys())
+    pylists = []
+    for c in cols:
+        a = np.asarray(named[c])
+        if a.ndim == 0 or a.shape[0] != expect:
+            raise ValueError(
+                f"serving output {c!r} has shape {np.shape(a)} but the batch "
+                f"fed {expect} rows — outputs must be per-example (leading "
+                "batch dimension matching the fed batch) to be emitted as "
+                "DataFrame rows")
+        pylists.append(a[:n_real].tolist())
+    make = sql_compat.row_maker(cols, backend)
+    if len(pylists) == 1:
+        return [make([v]) for v in pylists[0]]
+    return [make(values) for values in zip(*pylists)]

@@ -135,6 +135,28 @@ def _pwrite_all(fd: int, buf, offset: int) -> None:
         offset += n
 
 
+def transpose_rows(rows: Sequence[Any]) -> list[tuple] | None:
+    """Equal-arity tuple-like rows → per-column value tuples, or None.
+
+    ONE C-level pass (``zip(*rows)``) instead of a per-column, per-row
+    indexing loop — the transpose behind :func:`columnarize`'s feeder-side
+    columnarization.  (The serving ingest, ``serving.ingest_chunks``,
+    extracts per needed column with ``operator.itemgetter`` instead: a
+    partition often carries more columns than the model reads, so a full
+    transpose would touch fields serving never uses.)  Returns None on
+    mixed arity or rows without a length (the caller falls back to its
+    per-row path)."""
+    if not rows:
+        return None
+    try:
+        ncols = len(rows[0])
+        if any(len(r) != ncols for r in rows):
+            return None  # mixed arity: don't silently truncate rows
+    except TypeError:
+        return None
+    return list(zip(*rows))
+
+
 def columnarize(rows: Sequence[Any]) -> list[np.ndarray] | None:
     """Rows → contiguous fixed-dtype column arrays, or None.
 
@@ -148,10 +170,10 @@ def columnarize(rows: Sequence[Any]) -> list[np.ndarray] | None:
     first = rows[0]
     try:
         if isinstance(first, (list, tuple)) and not np.isscalar(first):
-            ncols = len(first)
-            if any(len(r) != ncols for r in rows):
-                return None  # mixed arity: don't silently truncate rows
-            cols = [np.asarray([r[c] for r in rows]) for c in range(ncols)]
+            transposed = transpose_rows(rows)
+            if transposed is None:
+                return None
+            cols = [np.asarray(col) for col in transposed]
         else:
             cols = [np.asarray(rows)]
     except Exception:

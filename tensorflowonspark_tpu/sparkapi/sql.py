@@ -16,27 +16,37 @@ import numpy as np
 
 
 class Row:
-    """Ordered named fields with attribute and index access (pyspark.sql.Row)."""
+    """Ordered named fields with attribute and index access (pyspark.sql.Row).
+
+    ``__slots__`` because Rows are the unit of every collect/transform:
+    no per-instance ``__dict__`` halves construction cost and memory on
+    the serving emit path (millions of Rows), and pickling still works
+    (protocol-2 slot state)."""
+
+    __slots__ = ("_fields", "_values")
 
     def __init__(self, **kwargs: Any):
-        self.__dict__["_fields"] = list(kwargs.keys())
-        self.__dict__["_values"] = list(kwargs.values())
+        self._fields = list(kwargs.keys())
+        self._values = list(kwargs.values())
 
     @classmethod
     def from_fields(cls, fields: Sequence[str], values: Sequence[Any]) -> "Row":
         r = cls.__new__(cls)
-        r.__dict__["_fields"] = list(fields)
-        r.__dict__["_values"] = list(values)
+        r._fields = list(fields)
+        r._values = list(values)
         return r
 
     def __getattr__(self, name: str) -> Any:
-        # guard via __dict__: during unpickling __getattr__ runs before the
-        # instance dict is restored, and self._fields would recurse forever
-        d = self.__dict__
-        if "_fields" not in d:
-            raise AttributeError(name)
+        # only reached when normal lookup fails (field names, or slots not
+        # yet set mid-unpickle).  object.__getattribute__ bypasses this
+        # hook, so an unset slot raises cleanly instead of recursing.
         try:
-            return d["_values"][d["_fields"].index(name)]
+            fields = object.__getattribute__(self, "_fields")
+            values = object.__getattribute__(self, "_values")
+        except AttributeError:
+            raise AttributeError(name) from None
+        try:
+            return values[fields.index(name)]
         except ValueError:
             raise AttributeError(name) from None
 

@@ -215,9 +215,9 @@ def test_feed_value_without_transport_attribution_fails(tmp_path):
 
 def test_feed_regression_gated_within_same_transport(tmp_path):
     paths = [
-        _write(tmp_path, "BENCH_r07.json",
+        _write(tmp_path, "BENCH_r06.json",
                _half(2400.0, **_feed_fields(rps=2000.0))),
-        _write(tmp_path, "BENCH_r08.json",
+        _write(tmp_path, "BENCH_r07.json",
                _half(2400.0, **_feed_fields(rps=500.0))),  # data plane 4× off
     ]
     verdict = bench_gate.gate(paths)
@@ -230,9 +230,9 @@ def test_feed_not_compared_across_transports_or_configs(tmp_path):
     # transport changed (shm host → pickle fallback host): different
     # experiment, no regression judgment in either direction
     paths = [
-        _write(tmp_path, "BENCH_r07.json",
+        _write(tmp_path, "BENCH_r06.json",
                _half(2400.0, **_feed_fields(rps=2000.0))),
-        _write(tmp_path, "BENCH_r08.json",
+        _write(tmp_path, "BENCH_r07.json",
                _half(2400.0, **_feed_fields(
                    rps=500.0, transport="pickle",
                    feed_transport_reason="shm unavailable"))),
@@ -244,9 +244,9 @@ def test_feed_not_compared_across_transports_or_configs(tmp_path):
                for c in verdict["checks"])
     # feed config changed (row size sweep): also incomparable
     paths = [
-        _write(tmp_path, "BENCH_r07.json",
+        _write(tmp_path, "BENCH_r06.json",
                _half(2400.0, **_feed_fields(rps=2000.0))),
-        _write(tmp_path, "BENCH_r08.json",
+        _write(tmp_path, "BENCH_r07.json",
                _half(2400.0, **_feed_fields(rps=500.0, feed_row_bytes=264))),
     ]
     verdict = bench_gate.gate(paths)
@@ -255,9 +255,9 @@ def test_feed_not_compared_across_transports_or_configs(tmp_path):
     # startup/teardown) amortizes over rows_total, so rows/sec at a
     # different total is a different experiment
     paths = [
-        _write(tmp_path, "BENCH_r07.json",
+        _write(tmp_path, "BENCH_r06.json",
                _half(2400.0, **_feed_fields(rps=2000.0))),
-        _write(tmp_path, "BENCH_r08.json",
+        _write(tmp_path, "BENCH_r07.json",
                _half(2400.0, **_feed_fields(rps=500.0,
                                             feed_rows_total=1024))),
     ]
@@ -272,8 +272,8 @@ def test_feed_prior_from_degraded_round_still_compared(tmp_path):
                            **_feed_fields(rps=2000.0))
     healthy_bad_feed = _half(2400.0, **_feed_fields(rps=500.0))
     paths = [
-        _write(tmp_path, "BENCH_r07.json", degraded_prior),
-        _write(tmp_path, "BENCH_r08.json", healthy_bad_feed),
+        _write(tmp_path, "BENCH_r06.json", degraded_prior),
+        _write(tmp_path, "BENCH_r07.json", healthy_bad_feed),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "fail"
@@ -288,13 +288,114 @@ def test_feed_regression_judged_even_on_degraded_newest(tmp_path):
     degraded_bad_feed = _half(600.0, platform="cpu", degraded="probe failed",
                               **_feed_fields(rps=500.0))
     paths = [
-        _write(tmp_path, "BENCH_r07.json", healthy_prior),
-        _write(tmp_path, "BENCH_r08.json", degraded_bad_feed),
+        _write(tmp_path, "BENCH_r06.json", healthy_prior),
+        _write(tmp_path, "BENCH_r07.json", degraded_bad_feed),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "fail"
     assert any("feed_rows_per_sec" in r and "data plane" in r
                for r in verdict["reasons"])
+
+
+def _serve_fields(rps=300000.0, ingest="arrow", **extra):
+    fields = {"serve_rows_per_sec": rps, "serve_ingest": ingest,
+              "serve_rows_per_sec_legacy": rps / 3.5,
+              "serve_speedup": 3.5, "serving_compiles_total": 2,
+              "serve_rows_total": 16384, "serve_batch_size": 1024,
+              "serve_row_bytes": 1032, "serve_bucket_sizes": [256, 1024]}
+    fields.update(extra)
+    return fields
+
+
+def _r8(**extra):
+    """A round-8-complete primary half (feed + serving stamped)."""
+    return _half(2400.0, **_feed_fields(), **_serve_fields(**extra))
+
+
+def test_serving_field_required_on_primary_from_round_8(tmp_path):
+    # round 7: grandfathered
+    verdict = bench_gate.gate(
+        [_write(tmp_path, "BENCH_r07.json", _half(2400.0, **_feed_fields()))])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # round 8+: the primary must carry the serving microbench
+    verdict = bench_gate.gate(
+        [_write(tmp_path, "BENCH_r08.json", _half(2400.0, **_feed_fields()))])
+    assert verdict["verdict"] == "fail"
+    assert any("serve_rows_per_sec" in r for r in verdict["reasons"])
+    # measured value + ingest attribution satisfies
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r08.json", _r8())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # explicit null + reason satisfies too
+    verdict = bench_gate.gate([_write(
+        tmp_path, "BENCH_r08.json",
+        _half(2400.0, **_feed_fields(), serve_rows_per_sec=None,
+              serve_reason="wall budget exhausted"))])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # the secondary half never needs it (stamped once per run)
+    wd = _half(103.0, metric="wide_deep_steps_per_sec")
+    wd["vs_baseline"] = 1.03
+    verdict = bench_gate.gate([_write(
+        tmp_path, "BENCH_r08.json", dict(_r8(), secondary=wd))])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+
+
+def test_serving_value_without_ingest_attribution_fails(tmp_path):
+    fields = _serve_fields()
+    del fields["serve_ingest"]
+    verdict = bench_gate.gate([_write(
+        tmp_path, "BENCH_r08.json",
+        _half(2400.0, **_feed_fields(), **fields))])
+    assert verdict["verdict"] == "fail"
+    assert any("serve_ingest" in r for r in verdict["reasons"])
+
+
+def test_serving_regression_gated_within_same_geometry(tmp_path):
+    paths = [
+        _write(tmp_path, "BENCH_r08.json", _r8(rps=300000.0)),
+        _write(tmp_path, "BENCH_r09.json", _r8(rps=60000.0)),  # 5× off
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("serve_rows_per_sec" in r and "serving data plane" in r
+               for r in verdict["reasons"])
+
+
+def test_serving_not_compared_across_ingest_or_geometry(tmp_path):
+    # ingest representation changed (arrow → rows fallback): different
+    # experiment, no regression judgment in either direction
+    paths = [
+        _write(tmp_path, "BENCH_r08.json", _r8(rps=300000.0)),
+        _write(tmp_path, "BENCH_r09.json", _r8(rps=60000.0, ingest="rows")),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    assert any(c["name"] == "regression:serve_rows_per_sec"
+               and "no comparable prior" in c["detail"]
+               for c in verdict["checks"])
+    # bucket geometry changed: also incomparable (padding waste and
+    # compile count are properties of the bucket set)
+    paths = [
+        _write(tmp_path, "BENCH_r08.json", _r8(rps=300000.0)),
+        _write(tmp_path, "BENCH_r09.json",
+               _r8(rps=60000.0, serve_bucket_sizes=[1024])),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+
+
+def test_serving_regression_judged_even_on_degraded_newest(tmp_path):
+    """The serving number is host-side: a degraded accelerator half must
+    not short-circuit its regression judgment (same rule as feed)."""
+    degraded_bad = dict(
+        _half(600.0, platform="cpu", degraded="probe failed",
+              **_feed_fields(), **_serve_fields(rps=60000.0)))
+    paths = [
+        _write(tmp_path, "BENCH_r08.json", _r8(rps=300000.0)),
+        _write(tmp_path, "BENCH_r09.json", degraded_bad),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("serve_rows_per_sec" in r for r in verdict["reasons"])
 
 
 def test_rebaselined_batch_size_not_compared_across_configs(tmp_path):

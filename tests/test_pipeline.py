@@ -133,6 +133,203 @@ def test_get_meta_graph_def_lists_export(tmp_path):
     assert meta == {"params/w": {"shape": (3, 2), "dtype": "float32"}}
 
 
+# ---------------------------------------------------------------------------
+# Serving data plane (ISSUE 5): bucketing, pad-mask, cache eviction, sampler
+# ---------------------------------------------------------------------------
+
+
+def _export_linear(tmp_path, in_dim=6, out_dim=2, seed=0):
+    from tensorflowonspark_tpu import compat
+
+    rng = np.random.RandomState(seed)
+    w = rng.randn(in_dim, out_dim).astype(np.float32)
+    export_dir = str(tmp_path / "export")
+    compat.export_saved_model({"params": {"w": w}}, export_dir)
+    return export_dir, w
+
+
+def _linear_predict(params, batch):
+    import jax.numpy as jnp
+
+    return {"score": jnp.asarray(batch["x"]) @ params["w"]}
+
+
+def _serving_runner(export_dir, batch_size=8, bucket_sizes=None,
+                    legacy=False):
+    import jax
+
+    return pipeline._RunModel(
+        export_dir=export_dir, model_name=None,
+        predict_fn=jax.jit(_linear_predict), batch_size=batch_size,
+        input_mapping={"x": "x"}, output_mapping={"score": "score"},
+        columns=["x", "id"], backend="sparkapi",
+        bucket_sizes=bucket_sizes, legacy=legacy)
+
+
+def _feature_rows(n, in_dim=6, seed=1):
+    from tensorflowonspark_tpu.sparkapi.sql import Row
+
+    rng = np.random.RandomState(seed)
+    feats = rng.randn(n, in_dim).astype(np.float32)
+    return [Row.from_fields(["x", "id"], [feats[i], i]) for i in range(n)], feats
+
+
+def test_ragged_tails_compile_once_per_bucket_and_mask_padding(tmp_path):
+    """Acceptance: partitions whose sizes are NOT multiples of batch_size
+    compile no executable beyond the configured buckets — and the bucketed
+    outputs equal the legacy row loop's on the same rows (padded rows are
+    never emitted)."""
+    from tensorflowonspark_tpu import obs, serving
+
+    export_dir, w = _export_linear(tmp_path)
+    rows, feats = _feature_rows(61)
+    # ragged partitions with three DISTINCT tail sizes (17, 21, 23 rows →
+    # tails 1, 5, 7 at batch_size 8): the legacy plane compiles each tail
+    # at its own shape, the bucketed plane pads everything to one bucket
+    parts = [rows[:17], rows[17:38], rows[38:61]]
+
+    counter = obs.counter("serving_compiles_total")
+    c0 = counter.value
+    bucketed = _serving_runner(export_dir, batch_size=8)
+    got = []
+    for part in parts:
+        got.extend(bucketed(iter(part)))
+    assert counter.value - c0 == 1  # == len(buckets), NOT distinct tails
+
+    legacy = _serving_runner(export_dir, legacy=True)
+    want = []
+    for part in parts:
+        want.extend(legacy(iter(part)))
+    assert len(got) == len(want) == 61
+    np.testing.assert_allclose(
+        np.asarray([r["score"] for r in got]),
+        np.asarray([r["score"] for r in want]), atol=1e-5)
+    # and against the closed form, to catch a shared wrong answer
+    np.testing.assert_allclose(
+        np.asarray([r["score"] for r in got]), feats @ w, atol=1e-5)
+
+    # a second bucket geometry on the SAME loaded model: the small bucket
+    # catches small tails; compile count == bucket count
+    c1 = counter.value
+    two = _serving_runner(export_dir, batch_size=8, bucket_sizes=[4, 8])
+    for part in parts:
+        list(two(iter(part)))
+    assert counter.value - c1 == 1  # the 4-bucket is new; 8 already seen
+
+
+def test_serving_pump_failure_propagates_to_consumer(tmp_path):
+    """A failure on the pipeline (pump) thread — here a missing input
+    column discovered during columnar ingest — must surface to the
+    consuming iterator, not wedge it."""
+    export_dir, _ = _export_linear(tmp_path)
+    rm = pipeline._RunModel(
+        export_dir=export_dir, model_name=None,
+        predict_fn=lambda p, b: {"score": b["x"]}, batch_size=8,
+        input_mapping={"missing_col": "x"}, output_mapping=None,
+        columns=["x", "id"], backend="sparkapi")
+    rows, _ = _feature_rows(10)
+    with pytest.raises(KeyError, match="missing_col"):
+        list(rm(iter(rows)))
+
+
+def test_model_cache_evicts_prior_entry_on_reexport(tmp_path):
+    """Satellite: re-exports must replace, not accumulate — one live cache
+    entry per (path, fn), and the serving shape tracking goes with it."""
+    from tensorflowonspark_tpu import serving
+
+    key_v1 = ("/exp/model", "fwd", 1.0)
+    key_v2 = ("/exp/model", "fwd", 2.0)
+    key_v3 = ("/exp/model", "saved_forward", 3.0)
+    other = ("/other/model", "fwd", 1.0)
+    for k in (key_v1, key_v2, key_v3, other):
+        pipeline._MODEL_CACHE.pop(k, None)
+    try:
+        pipeline._cache_insert(key_v1, ("fn1", "params1"))
+        pipeline._cache_insert(other, ("fn_other", "params_other"))
+        serving.note_compile(key_v1, {"x": np.zeros((2, 2))})
+        pipeline._cache_insert(key_v2, ("fn2", "params2"))
+        assert key_v1 not in pipeline._MODEL_CACHE  # evicted (re-export)
+        assert pipeline._MODEL_CACHE[key_v2] == ("fn2", "params2")
+        assert other in pipeline._MODEL_CACHE  # different path untouched
+        assert key_v1 not in serving._SEEN_SHAPES  # accounting dropped too
+        # same key re-insert is a no-op eviction-wise
+        pipeline._cache_insert(key_v2, ("fn2b", "params2b"))
+        assert pipeline._MODEL_CACHE[key_v2] == ("fn2b", "params2b")
+        # eviction keys on the artifact VERSION, not the forward identity:
+        # a re-export that also changes the forward (predict_fn → embedded
+        # serialized forward) must still replace, not accumulate
+        pipeline._cache_insert(key_v3, ("fn3", "params3"))
+        assert key_v2 not in pipeline._MODEL_CACHE
+        assert pipeline._MODEL_CACHE[key_v3] == ("fn3", "params3")
+        assert other in pipeline._MODEL_CACHE
+        # ...but two live forwards over the SAME artifact version coexist
+        # (two TFModels sharing one export_dir must not ping-pong each
+        # other's entries through full reload+jit)
+        key_sibling = ("/exp/model", "my_fn", 3.0)
+        pipeline._cache_insert(key_sibling, ("fn_sib", "params_sib"))
+        assert key_v3 in pipeline._MODEL_CACHE
+        assert pipeline._MODEL_CACHE[key_sibling] == ("fn_sib", "params_sib")
+    finally:
+        for k in (key_v1, key_v2, key_v3, other,
+                  ("/exp/model", "my_fn", 3.0)):
+            pipeline._MODEL_CACHE.pop(k, None)
+            serving.forget(k)
+
+
+def test_sampler_scores_only_the_first_row(tmp_path):
+    """Satellite: the schema-sampling fallback must not score the whole
+    first partition (the full mapPartitions re-scores it anyway)."""
+    export_dir, w = _export_linear(tmp_path)
+    rm = _serving_runner(export_dir, batch_size=8)
+    rows, feats = _feature_rows(20)
+    from tensorflowonspark_tpu import obs
+
+    padded = obs.counter("serving_padded_rows_total", "")
+    p0 = padded.value
+    out = list(rm.sampler()(iter(rows)))
+    assert len(out) == 1
+    np.testing.assert_allclose(
+        np.asarray(out[0]["score"]), feats[0] @ w, atol=1e-5)
+    # the sample scores at its own 1-row shape — padding it up to a bucket
+    # would pay a full-batch compile+forward for a schema probe
+    assert padded.value == p0
+    # the original runner is untouched (sampler returns a copy)
+    assert rm.sample_rows is None
+    assert len(list(rm(iter(rows)))) == 20
+
+
+def test_serving_buckets_opt_out_env_disables_padding(tmp_path, monkeypatch):
+    """TFOS_SERVING_BUCKETS=0: forwards whose per-example outputs depend
+    on the whole batch (in-batch normalization/softmax) need padding OFF —
+    every batch then runs at its own shape, outputs unchanged."""
+    from tensorflowonspark_tpu import obs
+
+    monkeypatch.setenv("TFOS_SERVING_BUCKETS", "0")
+    export_dir, w = _export_linear(tmp_path)
+    rm = _serving_runner(export_dir, batch_size=8)
+    rows, feats = _feature_rows(11)  # ragged: 8 + 3
+    padded = obs.counter("serving_padded_rows_total", "")
+    p0 = padded.value
+    out = list(rm(iter(rows)))
+    assert len(out) == 11
+    np.testing.assert_allclose(
+        np.asarray([r["score"] for r in out]), feats @ w, atol=1e-5)
+    assert padded.value == p0  # the 3-row tail ran at shape 3, unpadded
+
+
+def test_transform_bucket_sizes_param_flows_through(tmp_path):
+    """TFModel.setBucketSizes reaches the executor-side _RunModel."""
+    model = TFModel().setBucketSizes([4, 16]).setExportDir("/nope")
+    assert model.getBucketSizes() == [4, 16]
+    rm = pipeline._RunModel(
+        export_dir="/e", model_name=None, predict_fn=None, batch_size=16,
+        input_mapping=None, output_mapping=None, columns=["x"],
+        bucket_sizes=model.getBucketSizes())
+    from tensorflowonspark_tpu import serving
+
+    assert serving.resolve_buckets(rm.batch_size, rm.bucket_sizes) == (4, 16)
+
+
 def test_single_node_env_probes_serving_health(monkeypatch):
     """The cluster-less serving path probes chip health once per process:
     a wedged chip raises fast and named instead of hanging the inference

@@ -101,6 +101,26 @@ def test_abandoned_iterator_stops_threads(parts):
     assert not leaked, leaked
 
 
+def test_prefetched_is_public_and_propagates_producer_errors():
+    """``readers.prefetched`` is the ONE pump of the framework (training
+    readers + the serving data plane double-buffer through it): a
+    producer exception must re-raise on the consumer side, after the
+    items produced before it — no wedge, no silent truncation."""
+    def gen():
+        yield 1
+        yield 2
+        raise RuntimeError("pump blew up")
+
+    got = []
+    with pytest.raises(RuntimeError, match="pump blew up"):
+        for item in readers.prefetched(gen, 2):
+            got.append(item)
+    assert got == [1, 2]
+    # prefetch <= 0 degrades to the plain generator, same contract
+    with pytest.raises(RuntimeError, match="pump blew up"):
+        list(readers.prefetched(gen, 0))
+
+
 def test_prefetch_overlaps_feed_and_compute(parts, tmp_path):
     """With prefetch, wall time ≈ max(feed, compute), not their sum."""
     n_batches = 8

@@ -337,6 +337,113 @@ def test_infer_embed_serves_self_describing_export(tmp_path):
         infer_embed.close(h)
 
 
+def test_infer_embed_buckets_drifting_batch_sizes(tmp_path, monkeypatch):
+    """Serving-data-plane reuse (ISSUE 5 satellite): repeated JVM calls
+    with drifting batch sizes pad to power-of-two buckets — O(log n)
+    compiled shapes, padded rows sliced off every output."""
+    from tensorflowonspark_tpu import serving
+
+    monkeypatch.delenv("TFOS_INFER_BUCKETS", raising=False)
+    fwd, state = _toy_forward(), _toy_state()
+    d = str(tmp_path / "exp")
+    compat.export_saved_model(
+        state, d, forward_fn=fwd,
+        example_batch={"x": np.zeros((2, 5), np.float32)})
+    h = infer_embed.load(d)
+    rng = np.random.RandomState(7)
+    try:
+        for n in (3, 5, 6, 7, 9, 11, 13):  # 7 distinct sizes
+            x = rng.randn(n, 5).astype(np.float32)
+            infer_embed.set_input(h, "x", x.tobytes(), (n, 5), 0)
+            infer_embed.run(h)
+            assert infer_embed.output_shape(h) == (n,)  # sliced, not padded
+            got = np.frombuffer(infer_embed.get_output(h), np.float32)
+            np.testing.assert_allclose(
+                got, _jit_expect(fwd, state, x)["score"], atol=1e-6)
+        sigs = serving._SEEN_SHAPES[("infer_embed", h)]
+        # the first TWO distinct sizes (3, 5) run at their true shape —
+        # the per-example evidence runs — then everything pads to buckets
+        # 8 and 16: 4 compiled shapes, not 7
+        assert len(sigs) == 4
+    finally:
+        infer_embed.close(h)
+    # close() drops the shape tracking with the handle
+    assert ("infer_embed", h) not in serving._SEEN_SHAPES
+
+
+def test_infer_embed_never_pads_aggregating_forward(tmp_path, monkeypatch):
+    """Evidence-gated padding: a forward whose output aggregates OVER the
+    batch (pooled embedding — no per-example batch axis) must get exact
+    results at every size, never zero-skewed aggregates or sliced vectors,
+    with bucketing left ON (default).  Includes the adversarial
+    coincidence where the pooled dim equals a batch size."""
+    import jax.numpy as jnp
+
+    monkeypatch.delenv("TFOS_INFER_BUCKETS", raising=False)
+
+    def fwd(state, batch):
+        # mean over the batch axis: padding rows with zeros would skew this
+        return {"pooled": jnp.tanh(batch["x"] @ state["params"]["w"]
+                                   ).mean(axis=0)}
+
+    state = _toy_state()  # w: (5, 3) → pooled dim 3
+    d = str(tmp_path / "exp")
+    compat.export_saved_model(
+        state, d, forward_fn=fwd,
+        example_batch={"x": np.zeros((2, 5), np.float32)})
+    h = infer_embed.load(d)
+    rng = np.random.RandomState(11)
+    try:
+        # n=3: the adversarial coincidence FIRST — pooled dim (3) equals
+        # the batch size, so this call's output shapes look per-example.
+        # One coincidence must not enable padding (evidence needs TWO
+        # distinct confirmed sizes; a fixed-size aggregate can match at
+        # most one), so...
+        # n=5: still runs at the true shape; pooled (3,) != 5 is the
+        # counter-evidence that disables bucketing for the handle.
+        # n=4: stays exact-shape (sticky disable) — full exact vector.
+        for n in (3, 5, 4):
+            x = rng.randn(n, 5).astype(np.float32)
+            infer_embed.set_input(h, "x", x.tobytes(), (n, 5), 0)
+            infer_embed.run(h)
+            assert infer_embed.output_shape(h) == (3,)
+            got = np.frombuffer(infer_embed.get_output(h), np.float32)
+            np.testing.assert_allclose(
+                got, _jit_expect(fwd, state, x)["pooled"], atol=1e-6)
+    finally:
+        infer_embed.close(h)
+
+
+def test_infer_embed_bucketing_opt_out(tmp_path, monkeypatch):
+    monkeypatch.setenv("TFOS_INFER_BUCKETS", "0")
+    fwd, state = _toy_forward(), _toy_state()
+    d = str(tmp_path / "exp")
+    compat.export_saved_model(
+        state, d, forward_fn=fwd,
+        example_batch={"x": np.zeros((2, 5), np.float32)})
+    h = infer_embed.load(d)
+    try:
+        x = np.random.RandomState(1).randn(3, 5).astype(np.float32)
+        infer_embed.set_input(h, "x", x.tobytes(), (3, 5), 0)
+        infer_embed.run(h)
+        assert infer_embed.output_shape(h) == (3,)
+        got = np.frombuffer(infer_embed.get_output(h), np.float32)
+        np.testing.assert_allclose(
+            got, _jit_expect(fwd, state, x)["score"], atol=1e-6)
+    finally:
+        infer_embed.close(h)
+
+
+def test_pad_batch_is_the_one_padding_convention():
+    batch = {"x": np.ones((3, 2), np.float32), "n": np.float32(1.0),
+             "big": np.zeros((5, 2))}
+    out = saved_model.pad_batch(batch, 4)
+    assert out["x"].shape == (4, 2)
+    np.testing.assert_array_equal(out["x"][3], 0.0)
+    assert out["n"].shape == ()  # 0-d carries no batch axis
+    assert out["big"].shape == (5, 2)  # already ≥ target: untouched
+
+
 def test_infer_embed_weights_only_needs_model_name(tmp_path):
     d = str(tmp_path / "exp")
     compat.export_saved_model(_toy_state(), d)

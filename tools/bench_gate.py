@@ -28,7 +28,12 @@ so the artifact schema stays total.  From round ``--require-feed-from``
 half must carry ``feed_rows_per_sec`` with its ``feed_transport``
 attribution (again: explicit ``null`` + ``feed_transport_reason`` allowed);
 a healthy feed number is regression-judged against the best prior run with
-the same transport and feed config.
+the same transport and feed config.  From round ``--require-serving-from``
+(default 8, the round that introduced the bucketed serving data plane) the
+primary half must likewise carry ``serve_rows_per_sec`` with its
+``serve_ingest`` attribution (or explicit ``null`` + ``serve_reason``);
+healthy serving numbers are only compared across runs with the same ingest
+representation and bucket geometry.
 
 Usage::
 
@@ -59,10 +64,20 @@ DEFAULT_REQUIRE_ROOFLINE_FROM = 6
 #: first round whose primary half must carry the feed-transport microbench
 #: (``feed_rows_per_sec``, introduced with the zero-copy data plane)
 DEFAULT_REQUIRE_FEED_FROM = 7
+#: first round whose primary half must carry the serving microbench
+#: (``serve_rows_per_sec``, introduced with the bucketed serving data plane)
+DEFAULT_REQUIRE_SERVING_FROM = 8
 
 _REQUIRED_HALF_KEYS = ("metric", "value", "unit", "vs_baseline")
 _ROOFLINE_KEYS = ("mem_bw_gbps", "ici_bw_gbps")
 _FEED_KEY = "feed_rows_per_sec"
+_SERVE_KEY = "serve_rows_per_sec"
+#: the serving microbench's config identity: runs are only regression-
+#: compared within the same ingest representation AND bucket geometry —
+#: rows/sec across different bucket sets (or arrow- vs row-shaped
+#: partitions) are different experiments
+_SERVE_IDENT_KEYS = ("serve_ingest", "serve_rows_total", "serve_batch_size",
+                     "serve_row_bytes", "serve_bucket_sizes")
 
 
 def discover(repo_dir: str) -> list[str]:
@@ -113,7 +128,8 @@ def halves(parsed: dict[str, Any]) -> list[tuple[str, dict[str, Any]]]:
 
 def validate_half(half: dict[str, Any], *,
                   require_roofline: bool,
-                  require_feed: bool = False) -> list[str]:
+                  require_feed: bool = False,
+                  require_serving: bool = False) -> list[str]:
     """Schema problems of one measured result (a wrapper's half)."""
     problems = []
     for key in _REQUIRED_HALF_KEYS:
@@ -152,6 +168,23 @@ def validate_half(half: dict[str, Any], *,
             problems.append(
                 f"{_FEED_KEY!r} without 'feed_transport' attribution "
                 "(shm|pickle) — transports are different experiments")
+    # serving microbench: host-side like the feed one — required even on
+    # accelerator-degraded runs; null + reason always satisfies
+    if require_serving or _SERVE_KEY in half:
+        if _SERVE_KEY not in half:
+            problems.append(
+                f"missing {_SERVE_KEY!r} (serving microbench is part of "
+                "the schema from r08: measure it or stamp an explicit "
+                "null + 'serve_reason')")
+        elif half[_SERVE_KEY] is None and "serve_reason" not in half:
+            problems.append(
+                f"{_SERVE_KEY!r} is null without a 'serve_reason'")
+        elif (isinstance(half.get(_SERVE_KEY), (int, float))
+              and "serve_ingest" not in half):
+            problems.append(
+                f"{_SERVE_KEY!r} without 'serve_ingest' attribution "
+                "(arrow|rows) — ingest representations are different "
+                "experiments")
     return problems
 
 
@@ -194,25 +227,47 @@ def _comparable_prior_feed(artifacts: list[dict], newest: dict,
     attribution) and never compared across."""
     ident_keys = ("feed_transport", "feed_rows_total", "feed_chunk_rows",
                   "feed_batch_size", "feed_row_bytes")
+    return _comparable_prior_hostside(artifacts, newest, half,
+                                      _FEED_KEY, ident_keys)
+
+
+def _comparable_prior_serving(artifacts: list[dict], newest: dict,
+                              half: dict) -> tuple[float, str] | None:
+    """Best prior ``serve_rows_per_sec`` under the same ingest
+    representation and bucket geometry (``_SERVE_IDENT_KEYS``).
+
+    Host-side like the feed microbench, so degraded-accelerator priors
+    still count — they measured the same serving data plane."""
+    return _comparable_prior_hostside(artifacts, newest, half,
+                                      _SERVE_KEY, _SERVE_IDENT_KEYS)
+
+
+def _comparable_prior_hostside(artifacts: list[dict], newest: dict,
+                               half: dict, key: str,
+                               ident_keys: tuple[str, ...]
+                               ) -> tuple[float, str] | None:
+    """Best prior value of a host-side microbench metric among runs whose
+    config identity (``ident_keys``) matches the newest half's."""
     best: tuple[float, str] | None = None
     for art in artifacts:
         if art["n"] >= newest["n"] or not art["parsed"]:
             continue
         for plabel, phalf in halves(art["parsed"]):
-            if (not isinstance(phalf.get(_FEED_KEY), (int, float))
+            if (not isinstance(phalf.get(key), (int, float))
                     or any(phalf.get(k) != half.get(k)
                            for k in ident_keys)):
                 continue
             src = f"{os.path.basename(art['path'])}:{plabel}"
-            if best is None or phalf[_FEED_KEY] > best[0]:
-                best = (float(phalf[_FEED_KEY]), src)
+            if best is None or phalf[key] > best[0]:
+                best = (float(phalf[key]), src)
     return best
 
 
 def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
          target_floor: float = DEFAULT_TARGET_FLOOR,
          require_roofline_from: int = DEFAULT_REQUIRE_ROOFLINE_FROM,
-         require_feed_from: int = DEFAULT_REQUIRE_FEED_FROM
+         require_feed_from: int = DEFAULT_REQUIRE_FEED_FROM,
+         require_serving_from: int = DEFAULT_REQUIRE_SERVING_FROM
          ) -> dict[str, Any]:
     """Run the gate over a trajectory; returns the verdict document."""
     checks: list[dict[str, Any]] = []
@@ -246,11 +301,15 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
             continue
         for label, half in halves(art["parsed"]):
             require_rf = art["n"] >= require_roofline_from
-            # the feed microbench is stamped once per run, on the primary
+            # the feed/serving microbenches are stamped once per run, on
+            # the primary
             require_fd = (label == "primary"
                           and art["n"] >= require_feed_from)
+            require_sv = (label == "primary"
+                          and art["n"] >= require_serving_from)
             for problem in validate_half(half, require_roofline=require_rf,
-                                         require_feed=require_fd):
+                                         require_feed=require_fd,
+                                         require_serving=require_sv):
                 check(f"schema:{name}:{label}",
                       "fail" if is_newest else "warn", problem)
 
@@ -279,6 +338,26 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                           f"{fval} is {round(fval / fprior[0], 4)}× best "
                           f"prior {fprior[0]} ({fprior[1]}) — the data "
                           f"plane regressed below {threshold}")
+            # serving microbench: same host-side reasoning as the feed one
+            if isinstance(half.get(_SERVE_KEY), (int, float)):
+                sprior = _comparable_prior_serving(artifacts, newest, half)
+                sname = f"regression:{_SERVE_KEY}"
+                sval = float(half[_SERVE_KEY])
+                if sprior is None:
+                    check(sname, "pass",
+                          "no comparable prior serving measurement (same "
+                          "ingest + bucket geometry) — nothing to regress "
+                          "against")
+                elif sval >= threshold * sprior[0]:
+                    check(sname, "pass",
+                          f"{sval} vs best prior {sprior[0]} "
+                          f"({sprior[1]}): ratio "
+                          f"{round(sval / sprior[0], 4)} ≥ {threshold}")
+                else:
+                    check(sname, "fail",
+                          f"{sval} is {round(sval / sprior[0], 4)}× best "
+                          f"prior {sprior[0]} ({sprior[1]}) — the serving "
+                          f"data plane regressed below {threshold}")
             if "degraded" in half:
                 check(f"degraded:{cname}", "skip",
                       f"newest run degraded ({half['degraded'][:120]}); "
@@ -350,6 +429,8 @@ def main(argv: list[str] | None = None) -> int:
                    default=DEFAULT_REQUIRE_ROOFLINE_FROM)
     p.add_argument("--require-feed-from", type=int,
                    default=DEFAULT_REQUIRE_FEED_FROM)
+    p.add_argument("--require-serving-from", type=int,
+                   default=DEFAULT_REQUIRE_SERVING_FROM)
     args = p.parse_args(argv)
     paths = args.paths or discover(args.repo)
     if not paths:
@@ -359,7 +440,8 @@ def main(argv: list[str] | None = None) -> int:
     doc = gate(paths, threshold=args.threshold,
                target_floor=args.target_floor,
                require_roofline_from=args.require_roofline_from,
-               require_feed_from=args.require_feed_from)
+               require_feed_from=args.require_feed_from,
+               require_serving_from=args.require_serving_from)
     print(json.dumps(doc))
     return 1 if doc["verdict"] == "fail" else 0
 
