@@ -27,6 +27,7 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import subprocess
@@ -135,6 +136,22 @@ _WALL_BUDGET_S = int(os.environ.get("TFOS_BENCH_WALL_BUDGET_S", "660"))
 _FALLBACK_RESERVE_S = int(os.environ.get("TFOS_BENCH_FALLBACK_RESERVE_S",
                                          "120"))
 _MIN_CHILD_S = 20  # below this, don't bother spawning a child
+
+
+@contextlib.contextmanager
+def _flight_disabled():
+    """Run with the flight recorder off (``TFOS_FLIGHT=0``, previous value
+    restored) — the off half of the recorder-overhead A/B both
+    microbenches stamp."""
+    prev = os.environ.get("TFOS_FLIGHT")
+    os.environ["TFOS_FLIGHT"] = "0"
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop("TFOS_FLIGHT", None)
+        else:
+            os.environ["TFOS_FLIGHT"] = prev
 
 
 class _Deadline:
@@ -592,6 +609,14 @@ def measure_feed_transport(rows_total: int = 4096, chunk_rows: int = 256,
     between CIFAR and ImageNet rows): the wall scales with row bytes, and
     tiny rows are queue-latency-bound on both transports — see
     BENCH_NOTES.md "Feed transport microbench" for the measured size sweep.
+
+    From r09 every measurement also carries its flight-recorder stage
+    breakdown (``feed_stage_breakdown``: consumer ``wait``/``ingest``
+    seconds summing to the measured wall within the gate tolerance, plus
+    the bottleneck verdict and the feeder thread's concurrent
+    ``encode``/``backpressure`` split) and the recorder's measured
+    overhead (``feed_flight_overhead_frac``: one extra shm pass with
+    ``TFOS_FLIGHT=0``).
     """
     import threading
 
@@ -599,12 +624,17 @@ def measure_feed_transport(rows_total: int = 4096, chunk_rows: int = 256,
 
     from tensorflowonspark_tpu import TFManager, marker, shm
     from tensorflowonspark_tpu.TFNode import DataFeed
+    from tensorflowonspark_tpu.obs import flight
 
     rng = np.random.default_rng(0)
     feats = rng.standard_normal((rows_total, feature_dim)).astype(np.float32)
     rows = [(feats[i], i) for i in range(rows_total)]
+    rec = flight.recorder("feed")
+    feeder_rec = flight.recorder("feeder")
 
-    def run(transport: str) -> float:
+    def run(transport: str) -> tuple[float, dict]:
+        rec.reset()
+        feeder_rec.reset()
         m = TFManager.start(b"feed-transport-bench",
                             ["input", "output", "error"], mode="local")
         try:
@@ -620,14 +650,19 @@ def measure_feed_transport(rows_total: int = 4096, chunk_rows: int = 256,
                 # failure mode the harness exists to prevent.
                 try:
                     for i in range(0, rows_total, chunk_rows):
+                        te = time.perf_counter()
                         payload = shm.encode_chunk(rows[i:i + chunk_rows],
                                                    transport=transport)
                         if (transport == "shm"
                                 and not isinstance(payload,
                                                    shm.ShmChunkRef)):
                             fallbacks[0] += 1  # write_chunk fell back
-
+                        tp = time.perf_counter()
                         q.put(payload)
+                        feeder_rec.add(
+                            encode=tp - te,
+                            backpressure=time.perf_counter() - tp)
+                        feeder_rec.commit()
                 except BaseException as e:  # noqa: BLE001 - re-raised below
                     feeder_err[0] = e
                 finally:
@@ -645,6 +680,7 @@ def measure_feed_transport(rows_total: int = 4096, chunk_rows: int = 256,
                 batch = feed.next_batch(batch_size)
                 if batch:
                     n += int(batch["y"].shape[0])
+                rec.commit()  # one flight record per consumed batch
             dt = time.perf_counter() - t0
             th.join(timeout=30)
             if feeder_err[0] is not None:
@@ -662,7 +698,14 @@ def measure_feed_transport(rows_total: int = 4096, chunk_rows: int = 256,
                     f"shm transport fell back to pickled columnar on "
                     f"{fallbacks[0]} chunk(s) (/dev/shm full or "
                     "unwritable?) — refusing to mislabel the measurement")
-            return rows_total / dt
+            breakdown = rec.breakdown(dt)
+            # the feeder thread runs concurrent with the consumer wall:
+            # its split is evidence (encode vs queue back-pressure), not
+            # part of the additive stage sum
+            breakdown["feeder_stages_s"] = {
+                k: round(v, 4)
+                for k, v in sorted(feeder_rec.totals().items())}
+            return rows_total / dt, breakdown
         finally:
             m.shutdown()
 
@@ -672,18 +715,40 @@ def measure_feed_transport(rows_total: int = 4096, chunk_rows: int = 256,
         "feed_batch_size": batch_size,
         "feed_row_bytes": int(feats[0].nbytes + 8),
     }
-    pickle_rps = run("rows")
+    recording = flight.enabled()
+    pickle_rps, pickle_bd = run("rows")
     out["feed_rows_per_sec_pickle"] = round(pickle_rps, 1)
     if shm.shm_available():
-        shm_rps = run("shm")
+        shm_rps, shm_bd = run("shm")
         out["feed_rows_per_sec"] = round(shm_rps, 1)
         out["feed_transport"] = "shm"
         out["feed_transport_speedup"] = round(shm_rps / pickle_rps, 2)
+        out["feed_stage_breakdown"] = shm_bd if recording else None
+        if recording:
+            # recorder cost, measured the only honest way: the same pass
+            # with TFOS_FLIGHT=0.  Order-alternated pairs (off, off, then
+            # a second on) so cache/allocator warmth from a preceding
+            # pass hits both sides — a single fixed-order off-run after
+            # the recorded one would read its warm-state advantage as
+            # recorder cost
+            with _flight_disabled():
+                off_rps, _ = run("shm")
+                off2_rps, _ = run("shm")
+            on2_rps, _ = run("shm")
+            out["feed_flight_overhead_frac"] = round(
+                1.0 - max(shm_rps, on2_rps) / max(off_rps, off2_rps), 4)
     else:
         out["feed_rows_per_sec"] = round(pickle_rps, 1)
         out["feed_transport"] = "pickle"
         out["feed_transport_reason"] = ("shared memory unavailable on this "
                                         "host; pickled columnar fallback")
+        out["feed_stage_breakdown"] = pickle_bd if recording else None
+    if not recording:
+        # the opted-out run cannot decompose its wall: explicit null +
+        # reason keeps the r09 schema total without failing the gate's
+        # reconciliation on an all-zero sum
+        out["feed_stage_breakdown_reason"] = (
+            "flight recorder disabled (TFOS_FLIGHT=0)")
     return out
 
 
@@ -822,14 +887,43 @@ def measure_serving(rows_total: int = 16384, feature_dim: int = 256,
             return dt
 
         # interleave the reps so ambient load on this shared container
-        # hits both planes symmetrically; best-of-reps per plane
-        legacy_dts, serve_dts = [], []
-        for _ in range(reps):
+        # hits both planes symmetrically; best-of-reps per plane.  The
+        # flight recorder is reset here so its breakdown covers exactly
+        # the timed bucketed reps (warm/equality passes excluded): the
+        # additive consumer stages (wait/compute/emit) must sum to the
+        # reps' combined wall within the gate tolerance
+        from tensorflowonspark_tpu.obs import flight
+
+        rec = flight.recorder("serve")
+        rec.reset()
+        recording = flight.enabled()
+
+        def timed_unrecorded() -> float:
+            with _flight_disabled():
+                return timed_once(bucketed, arrow_parts)
+
+        legacy_dts, serve_dts, off_dts = [], [], []
+        for i in range(reps):
             legacy_dts.append(timed_once(legacy, row_parts))
-            serve_dts.append(timed_once(bucketed, arrow_parts))
+            # recorder-overhead reps: the same bucketed pass with
+            # TFOS_FLIGHT=0, interleaved (ambient drift hits on and off
+            # symmetrically — an off-block AFTER all on-reps reads
+            # container noise as recorder cost) AND order-alternated
+            # (the second of two back-to-back bucketed passes runs
+            # cache-warm; a fixed order would bias the comparison).
+            # Skipped when the recorder is already opted out — nothing
+            # to compare against.
+            if not recording:
+                serve_dts.append(timed_once(bucketed, arrow_parts))
+            elif i % 2 == 0:
+                serve_dts.append(timed_once(bucketed, arrow_parts))
+                off_dts.append(timed_unrecorded())
+            else:
+                off_dts.append(timed_unrecorded())
+                serve_dts.append(timed_once(bucketed, arrow_parts))
         legacy_rps = rows_total / min(legacy_dts)
         serve_rps = rows_total / min(serve_dts)
-        return {
+        out = {
             "serve_rows_per_sec": round(serve_rps, 1),
             "serve_rows_per_sec_legacy": round(legacy_rps, 1),
             "serve_speedup": round(serve_rps / legacy_rps, 2),
@@ -843,6 +937,18 @@ def measure_serving(rows_total: int = 16384, feature_dim: int = 256,
             "serve_partition_tails": [(b - a) % batch_size
                                       for a, b in bounds],
         }
+        if recording:
+            out["serve_stage_breakdown"] = rec.breakdown(sum(serve_dts))
+            off_rps = rows_total / min(off_dts)
+            out["serve_flight_overhead_frac"] = round(
+                1.0 - serve_rps / off_rps, 4)
+        else:
+            # opted-out runs cannot decompose their wall: explicit null +
+            # reason keeps the r09 schema total (gate-exempt)
+            out["serve_stage_breakdown"] = None
+            out["serve_stage_breakdown_reason"] = (
+                "flight recorder disabled (TFOS_FLIGHT=0)")
+        return out
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
 

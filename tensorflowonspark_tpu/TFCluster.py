@@ -465,6 +465,13 @@ class TFCluster:
                                        ("stalled", report["stalled"]),
                                        ("died", report["died"]))
                 for f in findings)
+        # persistent feed starvation (flight recorder): a node spending
+        # most of its classified step wall blocked on the Spark feed is an
+        # anomaly with the evidence (verdict ratio + wait/compute p50s)
+        # attached — the trainer is healthy, the feed is the bottleneck
+        from tensorflowonspark_tpu.obs import flight as flight_lib
+
+        report["feed_starved"] = flight_lib.detect_feed_starvation(agg)
         report["stall_events"] = []
         if scan_traces:
             try:
@@ -503,6 +510,18 @@ class TFCluster:
                     self._drain_node_errors()
                 except Exception:
                     pass
+        for s in report["feed_starved"]:
+            key = ("feed_starved", s["node"])
+            if key not in self._reported_anomalies:
+                self._reported_anomalies.add(key)
+                logger.warning(
+                    "feed-starved: node %s spent %.0f%% of %d classified "
+                    "steps blocked on the Spark feed (wait p50 %ss vs "
+                    "compute p50 %ss) — scale/unthrottle the feeders, not "
+                    "the trainer", s["node"], s["ratio"] * 100,
+                    s["batches"], s.get("wait_p50_s"),
+                    s.get("compute_p50_s"))
+                obs.event("anomaly.feed_starved", **s)
         for s in report["stall_events"]:
             key = ("stall_event", s["node"], s.get("ts"))
             if key not in self._reported_anomalies:
@@ -574,6 +593,63 @@ class TFCluster:
         return {"status": "ok" if healthy else "degraded", "nodes": nodes,
                 "num_nodes": len(nodes)}
 
+    def pipeline_report(self) -> dict:
+        """Live pipeline flight-recorder view: where each node's batch
+        time goes, and what the bottleneck verdict is.
+
+        Renders the flight stage histograms/verdict counters that ride
+        every node's metrics publication
+        (:func:`tensorflowonspark_tpu.obs.flight.report_from_metrics`)
+        plus each manager's watch-thread runtime stats (queue occupancy /
+        ``/dev/shm`` residency, kv key ``pipeline_stats``) and this
+        process's own recorders (driver-side serving/bench activity).
+        Served as ``GET /pipeline`` by :meth:`serve_observability`.
+        """
+        import threading
+        import time as _time
+
+        from tensorflowonspark_tpu import TFManager
+        from tensorflowonspark_tpu.obs import flight as flight_lib
+
+        agg = self.metrics()
+        report = flight_lib.report_from_metrics(agg)
+        report["feed_starved"] = flight_lib.detect_feed_starvation(agg)
+        # per-node kv reads in bounded daemon threads (same pattern as
+        # health()): a black-holed host must not hang every /pipeline
+        # scrape for the kernel TCP connect timeout — connection-refused
+        # fails fast, dropped SYNs do not
+        results: dict[str, Any] = {}
+        authkey = bytes.fromhex(self.cluster_meta["authkey_hex"])
+
+        def read_stats(name, meta) -> None:
+            try:
+                stats = TFManager.connect(tuple(meta["addr"]),
+                                          authkey).get("pipeline_stats")
+            except Exception as e:
+                logger.debug("pipeline stats: node %s unreachable: %s",
+                             name, e)
+                return
+            if stats:
+                results[name] = stats
+
+        threads = {}
+        for meta in self.cluster_info:
+            name = f"{meta['job_name']}:{meta['task_index']}"
+            t = threading.Thread(target=read_stats, args=(name, meta),
+                                 name=f"tfos-pipeline-{name}", daemon=True)
+            t.start()
+            threads[name] = t
+        deadline = _time.monotonic() + 5.0
+        for t in threads.values():
+            t.join(timeout=max(0.0, deadline - _time.monotonic()))
+        # snapshot per known key, never iterating the live dict: a
+        # straggler thread completing AFTER the join deadline must not
+        # mutate what the /pipeline handler is serializing
+        report["node_runtime"] = {
+            name: results[name] for name in threads if name in results}
+        report["driver"] = flight_lib.local_report()
+        return report
+
     def serve_observability(self, port: int = 0, host: str = "127.0.0.1"):
         """Start the live driver HTTP endpoint; returns the server.
 
@@ -581,9 +657,12 @@ class TFCluster:
         ``/metrics`` → Prometheus text of :meth:`metrics_prometheus`,
         ``/healthz`` → JSON from :meth:`health` (HTTP 503 when degraded),
         ``/trace`` → the merged Chrome-trace document (the
-        :meth:`dump_trace` content, served live).  The returned server
-        exposes ``.port`` / ``.url(path)`` / ``.stop()``; it is stopped
-        automatically by :meth:`shutdown`.
+        :meth:`dump_trace` content, served live),
+        ``/pipeline`` → JSON from :meth:`pipeline_report` (per-node stage
+        time attribution + bottleneck verdicts + live queue/shm
+        residency).  The returned server exposes ``.port`` /
+        ``.url(path)`` / ``.stop()``; it is stopped automatically by
+        :meth:`shutdown`.
         """
         import json as _json
 
@@ -602,6 +681,10 @@ class TFCluster:
             doc = obs.chrome.merge(self._trace_events_by_node())
             return (200, "application/json", _json.dumps(doc))
 
+        def _pipeline():
+            return (200, "application/json",
+                    _json.dumps(self.pipeline_report()))
+
         if self._obs_server is not None:
             # re-serving (e.g. to move ports) must not leak the previous
             # listener thread + socket until process exit
@@ -611,11 +694,12 @@ class TFCluster:
                 pass
             self._obs_server = None
         server = httpd.ObservabilityServer(
-            {"/metrics": _metrics, "/healthz": _healthz, "/trace": _trace},
+            {"/metrics": _metrics, "/healthz": _healthz, "/trace": _trace,
+             "/pipeline": _pipeline},
             host=host, port=port)
         addr = server.start()
         logger.info("observability endpoint serving on http://%s:%s "
-                    "(/metrics /healthz /trace)", *addr)
+                    "(/metrics /healthz /trace /pipeline)", *addr)
         self._obs_server = server
         return server
 

@@ -63,6 +63,43 @@ def _payload_nbytes(item: Any) -> int:
         return 0
 
 
+def _note_queue_delta(chunks: int, nbytes: int) -> None:
+    """Continuous queue-residency telemetry: ``feed_queue_chunks`` /
+    ``feed_queue_bytes`` gauges track what is sitting in this process's
+    byte-bounded queues RIGHT NOW (summed across queues; incremented at
+    ``put``, decremented at ``get``).
+
+    Residency accounting only — a consumer holding a dequeued shm
+    descriptor between ``get`` and ``read_chunk`` has already left these
+    gauges (the documented ``_ByteBoundedQueue`` headroom caveat); the
+    ``shm_bytes_resident`` gauge from the /dev/shm scan is the one that
+    still sees those bytes.  Best-effort: telemetry must never break the
+    data plane."""
+    try:
+        global _QUEUE_GAUGES
+        if _QUEUE_GAUGES is None:
+            from tensorflowonspark_tpu import obs
+
+            # handles cached: the data plane must not pay a registry
+            # lookup per queue operation (same rule as the flight
+            # recorder's instrument cache)
+            _QUEUE_GAUGES = (
+                obs.gauge("feed_queue_chunks",
+                          "chunks currently queued in this process's "
+                          "feed queues"),
+                obs.gauge("feed_queue_bytes",
+                          "payload bytes currently queued in this "
+                          "process's feed queues (descriptor-side "
+                          "accounting)"))
+        _QUEUE_GAUGES[0].inc(chunks)
+        _QUEUE_GAUGES[1].inc(nbytes)
+    except Exception:
+        pass
+
+
+_QUEUE_GAUGES: "tuple | None" = None
+
+
 class _ByteBoundedQueue(_queue_mod.Queue):
     """``queue.Queue`` with an additional in-flight payload-byte bound.
 
@@ -85,6 +122,11 @@ class _ByteBoundedQueue(_queue_mod.Queue):
         self.max_bytes = int(max_bytes)
         self._queued_bytes = 0
         self._nbytes_fifo: collections.deque = collections.deque()
+        # set (under mutex) by _del_queue when it releases this queue's
+        # remaining gauge residency: an op completing AFTER the release
+        # must not touch the gauges again (double-decrement would drive
+        # the process-wide residency negative forever)
+        self._gauges_released = False
 
     def _over(self, nb: int) -> bool:
         if 0 < self.maxsize <= self._qsize():
@@ -115,6 +157,13 @@ class _ByteBoundedQueue(_queue_mod.Queue):
             self._queued_bytes += nb
             self.unfinished_tasks += 1
             self.not_empty.notify()
+            # gauge delta INSIDE the mutex: the _gauges_released check and
+            # the update must be atomic against _del_queue's flag+snapshot,
+            # or an op completing between them double-counts (registry
+            # locks nest safely under the queue mutex — nothing acquires
+            # them in the other order)
+            if not self._gauges_released:
+                _note_queue_delta(1, nb)
 
     def get(self, block=True, timeout=None):
         with self.not_empty:
@@ -134,10 +183,12 @@ class _ByteBoundedQueue(_queue_mod.Queue):
                         raise _queue_mod.Empty
                     self.not_empty.wait(remaining)
             item = self._get()
-            if self._nbytes_fifo:
-                self._queued_bytes -= self._nbytes_fifo.popleft()
+            nb = self._nbytes_fifo.popleft() if self._nbytes_fifo else 0
+            self._queued_bytes -= nb
             self.not_full.notify()
-            return item
+            if not self._gauges_released:  # atomic with put()'s rationale
+                _note_queue_delta(-1, -nb)
+        return item
 
     def inflight_bytes(self) -> int:
         with self.mutex:
@@ -282,6 +333,37 @@ def _start_orphan_watch(parent_pid: int | None) -> None:
         except Exception:
             pass  # the watch must never die to a sweep hiccup
 
+    def _publish_pipeline_stats() -> None:
+        # live queue-occupancy + /dev/shm residency, refreshed every watch
+        # cycle: the gauges land in THIS server process's registry, and the
+        # same numbers go onto the kv blackboard (``pipeline_stats``) where
+        # the driver's /pipeline endpoint reads them — the manager server
+        # has no MetricsReporter of its own to ship through
+        try:
+            from tensorflowonspark_tpu import shm
+
+            qstats: dict[str, dict[str, int]] = {}
+            for qname, q in list(_queues.items()):
+                try:
+                    with q.mutex:
+                        qstats[qname] = {
+                            "chunks": q._qsize(),
+                            "bytes": int(getattr(q, "_queued_bytes", 0)),
+                            "max_bytes": int(getattr(q, "max_bytes", 0)),
+                            "maxsize": int(q.maxsize),
+                        }
+                except Exception:
+                    continue
+            segs, seg_bytes = shm.update_gauges()
+            _kv["pipeline_stats"] = {
+                "queues": qstats,
+                "shm_segments_live": segs,
+                "shm_bytes_resident": seg_bytes,
+                "ts": _time_mod.time(),
+            }
+        except Exception:
+            pass  # telemetry must never kill the watch
+
     def watch() -> None:
         last_sweep = 0.0
         while True:
@@ -291,6 +373,7 @@ def _start_orphan_watch(parent_pid: int | None) -> None:
             if do_sweep:
                 last_sweep = now
             _sweep_shm(do_sweep)
+            _publish_pipeline_stats()
             if os.getppid() == parent_pid:
                 continue
             if _trainer_alive():
@@ -323,8 +406,26 @@ def _get_kv() -> dict[str, Any]:
 
 def _del_queue(qname: str) -> bool:
     """Drop a dynamically-created queue (per-task result queues would
-    otherwise accumulate in the server process forever)."""
-    return _queues.pop(qname, None) is not None
+    otherwise accumulate in the server process forever).  Items still
+    enqueued leave the residency gauges with the dropped queue — without
+    the release here a failed task's undrained queue would read as
+    phantom residency for the rest of the process."""
+    q = _queues.pop(qname, None)
+    if q is None:
+        return False
+    try:
+        # flag + snapshot under ONE mutex hold: an op that pops/pushes
+        # after this sees the flag and skips the gauges, an op that ran
+        # before is already reflected in the snapshot — no double count
+        # in either interleaving
+        with q.mutex:
+            q._gauges_released = True
+            n, nb = q._qsize(), int(getattr(q, "_queued_bytes", 0))
+        if n or nb:
+            _note_queue_delta(-n, -nb)
+    except Exception:
+        pass
+    return True
 
 
 class _Router:

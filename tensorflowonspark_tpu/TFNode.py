@@ -129,12 +129,20 @@ class DataFeed:
         Feed observability (one histogram + two counters per batch, all
         O(1)): ``datafeed_assemble_seconds`` is the time the trainer spent
         *waiting on Spark* for this batch — the number that tells you
-        whether the feed or the compute is the bottleneck."""
+        whether the feed or the compute is the bottleneck.  The flight
+        recorder splits that further: queue-blocked time is the ``wait``
+        stage (starvation evidence), everything else in here is ``ingest``
+        (shm read + piece assembly); on the prefetch pump thread both are
+        recorded as overlapped — the consumer's own ``wait`` on the staged
+        queue is the critical-path number there."""
         from tensorflowonspark_tpu import obs
 
         t0 = _time_mod.perf_counter()
+        wait_s = 0.0
         while self._buffered_rows < batch_size and not self._stop_seen:
+            tw = _time_mod.perf_counter()
             item = self._queue_in.get()
+            wait_s += _time_mod.perf_counter() - tw
             if isinstance(item, marker.StopFeed):
                 self._stop_seen = True
             elif isinstance(item, shm.ShmChunkRef):
@@ -165,8 +173,11 @@ class DataFeed:
         pieces = self._take_pieces(batch_size)
         taken = sum(self._piece_len(p) for p in pieces)
         runs = self._take_tags(taken)
-        obs.histogram("datafeed_assemble_seconds").observe(
-            _time_mod.perf_counter() - t0)
+        dt = _time_mod.perf_counter() - t0
+        obs.histogram("datafeed_assemble_seconds").observe(dt)
+        obs.flight.recorder("feed").add(
+            overlapped=self.prefetch > 0,
+            wait=wait_s, ingest=max(0.0, dt - wait_s))
         obs.counter("datafeed_batches_total").inc()
         if taken:
             obs.counter("datafeed_rows_total").inc(taken)
@@ -239,7 +250,14 @@ class DataFeed:
                     "configuration.")
         if self._pf_thread is None:
             self._start_prefetch(batch_size, device_put)
+        from tensorflowonspark_tpu import obs
+
+        tw = _time_mod.perf_counter()
         item = self._pf_out.get()
+        # consumer-side starvation: the pump's own wait/ingest overlap and
+        # are recorded as such; blocking HERE is the critical-path wait
+        obs.flight.recorder("feed").add(
+            wait=_time_mod.perf_counter() - tw)
         if isinstance(item, BaseException):
             raise item
         batch, runs, stopped = item
@@ -405,9 +423,17 @@ class DataFeed:
         — one memcpy per column); a batch covered by a single columnar
         piece is handed out as-is: zero-copy views over the (already
         unlinked) shm segment, from which ``device_put`` transfers
-        directly."""
+        directly.  Flight attribution: the column assembly is ``collate``
+        (distinct from ``_assemble``'s ``ingest`` so each stage histogram
+        keeps one observation per batch), an in-feed ``device_put`` is
+        ``stage`` (all overlapped when the prefetch pump runs this)."""
         if not pieces:
             return {} if self.input_mapping else []
+        from tensorflowonspark_tpu import obs
+
+        rec = obs.flight.recorder("feed")
+        bg = self.prefetch > 0
+        t0 = _time_mod.perf_counter()
         col_sets = [piece.cols if isinstance(piece, marker.ColumnarChunk)
                     else self._rows_to_cols(piece) for piece in pieces]
         ncols = len(col_sets[0])
@@ -425,15 +451,20 @@ class DataFeed:
                 f"input_mapping has {len(self.input_mapping)} names but rows "
                 f"have {len(cols)} columns"
             )
+        t1 = _time_mod.perf_counter()
+        rec.add(overlapped=bg, collate=t1 - t0)
         if callable(device_put):
-            return device_put(
+            out = device_put(
                 dict(zip(self.input_mapping, cols)) if self.input_mapping
                 else cols
             )
+            rec.add(overlapped=bg, stage=_time_mod.perf_counter() - t1)
+            return out
         if device_put:
             import jax
 
             cols = [jax.device_put(c) for c in cols]
+            rec.add(overlapped=bg, stage=_time_mod.perf_counter() - t1)
         if self.input_mapping:
             return dict(zip(self.input_mapping, cols))
         return cols

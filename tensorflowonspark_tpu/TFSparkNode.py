@@ -499,14 +499,29 @@ class _TrainFn:
         chunk_size = self.meta.get("feed_chunk", 256)
         deadline = time.monotonic() + self.feed_timeout
         chunk: list[Any] = []
+        # feeder-plane flight attribution: `encode` (columnarize + shm
+        # write) vs `backpressure` (blocked in the queue put — the wire +
+        # byte-bound back-pressure).  A feeder whose verdicts are
+        # queue_backpressured is outrunning the trainer, not slow itself.
+        rec = obs.flight.recorder("feeder")
+
+        def send_chunk(rows: list[Any]) -> None:
+            t0 = time.perf_counter()
+            payload = shm.encode_chunk(rows)
+            t1 = time.perf_counter()
+            self._put(q, payload, deadline)
+            rec.add(encode=t1 - t0,
+                    backpressure=time.perf_counter() - t1)
+            rec.commit()
+
         try:
             for row in iterator:
                 chunk.append(row)
                 if len(chunk) >= chunk_size:
-                    self._put(q, shm.encode_chunk(chunk), deadline)
+                    send_chunk(chunk)
                     chunk = []
             if chunk:
-                self._put(q, shm.encode_chunk(chunk), deadline)
+                send_chunk(chunk)
             self._put(q, marker.EndPartition(), deadline)
         except _queue_mod.Full:
             raise RuntimeError(

@@ -25,7 +25,19 @@ Plus the measurement-integrity layer on top (ISSUE 3 tentpole):
   stall detection over the shipped per-node step-time histograms
   (``TFCluster.check_anomalies()``);
 - **live endpoint** (:mod:`.httpd`) — ``TFCluster.serve_observability``'s
-  stdlib HTTP server (``/metrics`` Prometheus, ``/healthz``, ``/trace``).
+  stdlib HTTP server (``/metrics`` Prometheus, ``/healthz``, ``/trace``,
+  ``/pipeline``).
+
+And the pipeline flight recorder (ISSUE 6 tentpole):
+
+- **flight recorder** (:mod:`.flight`) — always-on per-stage time
+  attribution across the training feed and serving data planes, with a
+  per-batch bottleneck verdict (feed-starved / device-bound / emit-bound /
+  queue-backpressured); rendered live on ``/pipeline``, judged by
+  ``TFCluster.check_anomalies()`` (persistent feed starvation is a
+  finding), and stamped by ``bench.py`` into every artifact as a
+  wall-time-reconciled stage breakdown.  ``TFOS_FLIGHT=0`` disables,
+  ``TFOS_FLIGHT_SAMPLE=N`` thins the histogram traffic.
 
 Instrumented out of the box: cluster lifecycle (``TFCluster`` /
 ``TFSparkNode`` bootstrap, reserve, probe, shutdown), the trainer
@@ -40,6 +52,7 @@ timeout).  ``TFOS_TRACE=0`` disables recording.
 from tensorflowonspark_tpu.obs import (  # noqa: F401
     anomaly,
     chrome,
+    flight,
     httpd,
     roofline,
 )
@@ -68,7 +81,7 @@ from tensorflowonspark_tpu.obs.trace import (  # noqa: F401
 )
 
 __all__ = [
-    "anomaly", "chrome", "httpd", "roofline",
+    "anomaly", "chrome", "flight", "httpd", "roofline",
     "Counter", "Gauge", "Histogram", "Registry",
     "counter", "gauge", "histogram", "get_registry",
     "merge_snapshots", "merged_to_prometheus", "snapshot_to_prometheus",

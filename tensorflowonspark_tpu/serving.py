@@ -188,21 +188,77 @@ def note_compile(key: Any, batch: Mapping[str, Any]) -> bool:
     return True
 
 
+#: padded-row fraction above which the bucket ladder is called bad
+#: (``TFOS_SERVING_PAD_WASTE_WARN`` overrides); judged only after
+#: ``_PAD_WARN_MIN_ROWS`` forwarded rows so a ragged first batch can't
+#: cry wolf
+DEFAULT_PAD_WASTE_WARN = 0.5
+_PAD_WARN_MIN_ROWS = 256
+_PAD_WASTE_WARNED = False
+#: cached (rows_counter, padded_counter, waste_gauge) — note_rows runs on
+#: the serving pump per batch and must not pay registry lookups there
+#: (same rule as the flight recorder's instrument cache)
+_ROW_INSTRUMENTS = None
+
+
+def _row_instruments():
+    global _ROW_INSTRUMENTS
+    if _ROW_INSTRUMENTS is None:
+        from tensorflowonspark_tpu import obs
+
+        _ROW_INSTRUMENTS = (
+            obs.counter("serving_rows_total",
+                        "rows scored through the serving data plane"),
+            obs.counter("serving_padded_rows_total",
+                        "rows invented by bucket padding (masked out of "
+                        "the output)"),
+            obs.gauge("serving_padding_waste_ratio",
+                      "fraction of forwarded rows invented by bucket "
+                      "padding (padded / (real + padded))"))
+    return _ROW_INSTRUMENTS
+
+
 def note_rows(n_real: int, bucket: int) -> None:
     """Count scored rows and the padding overhead of their bucket.
 
     ``serving_padded_rows_total / serving_rows_total`` is the padding-waste
     ratio of the configured bucket geometry — the number to look at before
-    adding smaller buckets (each one costs a compile)."""
-    from tensorflowonspark_tpu import obs
+    adding smaller buckets (each one costs a compile).  The derived
+    ``serving_padding_waste_ratio`` gauge (padded / forwarded rows — the
+    fraction of forward compute spent on invented rows) is refreshed on
+    every batch, and the first time it exceeds the warn threshold over a
+    meaningful volume a structured ``serving.padding_waste`` event + log
+    WARNING names the bad bucket ladder."""
+    global _PAD_WASTE_WARNED
 
-    obs.counter("serving_rows_total",
-                "rows scored through the serving data plane").inc(n_real)
+    rows, padded, waste = _row_instruments()
+    rows.inc(n_real)
     if bucket > n_real:
-        obs.counter(
-            "serving_padded_rows_total",
-            "rows invented by bucket padding (masked out of the output)"
-        ).inc(bucket - n_real)
+        padded.inc(bucket - n_real)
+    forwarded = rows.value + padded.value
+    ratio = padded.value / forwarded if forwarded else 0.0
+    waste.set(ratio)
+    if _PAD_WASTE_WARNED or forwarded < _PAD_WARN_MIN_ROWS:
+        return
+    try:
+        threshold = float(os.environ.get("TFOS_SERVING_PAD_WASTE_WARN",
+                                         DEFAULT_PAD_WASTE_WARN))
+    except ValueError:
+        threshold = DEFAULT_PAD_WASTE_WARN
+    if ratio > threshold:
+        from tensorflowonspark_tpu import obs
+
+        _PAD_WASTE_WARNED = True
+        logger.warning(
+            "serving padding waste %.0f%% exceeds %.0f%% (%d padded vs "
+            "%d real rows): the bucket ladder is a bad fit for this "
+            "batch-size distribution — add a smaller bucket (each costs "
+            "one compile) or lower batch_size",
+            ratio * 100, threshold * 100, int(padded.value),
+            int(rows.value))
+        obs.event("serving.padding_waste", ratio=round(ratio, 4),
+                  threshold=threshold, rows=int(rows.value),
+                  padded=int(padded.value))
 
 
 def forget(key: Any = None) -> None:

@@ -360,6 +360,51 @@ def encode_chunk(rows: list[Any], tag: str | None = None,
     return marker.ColumnarChunk(cols, tag=tag)
 
 
+def resident_stats() -> tuple[int, int]:
+    """``(live_segments, resident_bytes)`` of this host's feed segments.
+
+    One ``/dev/shm`` directory scan over ``tfos_feed_*`` names — the
+    ground truth a leak is measured against, independent of any queue's
+    own accounting.  Segments raced away mid-scan are skipped."""
+    if not os.path.isdir(_SHM_DIR):
+        return 0, 0
+    count = nbytes = 0
+    try:
+        names = os.listdir(_SHM_DIR)
+    except OSError:
+        return 0, 0
+    for fn in names:
+        if not fn.startswith(SEG_PREFIX + "_"):
+            continue
+        try:
+            st = os.stat(os.path.join(_SHM_DIR, fn))
+        except OSError:
+            continue
+        count += 1
+        nbytes += st.st_size
+    return count, nbytes
+
+
+def update_gauges() -> tuple[int, int]:
+    """Refresh the ``shm_segments_live`` / ``shm_bytes_resident`` gauges
+    from :func:`resident_stats`; returns the stats.
+
+    Called from every TFManager server's watch thread (each executor host
+    polices and *reports* its own ``/dev/shm``) and by the leak checks in
+    ``tests/test_shm.py`` — a transport that leaks shows up as a nonzero
+    gauge on the very next watch cycle, not as a mystery OOM later."""
+    count, nbytes = resident_stats()
+    from tensorflowonspark_tpu import obs
+
+    obs.gauge("shm_segments_live",
+              "tfos_feed_* segments currently resident in /dev/shm").set(
+        count)
+    obs.gauge("shm_bytes_resident",
+              "bytes pinned by tfos_feed_* segments in /dev/shm").set(
+        nbytes)
+    return count, nbytes
+
+
 def keepalive(names: "Iterable[str]") -> None:
     """Refresh the mtime of in-flight segments (sweep keep-alive).
 

@@ -195,6 +195,11 @@ class Trainer:
                 "TFOS_PROFILE_STEPS", "") not in ("", "0", "false", "no")
         self._profile_steps = bool(profile_steps)
         self._steps_done = 0
+        # flight recorder: step() attributes its shard + dispatch
+        # (compute) per step and commits the feed-plane record the
+        # DataFeed's wait/ingest halves accumulated into — one bottleneck
+        # verdict per training step
+        self._flight = obs.flight.recorder("feed")
         obs.get_tracer().record(
             "trainer.init", "X", _t0_wall * 1e6,
             (time.perf_counter() - _t0) * 1e6,
@@ -220,8 +225,20 @@ class Trainer:
         """One sharded optimizer step; returns the (replicated) loss."""
         if self._watchdog is not None:
             return self._watchdogged_step(batch)
+        t0 = time.perf_counter()
+        staged = self.shard(batch)
+        t1 = time.perf_counter()
         with self._step_annotation():
-            self.state, loss = self.train_step(self.state, self.shard(batch))
+            self.state, loss = self.train_step(self.state, staged)
+        # `compute` is the dispatch wall: on async backends it understates
+        # true device time until dispatch throttling backs up — which is
+        # exactly when a step becomes device-bound and the number grows.
+        # The shard is its own `shard` stage (not `stage`): a feed that
+        # already device_put the batch recorded the real transfer as
+        # `stage`, and this re-shard of device-resident arrays is ~free —
+        # sharing the name would bimodalize that histogram toward zero
+        self._flight.add(shard=t1 - t0,
+                         compute=time.perf_counter() - t1)
         return self._after_step(loss, batch)
 
     def _step_annotation(self):
@@ -262,6 +279,9 @@ class Trainer:
         # (obs.anomaly): a node whose gauge falls behind the freshest
         # peer is wedged — visible from the rollup without any new RPC
         obs.gauge("trainer_last_step_unix_ts").set(time.time())
+        # close the feed-plane flight record (DataFeed wait/ingest + this
+        # step's stage/compute) into one classified bottleneck verdict
+        self._flight.commit()
         for cb in self._step_callbacks:
             cb(loss, n, dt)
         return loss
@@ -306,10 +326,17 @@ class Trainer:
             if os.environ.get("TFOS_STEP_WATCHDOG_TEST_HANG"):
                 time.sleep(3600)  # simulated mid-run wedge (tests)
         try:
+            t0 = time.perf_counter()
+            staged = self.shard(batch)
+            t1 = time.perf_counter()
             with self._step_annotation():
-                self.state, loss = self.train_step(
-                    self.state, self.shard(batch))
+                self.state, loss = self.train_step(self.state, staged)
                 loss = jax.block_until_ready(loss)
+            # the watchdogged step forces the loss, so `compute` here is
+            # true device wall, not just dispatch (`shard`, not `stage`:
+            # see step())
+            self._flight.add(shard=t1 - t0,
+                             compute=time.perf_counter() - t1)
         finally:
             # disarm on ANY exit: an exception a caller handles must not
             # leave a stale armed timestamp that later reads as a stall

@@ -188,8 +188,16 @@ class TestOutageProofing(unittest.TestCase):
             feature_dim=16384)
         self.assertGreater(out["feed_rows_per_sec_pickle"], 0.0)
         self.assertGreater(out["feed_rows_per_sec"], 0.0)
+        # ISSUE 6: every feed measurement ships its stage decomposition
+        # (wait/ingest + feeder split + verdict) — reconciliation with
+        # wall time is asserted at the gate and in tests/test_flight.py
+        bd = out["feed_stage_breakdown"]
+        self.assertIn("verdict", bd)
+        self.assertGreater(bd["stage_sum_s"], 0.0)
+        self.assertGreater(bd["wall_s"], 0.0)
         if shm.shm_available():
             self.assertEqual(out["feed_transport"], "shm")
+            self.assertIn("feed_flight_overhead_frac", out)
             # sanity floor only: the real ≥3× acceptance lives in the
             # artifact gate at full geometry — at this small config on a
             # loaded 2-core CI box the ratio jitters, so the unit suite
@@ -234,6 +242,12 @@ class TestOutageProofing(unittest.TestCase):
         # CI box the ratio jitters, so the unit suite just catches the
         # bucketed plane going pathologically slower than the row loop
         self.assertGreater(out["serve_speedup"], 0.5)
+        # ISSUE 6: the serving number ships its stage decomposition too
+        bd = out["serve_stage_breakdown"]
+        self.assertIn("verdict", bd)
+        self.assertGreater(bd["stage_sum_s"], 0.0)
+        self.assertGreaterEqual(bd["batches"], 1)
+        self.assertIn("serve_flight_overhead_frac", out)
 
     def test_serving_stamp_is_total_on_exhausted_budget(self):
         sys.path.insert(0, os.path.dirname(BENCH))

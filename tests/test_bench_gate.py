@@ -364,8 +364,8 @@ def test_serving_not_compared_across_ingest_or_geometry(tmp_path):
     # ingest representation changed (arrow → rows fallback): different
     # experiment, no regression judgment in either direction
     paths = [
-        _write(tmp_path, "BENCH_r08.json", _r8(rps=300000.0)),
-        _write(tmp_path, "BENCH_r09.json", _r8(rps=60000.0, ingest="rows")),
+        _write(tmp_path, "BENCH_r07.json", _r8(rps=300000.0)),
+        _write(tmp_path, "BENCH_r08.json", _r8(rps=60000.0, ingest="rows")),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "pass", verdict["reasons"]
@@ -375,8 +375,8 @@ def test_serving_not_compared_across_ingest_or_geometry(tmp_path):
     # bucket geometry changed: also incomparable (padding waste and
     # compile count are properties of the bucket set)
     paths = [
-        _write(tmp_path, "BENCH_r08.json", _r8(rps=300000.0)),
-        _write(tmp_path, "BENCH_r09.json",
+        _write(tmp_path, "BENCH_r07.json", _r8(rps=300000.0)),
+        _write(tmp_path, "BENCH_r08.json",
                _r8(rps=60000.0, serve_bucket_sizes=[1024])),
     ]
     verdict = bench_gate.gate(paths)
@@ -390,8 +390,8 @@ def test_serving_regression_judged_even_on_degraded_newest(tmp_path):
         _half(600.0, platform="cpu", degraded="probe failed",
               **_feed_fields(), **_serve_fields(rps=60000.0)))
     paths = [
-        _write(tmp_path, "BENCH_r08.json", _r8(rps=300000.0)),
-        _write(tmp_path, "BENCH_r09.json", degraded_bad),
+        _write(tmp_path, "BENCH_r07.json", _r8(rps=300000.0)),
+        _write(tmp_path, "BENCH_r08.json", degraded_bad),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "fail"
@@ -459,3 +459,124 @@ def test_cli_exit_codes(tmp_path):
         [sys.executable, gate_py, "--repo", str(tmp_path / "empty")],
         capture_output=True, text=True)
     assert proc.returncode == 2
+
+
+# -- flight-recorder stage breakdowns (required from r09) --------------------
+
+
+def _flight_bd(frac=1.0, verdict="feed_starved", wall=10.0, **extra):
+    bd = {"wall_s": wall, "stage_sum_s": round(wall * frac, 4),
+          "stage_sum_frac": round(frac, 4),
+          "stages_s": {"wait": round(wall * frac * 0.8, 4),
+                       "ingest": round(wall * frac * 0.2, 4)},
+          "overlapped_stages_s": {}, "batches": 16,
+          "verdicts": {verdict: 16}, "verdict": verdict}
+    bd.update(extra)
+    return bd
+
+
+def _r9(**extra):
+    """A round-9-complete primary half: microbenches + stage breakdowns."""
+    half = _half(2400.0, **_feed_fields(), **_serve_fields())
+    half["feed_stage_breakdown"] = _flight_bd()
+    half["serve_stage_breakdown"] = _flight_bd(verdict="device_bound")
+    half.update(extra)
+    return half
+
+
+def test_flight_breakdowns_required_on_primary_from_round_9(tmp_path):
+    # round 8: grandfathered — no breakdown owed
+    verdict = bench_gate.gate(
+        [_write(tmp_path, "BENCH_r08.json",
+                _half(2400.0, **_feed_fields(), **_serve_fields()))])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # round 9+: both healthy microbench numbers owe their decomposition
+    verdict = bench_gate.gate(
+        [_write(tmp_path, "BENCH_r09.json",
+                _half(2400.0, **_feed_fields(), **_serve_fields()))])
+    assert verdict["verdict"] == "fail"
+    assert any("feed_stage_breakdown" in r for r in verdict["reasons"])
+    assert any("serve_stage_breakdown" in r for r in verdict["reasons"])
+    # complete round 9 passes
+    verdict = bench_gate.gate(
+        [_write(tmp_path, "BENCH_r09.json", _r9())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+
+
+def test_flight_breakdown_must_reconcile_with_wall_time(tmp_path):
+    """A breakdown whose stage sum disagrees with measured wall beyond
+    the tolerance fails the artifact — it is attribution, not decoration."""
+    undercounts = _r9(feed_stage_breakdown=_flight_bd(frac=0.6))
+    verdict = bench_gate.gate(
+        [_write(tmp_path, "BENCH_r09.json", undercounts)])
+    assert verdict["verdict"] == "fail"
+    assert any("does not reconcile" in r for r in verdict["reasons"])
+    overcounts = _r9(serve_stage_breakdown=_flight_bd(
+        frac=1.4, verdict="device_bound"))
+    verdict = bench_gate.gate(
+        [_write(tmp_path, "BENCH_r09.json", overcounts)])
+    assert verdict["verdict"] == "fail"
+    assert any("does not reconcile" in r for r in verdict["reasons"])
+    # within the ±15% tolerance: fine
+    ok = _r9(feed_stage_breakdown=_flight_bd(frac=0.9))
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r09.json", ok)])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+
+
+def test_flight_breakdown_requires_verdict_and_numbers(tmp_path):
+    no_verdict = _r9()
+    del no_verdict["feed_stage_breakdown"]["verdict"]
+    verdict = bench_gate.gate(
+        [_write(tmp_path, "BENCH_r09.json", no_verdict)])
+    assert verdict["verdict"] == "fail"
+    assert any("verdict" in r for r in verdict["reasons"])
+    no_wall = _r9()
+    del no_wall["serve_stage_breakdown"]["wall_s"]
+    verdict = bench_gate.gate(
+        [_write(tmp_path, "BENCH_r09.json", no_wall)])
+    assert verdict["verdict"] == "fail"
+    assert any("wall_s" in r for r in verdict["reasons"])
+
+
+def test_flight_breakdown_not_owed_for_null_metrics(tmp_path):
+    """A null microbench number (already explained by its reason field)
+    owes no decomposition — the schema stays total, not redundant."""
+    half = _half(2400.0,
+                 feed_rows_per_sec=None,
+                 feed_transport_reason="wall budget exhausted",
+                 serve_rows_per_sec=None,
+                 serve_reason="wall budget exhausted")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r09.json", half)])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+
+
+def test_flight_breakdown_judged_when_present_before_round_9(tmp_path):
+    """Same or-present semantics as the other schema fields: an early
+    round that ships a breakdown is held to the reconciliation bar."""
+    early = _half(2400.0, **_feed_fields(),
+                  feed_stage_breakdown=_flight_bd(frac=0.5))
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r07.json", early)])
+    assert verdict["verdict"] == "fail"
+    assert any("does not reconcile" in r for r in verdict["reasons"])
+
+
+def test_flight_breakdown_null_with_reason_is_exempt(tmp_path):
+    """A run with the recorder opted out (TFOS_FLIGHT=0) cannot decompose
+    its wall: explicit null + reason satisfies the r09 requirement; a
+    bare null does not."""
+    opted_out = _r9()
+    opted_out["feed_stage_breakdown"] = None
+    opted_out["feed_stage_breakdown_reason"] = \
+        "flight recorder disabled (TFOS_FLIGHT=0)"
+    opted_out["serve_stage_breakdown"] = None
+    opted_out["serve_stage_breakdown_reason"] = \
+        "flight recorder disabled (TFOS_FLIGHT=0)"
+    verdict = bench_gate.gate(
+        [_write(tmp_path, "BENCH_r09.json", opted_out)])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    bare_null = _r9()
+    bare_null["feed_stage_breakdown"] = None
+    verdict = bench_gate.gate(
+        [_write(tmp_path, "BENCH_r09.json", bare_null)])
+    assert verdict["verdict"] == "fail"
+    assert any("feed_stage_breakdown" in r for r in verdict["reasons"])

@@ -228,11 +228,24 @@ def test_dump_trace_merges_driver_and_executors(sc, tmp_path):
         assert set(health["nodes"]) == {"worker:0", "worker:1"}
     with urllib.request.urlopen(server.url("/trace"), timeout=30) as r:
         live_trace = json.loads(r.read().decode())
+    # ISSUE 6: the /pipeline flight-recorder view round-trips live — the
+    # executors' DataFeed wait/ingest stage histograms shipped with their
+    # metrics publications and render per node
+    with urllib.request.urlopen(server.url("/pipeline"), timeout=30) as r:
+        assert r.status == 200
+        pipeline_doc = json.loads(r.read().decode())
+    assert "planes" in pipeline_doc and "node_runtime" in pipeline_doc
+    feed_nodes = pipeline_doc["planes"]["feed"]["nodes"]
+    assert set(feed_nodes) == {"worker:0", "worker:1"}
+    for doc in feed_nodes.values():
+        assert "wait" in doc["stages"]
 
     # straggler/stall judgment runs on live cluster state without error
-    # (2 healthy uniform nodes: no findings)
+    # (2 healthy uniform nodes: no findings — and no feed-starvation
+    # finding, since the hand-rolled loop commits no flight verdicts)
     report = cluster.check_anomalies()
     assert report["stalled"] == [] and report["stall_events"] == []
+    assert report["feed_starved"] == []
 
     metrics_url = server.url("/metrics")
     cluster.shutdown(grace_secs=30)

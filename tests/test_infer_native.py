@@ -4,6 +4,7 @@ symbols (VERDICT r2 task 4 / SURVEY §2.2 rows 1-2)."""
 
 import os
 import subprocess
+import sys
 
 import numpy as np
 import pytest
@@ -105,6 +106,15 @@ def test_demo_runs_without_python_driver(export):
     assert abs(got - expected) < 1e-3 * max(1.0, abs(expected)), (got, expected)
 
 
+#: bounded retries for the harness STARTUP flake: rc -6
+#: (``recursive_init_error`` SIGABRT) with EMPTY stdout is a native
+#: static-init race in the embedded interpreter before the harness prints
+#: anything — pre-existing, ~3/5 on this box, unrelated to the code under
+#: test.  A fresh process reliably clears it; anything that produced
+#: output (or any other rc) is a REAL result and is never retried.
+_HARNESS_STARTUP_RETRIES = 4
+
+
 def _run_harness(export_dir, model_name, batch, dim, tmpdir):
     harness = infer_native.jni_harness()
     if harness is None:
@@ -113,9 +123,21 @@ def _run_harness(export_dir, model_name, batch, dim, tmpdir):
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.setdefault("TFOS_JAX_PLATFORM", "cpu")
     env.setdefault("TFOS_NUM_CHIPS", "0")
-    return subprocess.run(
-        [harness, export_dir, model_name, str(batch), str(dim), str(tmpdir)],
-        capture_output=True, text=True, timeout=600, env=env)
+    for attempt in range(1 + _HARNESS_STARTUP_RETRIES):
+        proc = subprocess.run(
+            [harness, export_dir, model_name, str(batch), str(dim),
+             str(tmpdir)],
+            capture_output=True, text=True, timeout=600, env=env)
+        if proc.returncode == -6 and not proc.stdout.strip() \
+                and attempt < _HARNESS_STARTUP_RETRIES:
+            # logged loudly so the flake RATE stays visible in test output
+            # even while the retry keeps it from failing the suite
+            print(f"jni harness startup flake (rc -6, empty stdout): "
+                  f"retry {attempt + 1}/{_HARNESS_STARTUP_RETRIES}",
+                  file=sys.stderr, flush=True)
+            continue
+        return proc
+    return proc
 
 
 def test_jni_glue_executes_under_fake_jvm(export, tmp_path):

@@ -148,6 +148,95 @@ def test_byte_bound_keeps_chunk_count_floor():
     assert q.inflight_bytes() == 0  # row lists: no byte accounting
 
 
+def test_queue_gauges_track_residency():
+    """ISSUE 6 satellite: continuous occupancy/byte gauges on the
+    byte-bounded queue — incremented at put, decremented at get, summed
+    across this process's queues."""
+    import numpy as np
+
+    from tensorflowonspark_tpu import marker, obs
+
+    g_chunks = obs.gauge("feed_queue_chunks")
+    g_bytes = obs.gauge("feed_queue_bytes")
+    c0, b0 = g_chunks.value, g_bytes.value
+    q = TFManager._ByteBoundedQueue(maxsize=8, max_bytes=0)
+    a = marker.ColumnarChunk([np.zeros(100, np.uint8)])
+    b = marker.ColumnarChunk([np.zeros(50, np.uint8)])
+    q.put(a)
+    q.put(b)
+    q.put([1, 2, 3])  # legacy rows payload: a chunk with no byte account
+    assert g_chunks.value - c0 == 3
+    assert g_bytes.value - b0 == a.nbytes + b.nbytes
+    got = q.get()
+    # the consumer-held-headroom caveat (PR 3, _ByteBoundedQueue
+    # docstring): the gauges track QUEUE residency — a dequeued shm
+    # descriptor's segment is still pinned in /dev/shm until read_chunk,
+    # but it has left these gauges; shm_bytes_resident is the instrument
+    # that still sees it
+    assert got is a
+    assert g_chunks.value - c0 == 2
+    assert g_bytes.value - b0 == b.nbytes
+    q.get()
+    q.get()
+    assert g_chunks.value - c0 == 0
+    assert g_bytes.value - b0 == 0
+
+
+def test_queue_gauges_under_headroom_caveat_with_shm_descriptor():
+    """Fill/drain with a real shm descriptor: after get() the queue gauges
+    drop while the segment is still resident — exactly the documented
+    headroom between queue accounting and true /dev/shm residency."""
+    import numpy as np
+
+    from tensorflowonspark_tpu import obs, shm
+
+    if not shm.shm_available():
+        pytest.skip("/dev/shm unavailable")
+    g_bytes = obs.gauge("feed_queue_bytes")
+    b0 = g_bytes.value
+    q = TFManager._ByteBoundedQueue(maxsize=8, max_bytes=0)
+    ref = shm.encode_chunk([(np.ones(64, np.float32), 0)])
+    assert isinstance(ref, shm.ShmChunkRef)
+    try:
+        q.put(ref)
+        assert g_bytes.value - b0 == ref.nbytes
+        held = q.get()
+        # dequeued but unconsumed: gone from the queue gauge...
+        assert g_bytes.value - b0 == 0
+        # ...while the /dev/shm scan still counts the bytes
+        segs, resident = shm.resident_stats()
+        assert segs >= 1 and resident >= ref.nbytes
+    finally:
+        shm.maybe_unlink_payload(ref)
+    assert held is ref
+
+
+def test_del_queue_releases_residency_gauges():
+    """Dropping a queue with items still enqueued must release their
+    gauge residency — a failed task's undrained per-task queue must not
+    read as phantom residency forever."""
+    import numpy as np
+
+    from tensorflowonspark_tpu import marker, obs
+
+    g_chunks = obs.gauge("feed_queue_chunks")
+    g_bytes = obs.gauge("feed_queue_bytes")
+    c0, b0 = g_chunks.value, g_bytes.value
+    q = TFManager._ByteBoundedQueue(maxsize=8, max_bytes=0)
+    TFManager._queues["output:ghost"] = q
+    try:
+        q.put(marker.ColumnarChunk([np.zeros(128, np.uint8)]))
+        q.put([1, 2])
+        assert g_chunks.value - c0 == 2
+        assert g_bytes.value - b0 == 128
+        assert TFManager._del_queue("output:ghost") is True
+        assert g_chunks.value - c0 == 0
+        assert g_bytes.value - b0 == 0
+        assert TFManager._del_queue("output:ghost") is False
+    finally:
+        TFManager._queues.pop("output:ghost", None)
+
+
 def test_byte_bound_configured_from_env(monkeypatch):
     """TFOS_FEED_MAX_INFLIGHT_MB reaches the spawned server's queues (the
     env rides the spawn); shm descriptors are accounted at their segment

@@ -116,6 +116,68 @@ def _rows(n, start=0):
             for i in range(start, start + n)]
 
 
+def test_note_rows_derives_padding_waste_ratio_gauge():
+    """ISSUE 6 satellite: the waste gauge is derived from the existing
+    rows/padded counters on every batch — padded / (real + padded), the
+    fraction of forward compute spent on invented rows."""
+    from tensorflowonspark_tpu import obs
+
+    serving.note_rows(24, 32)
+    rows = obs.counter("serving_rows_total").value
+    padded = obs.counter("serving_padded_rows_total").value
+    gauge = obs.gauge("serving_padding_waste_ratio").value
+    assert gauge == pytest.approx(padded / (rows + padded))
+    serving.note_rows(32, 32)  # a full batch moves the ratio down
+    assert obs.gauge("serving_padding_waste_ratio").value < gauge
+
+
+def test_padding_waste_warning_fires_once_over_threshold(monkeypatch):
+    """Bad-bucket-ladder detection: waste above the threshold (over a
+    meaningful row volume) emits ONE structured warning event."""
+    from tensorflowonspark_tpu import obs
+
+    monkeypatch.setattr(serving, "_PAD_WASTE_WARNED", False)
+    # the process counters are cumulative across the suite, so use a
+    # threshold any nonzero cumulative waste ratio clears
+    monkeypatch.setenv("TFOS_SERVING_PAD_WASTE_WARN", "0.000001")
+    tracer = obs.get_tracer()
+    before = sum(1 for e in tracer.snapshot()
+                 if e["name"] == "serving.padding_waste")
+    # enough volume to clear the min-rows guard, mostly padding
+    serving.note_rows(1, serving._PAD_WARN_MIN_ROWS)
+    serving.note_rows(1, serving._PAD_WARN_MIN_ROWS)
+    events = [e for e in tracer.snapshot()
+              if e["name"] == "serving.padding_waste"]
+    assert len(events) == before + 1
+    assert serving._PAD_WASTE_WARNED is True
+    attrs = events[-1]["attrs"]
+    assert attrs["ratio"] > 0
+    assert {"threshold", "rows", "padded"} <= set(attrs)
+    # warned-once: more waste does not re-fire
+    serving.note_rows(1, serving._PAD_WARN_MIN_ROWS)
+    assert sum(1 for e in tracer.snapshot()
+               if e["name"] == "serving.padding_waste") == before + 1
+
+
+def test_padding_waste_warning_respects_min_volume(monkeypatch):
+    """A ragged first batch must not cry wolf: below the min-rows guard
+    no warning fires even at 100% waste."""
+    from tensorflowonspark_tpu import obs, serving as serving_mod
+
+    monkeypatch.setattr(serving_mod, "_PAD_WASTE_WARNED", False)
+    monkeypatch.setenv("TFOS_SERVING_PAD_WASTE_WARN", "0.000001")
+    # raise the volume guard above anything the suite has accumulated —
+    # the counters are process-cumulative by design
+    monkeypatch.setattr(serving_mod, "_PAD_WARN_MIN_ROWS", 10**12)
+    tracer = obs.get_tracer()
+    before = sum(1 for e in tracer.snapshot()
+                 if e["name"] == "serving.padding_waste")
+    serving_mod.note_rows(1, 64)
+    assert sum(1 for e in tracer.snapshot()
+               if e["name"] == "serving.padding_waste") == before
+    assert serving_mod._PAD_WASTE_WARNED is False
+
+
 def test_ingest_chunks_rows_chunking_and_columns():
     chunks = list(serving.ingest_chunks(
         iter(_rows(10)), 4, {"x": "x"}, ["x", "id"]))

@@ -29,10 +29,21 @@ def _segments():
 def no_segment_leaks():
     """The leak assertion: every test leaves /dev/shm exactly as it found
     it.  Tests that deliberately strand a segment must reap it themselves
-    (that is what they are testing)."""
+    (that is what they are testing).  The flight-recorder residency gauges
+    (``shm_segments_live`` / ``shm_bytes_resident``, refreshed by every
+    manager watch cycle in production) must agree — and read zero when the
+    directory is clean."""
     before = _segments()
     yield
     assert _segments() == before, "test leaked shm feed segments"
+    from tensorflowonspark_tpu import obs
+
+    count, nbytes = shm.update_gauges()
+    assert count == len(before)
+    assert obs.gauge("shm_segments_live").value == count
+    assert obs.gauge("shm_bytes_resident").value == nbytes
+    if not before:
+        assert (count, nbytes) == (0, 0)
 
 
 pytestmark = pytest.mark.skipif(
@@ -275,6 +286,23 @@ def test_keepalive_protects_inflight_segments_from_foreign_sweepers():
     finally:
         assert shm.sweep_orphans(grace_s=0.0) >= 1
     assert stranded[0] not in _segments()
+
+
+def test_resident_gauges_see_parked_segments():
+    """A parked segment shows up in resident_stats/update_gauges (the
+    manager watch thread's leak visibility) and disappears on consume."""
+    from tensorflowonspark_tpu import obs
+
+    ref = shm.write_chunk(shm.columnarize(_rows()))
+    try:
+        count, nbytes = shm.update_gauges()
+        assert count >= 1
+        assert nbytes >= ref.nbytes
+        assert obs.gauge("shm_segments_live").value == count
+        assert obs.gauge("shm_bytes_resident").value == nbytes
+    finally:
+        shm.unlink_ref(ref)
+    assert shm.update_gauges() == (0, 0)
 
 
 def test_sweep_keeps_live_creator_segments():

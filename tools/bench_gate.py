@@ -33,7 +33,13 @@ the same transport and feed config.  From round ``--require-serving-from``
 primary half must likewise carry ``serve_rows_per_sec`` with its
 ``serve_ingest`` attribution (or explicit ``null`` + ``serve_reason``);
 healthy serving numbers are only compared across runs with the same ingest
-representation and bucket geometry.
+representation and bucket geometry.  From round ``--require-flight-from``
+(default 9, the round that introduced the pipeline flight recorder) every
+healthy feed/serving number must also ship its stage-time breakdown
+(``feed_stage_breakdown`` / ``serve_stage_breakdown``) with a bottleneck
+verdict, and the breakdown's additive stage sum must reconcile with the
+measured wall time within ``--flight-tolerance`` (default 0.15) — a
+decomposition that does not add up fails the artifact.
 
 Usage::
 
@@ -67,6 +73,12 @@ DEFAULT_REQUIRE_FEED_FROM = 7
 #: first round whose primary half must carry the serving microbench
 #: (``serve_rows_per_sec``, introduced with the bucketed serving data plane)
 DEFAULT_REQUIRE_SERVING_FROM = 8
+#: first round whose feed/serving numbers must each ship a flight-recorder
+#: stage breakdown that reconciles with measured wall time
+DEFAULT_REQUIRE_FLIGHT_FROM = 9
+#: |stage_sum / wall - 1| beyond this fails the artifact: a breakdown that
+#: does not add up is decoration, not attribution
+DEFAULT_FLIGHT_TOLERANCE = 0.15
 
 _REQUIRED_HALF_KEYS = ("metric", "value", "unit", "vs_baseline")
 _ROOFLINE_KEYS = ("mem_bw_gbps", "ici_bw_gbps")
@@ -78,6 +90,61 @@ _SERVE_KEY = "serve_rows_per_sec"
 #: partitions) are different experiments
 _SERVE_IDENT_KEYS = ("serve_ingest", "serve_rows_total", "serve_batch_size",
                      "serve_row_bytes", "serve_bucket_sizes")
+#: (metric key, breakdown key) pairs the flight requirement covers: a
+#: healthy metric value must carry its stage decomposition; a null metric
+#: (already explained by its reason field) owes none
+_FLIGHT_BREAKDOWNS = ((_FEED_KEY, "feed_stage_breakdown"),
+                      (_SERVE_KEY, "serve_stage_breakdown"))
+
+
+def validate_breakdown(half: dict[str, Any], metric_key: str,
+                       breakdown_key: str, *, required: bool,
+                       tolerance: float = DEFAULT_FLIGHT_TOLERANCE
+                       ) -> list[str]:
+    """Schema + reconciliation problems of one stage breakdown.
+
+    A breakdown must name a bottleneck ``verdict`` and its additive
+    ``stage_sum_s`` must reconcile with ``wall_s`` within ``tolerance`` —
+    a decomposition that does not add up to the wall it claims to explain
+    fails the artifact rather than decorating it.  Only judged when the
+    owning metric is a number (an explicit-null metric already carries its
+    reason) and when either ``required`` (r09+) or the breakdown is
+    present anyway.
+    """
+    problems: list[str] = []
+    if not isinstance(half.get(metric_key), (int, float)):
+        return problems
+    bd = half.get(breakdown_key)
+    if bd is None:
+        # a run with the recorder opted out (TFOS_FLIGHT=0) cannot
+        # decompose its wall — an explicit null + reason satisfies, same
+        # contract as every other schema-total field
+        if required and f"{breakdown_key}_reason" not in half:
+            problems.append(
+                f"missing {breakdown_key!r} (stage-time attribution is "
+                "part of the schema from r09: every healthy "
+                f"{metric_key!r} must ship the decomposition that "
+                f"produced it, or an explicit null + "
+                f"'{breakdown_key}_reason')")
+        return problems
+    if not isinstance(bd, dict):
+        return [f"{breakdown_key!r} must be an object"]
+    if not bd.get("verdict"):
+        problems.append(f"{breakdown_key!r} lacks a bottleneck 'verdict'")
+    wall = bd.get("wall_s")
+    ssum = bd.get("stage_sum_s")
+    if not isinstance(wall, (int, float)) or wall <= 0 \
+            or not isinstance(ssum, (int, float)):
+        problems.append(
+            f"{breakdown_key!r} lacks numeric wall_s/stage_sum_s")
+    else:
+        frac = ssum / wall
+        if abs(frac - 1.0) > tolerance:
+            problems.append(
+                f"{breakdown_key!r} stage sum {ssum}s is "
+                f"{round(frac, 3)}x the measured wall {wall}s — the "
+                f"breakdown does not reconcile within ±{tolerance}")
+    return problems
 
 
 def discover(repo_dir: str) -> list[str]:
@@ -267,7 +334,9 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
          target_floor: float = DEFAULT_TARGET_FLOOR,
          require_roofline_from: int = DEFAULT_REQUIRE_ROOFLINE_FROM,
          require_feed_from: int = DEFAULT_REQUIRE_FEED_FROM,
-         require_serving_from: int = DEFAULT_REQUIRE_SERVING_FROM
+         require_serving_from: int = DEFAULT_REQUIRE_SERVING_FROM,
+         require_flight_from: int = DEFAULT_REQUIRE_FLIGHT_FROM,
+         flight_tolerance: float = DEFAULT_FLIGHT_TOLERANCE
          ) -> dict[str, Any]:
     """Run the gate over a trajectory; returns the verdict document."""
     checks: list[dict[str, Any]] = []
@@ -312,6 +381,17 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                                          require_serving=require_sv):
                 check(f"schema:{name}:{label}",
                       "fail" if is_newest else "warn", problem)
+            # flight breakdowns ride the primary half with the microbench
+            # numbers they decompose (judged whenever present; required
+            # from r09)
+            require_fl = (label == "primary"
+                          and art["n"] >= require_flight_from)
+            for mkey, bkey in _FLIGHT_BREAKDOWNS:
+                for problem in validate_breakdown(
+                        half, mkey, bkey, required=require_fl,
+                        tolerance=flight_tolerance):
+                    check(f"flight:{name}:{label}",
+                          "fail" if is_newest else "warn", problem)
 
     if newest["parsed"] is not None and not newest["problems"]:
         for label, half in halves(newest["parsed"]):
@@ -431,6 +511,10 @@ def main(argv: list[str] | None = None) -> int:
                    default=DEFAULT_REQUIRE_FEED_FROM)
     p.add_argument("--require-serving-from", type=int,
                    default=DEFAULT_REQUIRE_SERVING_FROM)
+    p.add_argument("--require-flight-from", type=int,
+                   default=DEFAULT_REQUIRE_FLIGHT_FROM)
+    p.add_argument("--flight-tolerance", type=float,
+                   default=DEFAULT_FLIGHT_TOLERANCE)
     args = p.parse_args(argv)
     paths = args.paths or discover(args.repo)
     if not paths:
@@ -441,7 +525,9 @@ def main(argv: list[str] | None = None) -> int:
                target_floor=args.target_floor,
                require_roofline_from=args.require_roofline_from,
                require_feed_from=args.require_feed_from,
-               require_serving_from=args.require_serving_from)
+               require_serving_from=args.require_serving_from,
+               require_flight_from=args.require_flight_from,
+               flight_tolerance=args.flight_tolerance)
     print(json.dumps(doc))
     return 1 if doc["verdict"] == "fail" else 0
 

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Per-file tier-1 runner: one pytest process per test file, times recorded.
+
+The one-shot tier-1 suite exceeds its 870 s budget on the 2-core container
+even at baseline (ROADMAP "known debt"), so verification happens per file —
+but until now nobody *measured* where the budget goes, which makes the debt
+unactionable.  This runner makes it a number: it runs every
+``tests/test_*.py`` in its own pytest process (same flags as the tier-1
+command, minus the aggregate timeout), records per-file wall time and
+pass/fail counts to ``TIER1_TIMES.json``, and prints the files
+slowest-first so the next split/deflake target is obvious.
+
+Usage::
+
+    python tools/tier1.py                    # all tests/test_*.py
+    python tools/tier1.py tests/test_shm.py  # a subset
+    python tools/tier1.py --timeout 300      # per-FILE timeout (default 600)
+
+Exit code: 0 when every file passed, 1 when any failed/timed out, 2 on
+usage error.  The JSON schema::
+
+    {"generated_at": iso8601, "total_s": float, "python": "...",
+     "files": {"tests/test_x.py": {"wall_s": float, "rc": int,
+               "passed": int, "failed": int, "errors": int,
+               "skipped": int, "timeout": bool}}}
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the tier-1 flags (ROADMAP.md), minus the suite-level ``timeout`` wrapper
+PYTEST_ARGS = ["-q", "-m", "not slow", "--continue-on-collection-errors",
+               "-p", "no:cacheprovider", "-p", "no:xdist",
+               "-p", "no:randomly"]
+
+_SUMMARY_RE = re.compile(
+    r"(\d+) (passed|failed|error|errors|skipped|xfailed|xpassed|warnings?)")
+#: the per-test progress line (`....FE.s  [ 42%]`) — under this repo's
+#: quiet pytest config no "N passed" summary line is printed, so counts
+#: come from the dots, exactly like the tier-1 command's DOTS_PASSED grep.
+#: The percent marker is REQUIRED: a traceback line of bare dots must not
+#: count as passed tests
+_DOTS_RE = re.compile(r"^([.FEsxX]+)\s*\[ *\d+%\]$")
+
+
+def _parse_counts(tail: str) -> dict[str, int]:
+    """Pass/fail/skip counts from pytest's summary line, or — when the
+    quiet config suppresses it — from the progress-dot lines."""
+    counts = {"passed": 0, "failed": 0, "errors": 0, "skipped": 0}
+    for line in reversed(tail.splitlines()):
+        found = _SUMMARY_RE.findall(line)
+        if not found:
+            continue
+        for n, what in found:
+            if what.startswith("error"):
+                counts["errors"] += int(n)
+            elif what in counts:
+                counts[what] += int(n)
+        return counts
+    for line in tail.splitlines():
+        m = _DOTS_RE.match(line.rstrip())
+        if not m:
+            continue
+        dots = m.group(1)
+        counts["passed"] += dots.count(".")
+        counts["failed"] += dots.count("F")
+        counts["errors"] += dots.count("E")
+        counts["skipped"] += dots.count("s")
+    return counts
+
+
+def run_file(path: str, timeout_s: float) -> dict:
+    """One pytest process for one file; returns its record."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.perf_counter()
+    timed_out = False
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", path, *PYTEST_ARGS],
+            capture_output=True, text=True, timeout=timeout_s, cwd=REPO,
+            env=env)
+        rc, out = proc.returncode, proc.stdout
+    except subprocess.TimeoutExpired as e:
+        rc, out = 124, (e.stdout or b"").decode(errors="replace") \
+            if isinstance(e.stdout, bytes) else (e.stdout or "")
+        timed_out = True
+    wall = time.perf_counter() - t0
+    record = {"wall_s": round(wall, 2), "rc": rc, "timeout": timed_out}
+    # full output, not a tail slice: under the repo's -qq config the
+    # progress-dot lines are the only counts, and on a failing file the
+    # trailing screens are tracebacks, not dots
+    record.update(_parse_counts(out))
+    return record
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("files", nargs="*",
+                   help="test files (default: tests/test_*.py)")
+    p.add_argument("--timeout", type=float, default=600.0,
+                   help="per-file timeout in seconds (default 600)")
+    p.add_argument("--out", default=os.path.join(REPO, "TIER1_TIMES.json"))
+    args = p.parse_args(argv)
+    files = args.files or sorted(
+        glob.glob(os.path.join(REPO, "tests", "test_*.py")))
+    if not files:
+        print("tier1: no test files found", file=sys.stderr)
+        return 2
+
+    records: dict[str, dict] = {}
+    t0 = time.perf_counter()
+    for path in files:
+        rel = os.path.relpath(path, REPO)
+        record = run_file(path, args.timeout)
+        records[rel] = record
+        status = ("TIMEOUT" if record["timeout"]
+                  else "ok" if record["rc"] == 0 else f"rc={record['rc']}")
+        print(f"{record['wall_s']:8.1f}s  {status:>8}  "
+              f"{record['passed']:3d} passed {record['failed']:2d} failed  "
+              f"{rel}", flush=True)
+    total = time.perf_counter() - t0
+
+    doc = {
+        "generated_at": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+        "total_s": round(total, 1),
+        "python": sys.version.split()[0],
+        "files": records,
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+    slowest = sorted(records.items(), key=lambda kv: -kv[1]["wall_s"])[:5]
+    print(f"\ntier1: {len(records)} files in {total:.0f}s "
+          f"(budget 870s) → {args.out}")
+    print("slowest:")
+    for rel, r in slowest:
+        print(f"  {r['wall_s']:8.1f}s  {rel}")
+    failed = [rel for rel, r in records.items() if r["rc"] != 0]
+    if failed:
+        print(f"failing files: {failed}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
