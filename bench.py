@@ -229,6 +229,12 @@ def _parse_args(argv=None):
                         "rows/sec through the real _RunModel path, bucketed "
                         "columnar pipeline vs the legacy row loop "
                         "(host-side, no accelerator involved)")
+    p.add_argument("--recovery", action="store_true",
+                   help="measure executor-loss recovery: seconds from "
+                        "SIGKILLing one of three trainers mid-run to the "
+                        "first post-restore step, through the real elastic "
+                        "regroup + checkpoint-restore path (host-side, "
+                        "local substrate)")
     p.add_argument("--_measure", action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--_probe", action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--_force-cpu", action="store_true", help=argparse.SUPPRESS)
@@ -953,6 +959,203 @@ def measure_serving(rows_total: int = 16384, feature_dim: int = 256,
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def _recovery_train_fun(args, ctx):
+    """Elastic map_fun for the recovery microbench: Trainer + periodic
+    async checkpoints + regroup cooperation (the REAL elastic path —
+    same wiring as production, minus the test-only continuity probes)."""
+    from tensorflowonspark_tpu import util
+
+    util.ensure_jax_platform()
+    import numpy as np
+
+    from tensorflowonspark_tpu import TFNode, elastic
+    from tensorflowonspark_tpu.metrics import MetricsReporter
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    def build():
+        t = Trainer("mnist_mlp", config=mnist.Config.tiny(),
+                    learning_rate=1e-2)
+        t.checkpoint(f"{args['model_dir']}/{ctx.job_name}_"
+                     f"{ctx.task_index}", every_steps=args["ckpt_every"])
+        t.add_step_callback(MetricsReporter(ctx, interval=1))
+        return t
+
+    trainer = build()
+    worker = elastic.ElasticWorker(ctx, poll_interval=0.25)
+    trainer.attach_elastic(worker)
+    feed = worker.attach(ctx.get_data_feed(
+        train_mode=True, input_mapping=["image", "label"]))
+    need_resume_report = False
+    while not feed.should_stop():
+        try:
+            batch = feed.next_batch(args["batch_size"])
+            if batch and batch["image"].shape[0] > 0:
+                trainer.step(
+                    {"image": np.asarray(batch["image"], np.float32),
+                     "label": np.asarray(batch["label"], np.int32)})
+                if need_resume_report:
+                    worker.report_resumed(
+                        step=int(np.asarray(trainer.state.step)))
+                    need_resume_report = False
+        except (TFNode.FeedInterrupted, elastic.RegroupSignal):
+            pass
+        if worker.regroup_pending():
+            trainer.finish_checkpoints()
+            worker.rejoin(timeout=120.0)
+            trainer = build()
+            trainer.attach_elastic(worker)
+            trainer.restore_latest()
+            need_resume_report = True
+    trainer.finish_checkpoints()
+
+
+def measure_recovery(num_executors: int = 3, ckpt_every: int = 4,
+                     kill_at_step: int = 8, batch_size: int = 32,
+                     rows: int = 576, num_epochs: int = 16,
+                     feed_timeout: float = 180.0) -> dict:
+    """Recovery microbench: seconds from SIGKILL to the first post-restore
+    step, through the REAL elastic path (ISSUE 8).
+
+    Drives a ``num_executors``-node local-substrate SPARK train with the
+    elastic supervisor attached, SIGKILLs one trainer once it reaches
+    ``kill_at_step``, and measures SIGKILL → the LAST survivor's first
+    post-restore step (the ``elastic:resumed`` kv stamps).  Host-side and
+    CPU-capable, so the number is valid on accelerator-degraded runs; it
+    bounds the real operational cost of a preemption: detection (manager
+    orphan grace + anomaly poll) + generation barrier + checkpoint
+    restore + feed replay to the first step.
+    """
+    import shutil
+
+    import cloudpickle
+    import numpy as np
+
+    import tensorflowonspark_tpu.TFCluster as TFClusterMod
+    from tensorflowonspark_tpu import elastic
+    from tensorflowonspark_tpu.sparkapi import LocalSparkContext
+
+    # the SAME kill protocol the e2e regroup test drives (the chaos
+    # helpers live beside the tests; two hand-rolled copies of the
+    # poll-and-SIGKILL loop would drift)
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests"))
+    import chaos
+
+    cloudpickle.register_pickle_by_value(sys.modules[__name__])
+    # fast detection: the dead node's manager lingers for the orphan
+    # grace before the loss is confirmable — the default 15 s is sized
+    # for production feed hiccups, not a microbench
+    prev_grace = os.environ.get("TFOS_MANAGER_ORPHAN_GRACE_S")
+    os.environ["TFOS_MANAGER_ORPHAN_GRACE_S"] = "3"
+    tmpdir = tempfile.mkdtemp(prefix="tfos_recovery_bench_")
+    sc = LocalSparkContext(f"local-cluster[{num_executors},1,1024]",
+                           "recovery-bench")
+    out: dict = {
+        "recovery_num_executors": num_executors,
+        "recovery_ckpt_every_steps": ckpt_every,
+        "recovery_kill_at_step": kill_at_step,
+        "recovery_batch_size": batch_size,
+    }
+    cluster = sup = None
+    try:
+        args = {"model_dir": tmpdir, "ckpt_every": ckpt_every,
+                "batch_size": batch_size}
+        cluster = TFClusterMod.run(
+            sc, _recovery_train_fun, tf_args=args,
+            num_executors=num_executors,
+            input_mode=TFClusterMod.InputMode.SPARK)
+        sup = elastic.ElasticSupervisor(
+            cluster, poll_interval=0.5, max_regroups=1,
+            regroup_timeout=120.0, resume_wait_s=90.0).start()
+        victim = max(cluster.cluster_info, key=lambda m: m["executor_id"])
+        kill = chaos.kill_trainer_at_step(cluster, victim,
+                                          at_step=kill_at_step,
+                                          timeout=240.0,
+                                          poll_interval=0.2)
+        rng = np.random.default_rng(0)
+        data = [(rng.random(64).astype(np.float32), int(i % 10))
+                for i in range(rows)]
+        sup.train(sc.parallelize(data, num_executors),
+                  num_epochs=num_epochs, feed_timeout=feed_timeout,
+                  metrics_interval=1.0, detect_timeout=90.0)
+        kill["event"].wait(timeout=10.0)
+        if "killed_ts" not in kill:
+            raise RuntimeError(
+                "victim was never killed (training finished first — "
+                f"raise num_epochs or lower kill_at_step): "
+                f"{kill.get('error')}")
+        if sup.generation < 1:
+            raise RuntimeError("no regroup happened after the kill")
+        record = sup.regroups[0]
+        # wait (bounded) for the async recovery stamps
+        deadline = time.monotonic() + 90
+        while record["recovery_seconds"] is None \
+                and time.monotonic() < deadline:
+            time.sleep(0.5)
+        stamps = cluster.server.kv_items(
+            f"{elastic.RESUMED_KEY}:{sup.generation}:")
+        if not stamps:
+            raise RuntimeError("no survivor stamped a post-restore step")
+        # one host by construction (local substrate), so the workers'
+        # stamp clocks and the killer's clock agree — this is the
+        # SIGKILL-anchored number; the supervisor's detect-anchored view
+        # rides along as attribution
+        out["recovery_seconds"] = round(
+            max(float(v["ts"]) for v in stamps.values())
+            - kill["killed_ts"], 3)
+        out["recovery_barrier_seconds"] = record["barrier_seconds"]
+        out["recovery_detect_to_resume_seconds"] = record[
+            "recovery_seconds"]
+        out["recovery_generation"] = sup.generation
+        out["recovery_survivors"] = len(stamps)
+        return out
+    finally:
+        # teardown in ALL paths: an error mid-measure must not leak a
+        # live 3-executor cluster (threads, managers, shm) into the rest
+        # of the bench process — it would contend with and corrupt the
+        # remaining measurements
+        try:
+            if cluster is not None:
+                cluster.shutdown(grace_secs=90)
+        except Exception:
+            pass
+        if sup is not None:
+            sup.stop()
+        if prev_grace is None:
+            os.environ.pop("TFOS_MANAGER_ORPHAN_GRACE_S", None)
+        else:
+            os.environ["TFOS_MANAGER_ORPHAN_GRACE_S"] = prev_grace
+        sc.stop()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def _stamp_recovery(result: dict, deadline: _Deadline) -> None:
+    """Stamp the recovery microbench into the headline result.
+
+    Host-side (local substrate, CPU-capable) like the feed/serving
+    microbenches, so it runs on accelerator-degraded rounds too.  The
+    schema is total from r10: failure or an exhausted wall budget stamps
+    an explicit null + ``recovery_reason``
+    (``tools/bench_gate.py --require-recovery-from``)."""
+    from tensorflowonspark_tpu import obs
+
+    if deadline.remaining() < 240:
+        result["recovery_seconds"] = None
+        result["recovery_reason"] = ("wall budget exhausted before "
+                                     "recovery microbench")
+        return
+    with obs.span("bench.recovery") as sp:
+        try:
+            result.update(measure_recovery())
+            sp.set(ok=True, seconds=result.get("recovery_seconds"))
+        except Exception as e:
+            result["recovery_seconds"] = None
+            result["recovery_reason"] = (
+                f"recovery microbench failed: {e!r}"[:200])
+            sp.set(ok=False, error=str(e)[:200])
+
+
 def _stamp_serving(result: dict, deadline: _Deadline) -> None:
     """Stamp the serving microbench into the headline result.
 
@@ -1225,6 +1428,15 @@ def main() -> None:
         print(json.dumps(result))
         return
 
+    if args.recovery:
+        # host-side elastic-recovery measurement: no accelerator, no probe
+        result = {"metric": "recovery_seconds", "unit": "seconds"}
+        _stamp_recovery(result, deadline)
+        result["value"] = result.get("recovery_seconds")
+        _write_trace_artifact(result)
+        print(json.dumps(result))
+        return
+
     probe = _probe_accelerator(deadline)
     probe_failed_at_start = not probe.get("ok")
     health = {"ok": bool(probe.get("ok")),
@@ -1305,6 +1517,7 @@ def main() -> None:
     result["secondary"] = _bench_one("wide_deep", args, deadline, health)
     _stamp_feed_transport(result, deadline)
     _stamp_serving(result, deadline)
+    _stamp_recovery(result, deadline)
     if not probe.get("ok"):
         result["probe"] = probe
     _ensure_roofline_fields(

@@ -70,6 +70,10 @@ class TFCluster:
         #: last anomaly report from :meth:`check_anomalies`
         self.last_anomaly_report: dict | None = None
         self._obs_server = None
+        #: elastic supervisor, when one is attached
+        #: (:class:`tensorflowonspark_tpu.elastic.ElasticSupervisor`);
+        #: :meth:`health` surfaces its state on ``/healthz``
+        self._elastic = None
 
     # -- data plane --------------------------------------------------------
 
@@ -346,6 +350,18 @@ class TFCluster:
         merged = agg.get("registry")
         if merged:
             parts.append(reg.merged_to_prometheus(merged))
+        # the DRIVER's own registry rides along too — the elastic
+        # supervisor's counters (elastic_regroups_total, recovery_seconds)
+        # live here, not on any node.  Families the node merge already
+        # emitted are dropped: a second "# TYPE" line for the same name is
+        # an exposition-format violation scrapers reject.
+        drv = obs.get_registry().snapshot()
+        merged = merged or {}
+        drv = {section: {k: v for k, v in (drv.get(section) or {}).items()
+                         if k not in (merged.get(section) or {})}
+               for section in ("counters", "gauges", "histograms")}
+        if any(drv.values()):
+            parts.append(reg.snapshot_to_prometheus(drv))
         return "".join(parts)
 
     def dump_trace(self, path: str) -> str:
@@ -455,6 +471,20 @@ class TFCluster:
             for n, s in sorted((agg.get("nodes") or {}).items())
             if s and s.get("stale")
             and self._last_node_state.get(n) != "finished"]
+        # manager-reported trainer deaths: where the executor process
+        # survives its trainer (persistent workers, the local substrate),
+        # the node's manager stays REACHABLE — the stale-based judgment
+        # above never fires — but its orphan watch marked the node "lost"
+        # the moment the trainer pid vanished without reporting
+        seen_died = {d["node"] for d in report["died"]}
+        # dict() snapshot: the metrics poller / health() threads insert
+        # into _last_node_state concurrently, and iterating the live dict
+        # here could raise mid-detection (the copy itself is atomic under
+        # the GIL)
+        report["died"] += [
+            {"node": n, "last_state": "lost"}
+            for n, state in sorted(dict(self._last_node_state).items())
+            if state == "lost" and n not in seen_died]
         if scan_traces is None:
             # only a finding not yet reported justifies the RPCs: a node
             # that STAYS stalled would otherwise re-pull every blackboard
@@ -587,11 +617,37 @@ class TFCluster:
             else:
                 state = "unreachable"
                 healthy = False
-            if state == "failed":
+            if state in ("failed", "lost"):
                 healthy = False
             nodes[name] = state
-        return {"status": "ok" if healthy else "degraded", "nodes": nodes,
-                "num_nodes": len(nodes)}
+        doc = {"status": "ok" if healthy else "degraded", "nodes": nodes,
+               "num_nodes": len(nodes)}
+        if self._elastic is not None:
+            # degraded-but-recovering vs dead (ISSUE 8): a regroup in
+            # flight reports "recovering" (work in progress, not a 503 —
+            # the lost node is expected to be unreachable and the
+            # survivors are mid-rejoin); a dead supervisor (budget
+            # exhausted / barrier timeout) is a real "degraded".  Already-
+            # mourned nodes are annotated "lost" for the reader.
+            sup = self._elastic.status()
+            doc["elastic"] = sup
+            mourned = set(sup.get("lost_nodes") or [])
+            for n in mourned:
+                if nodes.get(n) in (None, "unreachable"):
+                    nodes[n] = "lost"
+            if sup["state"] == "dead":
+                doc["status"] = "degraded"
+            elif sup["state"] == "regrouping":
+                doc["status"] = "recovering"
+            elif doc["status"] == "degraded" and all(
+                    s not in ("unreachable", "failed")
+                    and (s != "lost" or n in mourned)
+                    for n, s in nodes.items()):
+                # the only unhealthy nodes were the regrouped-away ones
+                # (mourned, annotated "lost"): the surviving cluster is
+                # whole again
+                doc["status"] = "ok"
+        return doc
 
     def pipeline_report(self) -> dict:
         """Live pipeline flight-recorder view: where each node's batch
@@ -673,8 +729,13 @@ class TFCluster:
                     self.metrics_prometheus())
 
         def _healthz():
+            # "recovering" (elastic regroup in flight) serves 200: the
+            # endpoint names the state, and flapping to 503 mid-recovery
+            # would page for exactly the condition the supervisor is
+            # already handling; only "degraded" (truly unhealthy / dead
+            # supervisor) is a 503
             doc = self.health()
-            return (200 if doc["status"] == "ok" else 503,
+            return (503 if doc["status"] == "degraded" else 200,
                     "application/json", _json.dumps(doc))
 
         def _trace():

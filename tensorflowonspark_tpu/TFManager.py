@@ -247,7 +247,24 @@ def _pid_alive(pid: int, recorded_start: int | None) -> bool | None:
         current = proc_start_time(pid)
         if current is not None and current != recorded_start:
             return False
+    # a ZOMBIE is dead: a SIGKILLed spawned trainer lingers as a zombie
+    # child of its (still-running) executor worker, passes signal-0, and
+    # keeps its start tick — without this check the orphan watch (and the
+    # elastic trainer-death detection) would consider it alive forever
+    if _proc_state(pid) in (b"Z", b"X"):
+        return False
     return exists
+
+
+def _proc_state(pid: int) -> bytes | None:
+    """One-letter kernel state of ``pid`` (``/proc/<pid>/stat`` field 3:
+    R/S/D/Z/...), or None off-Linux / for a vanished pid."""
+    try:
+        with open(f"/proc/{int(pid)}/stat", "rb") as f:
+            data = f.read()
+        return data[data.rfind(b")") + 2:].split()[0]
+    except Exception:
+        return None
 
 
 def _setup(qnames: Iterable[str], maxsize: int,
@@ -364,6 +381,54 @@ def _start_orphan_watch(parent_pid: int | None) -> None:
         except Exception:
             pass  # telemetry must never kill the watch
 
+    def _drain_dead_node_queues() -> None:
+        # chunks staged for a corpse will never be consumed, and their shm
+        # segments would be keepalive-pinned by THIS manager's own sweep
+        # exclusion forever (leaked host memory until every manager on the
+        # host is gone).  Runs EVERY watch cycle while the node is lost:
+        # a feeder mid-partition when the trainer died keeps delivering
+        # until it notices the state, and a one-shot drain would strand
+        # everything it enqueues after the first pass.
+        from tensorflowonspark_tpu import shm as _shm
+
+        for qname, q in list(_queues.items()):
+            if qname == "error":
+                continue  # the attribution must stay drainable
+            while True:
+                try:
+                    item = q.get(block=False)
+                except Exception:
+                    break
+                try:
+                    _shm.maybe_unlink_payload(item)
+                except Exception:
+                    pass
+
+    def _mark_lost_if_trainer_vanished() -> None:
+        # elastic membership (ISSUE 8): a trainer that VANISHES while its
+        # node still reads "running" was killed from outside (SIGKILL,
+        # preemption) — no code path of its own could report.  Mark the
+        # node "lost" and leave an attributed error, so the driver's
+        # anomaly detection confirms the death even where this manager
+        # itself survives (a persistent executor worker keeps the parent
+        # alive, so the reaping below never fires).
+        if _kv.get("state") == "lost":
+            _drain_dead_node_queues()
+            return
+        if _kv.get("state") != "running" or not _kv.get("trainer_pid"):
+            return
+        if _trainer_alive():
+            return
+        pid = _kv.get("trainer_pid")
+        _kv["state"] = "lost"
+        try:
+            _get_queue("error").put(
+                f"trainer process (pid {pid}) vanished without reporting "
+                "(SIGKILL / preemption?) — node marked lost")
+        except Exception:
+            pass
+        _drain_dead_node_queues()
+
     def watch() -> None:
         last_sweep = 0.0
         while True:
@@ -374,6 +439,7 @@ def _start_orphan_watch(parent_pid: int | None) -> None:
                 last_sweep = now
             _sweep_shm(do_sweep)
             _publish_pipeline_stats()
+            _mark_lost_if_trainer_vanished()
             if os.getppid() == parent_pid:
                 continue
             if _trainer_alive():

@@ -33,6 +33,13 @@ from tensorflowonspark_tpu import marker, shm
 logger = logging.getLogger(__name__)
 
 
+class FeedInterrupted(Exception):
+    """Raised out of ``DataFeed.next_batch`` when the feed's ``interrupt``
+    callback reports a pending condition (an elastic regroup) while the
+    consumer is blocked on an empty queue.  Buffered data is untouched —
+    the caller handles the condition and may keep consuming afterwards."""
+
+
 class DataFeed:
     """Consume Spark partition data inside ``map_fun``.
 
@@ -84,6 +91,13 @@ class DataFeed:
         self._buffer_tags: list[list] = []
         self._out_route: list[list] = []
         self._stop_seen = False  # StopFeed consumed by the assembling side
+        #: optional zero-arg callable (``elastic.ElasticWorker.attach``):
+        #: when set and truthy while the consumer is BLOCKED on an empty
+        #: queue, ``next_batch`` raises :class:`FeedInterrupted` instead of
+        #: waiting forever — a starved survivor must still reach its
+        #: between-steps regroup check.  Flowing data is never interrupted.
+        self.interrupt: Any = None
+        self._interrupt_poll_s = 0.5
         self._pf_thread = None
         self._pf_out: _std_queue.Queue | None = None
         self._pf_args: tuple | None = None
@@ -141,7 +155,19 @@ class DataFeed:
         wait_s = 0.0
         while self._buffered_rows < batch_size and not self._stop_seen:
             tw = _time_mod.perf_counter()
-            item = self._queue_in.get()
+            if self.interrupt is None:
+                item = self._queue_in.get()
+            else:
+                while True:
+                    try:
+                        item = self._queue_in.get(
+                            timeout=self._interrupt_poll_s)
+                        break
+                    except _std_queue.Empty:
+                        if self.interrupt():
+                            raise FeedInterrupted(
+                                "feed wait interrupted (regroup pending)"
+                            ) from None
             wait_s += _time_mod.perf_counter() - tw
             if isinstance(item, marker.StopFeed):
                 self._stop_seen = True
@@ -259,6 +285,15 @@ class DataFeed:
         obs.flight.recorder("feed").add(
             wait=_time_mod.perf_counter() - tw)
         if isinstance(item, BaseException):
+            if isinstance(item, FeedInterrupted):
+                # the pump thread died delivering this — reset so the
+                # NEXT call restarts it (the interrupt contract promises
+                # the caller may keep consuming after handling the
+                # condition; a dead pump would block that call forever on
+                # an empty staging queue).  Buffered pieces stay intact.
+                self._pf_thread = None
+                self._pf_out = None
+                self._pf_args = None
             raise item
         batch, runs, stopped = item
         if stopped:

@@ -166,11 +166,17 @@ def _guard_name(cluster_id: str) -> str:
     return f"executor_id_{cluster_id}"
 
 
-def _resolve_node(cluster_info, cluster_id) -> dict[str, Any]:
+def _resolve_node(cluster_info, cluster_id,
+                  lost_executors=None) -> dict[str, Any] | None:
     """Find the cluster node co-located with the current task's executor.
 
     Reference anchor: ``TFSparkNode.py::_get_manager`` — match by the
     executor-id file the bootstrap task wrote into this executor's cwd.
+
+    ``lost_executors`` (elastic membership): executor ids regrouped away
+    by the supervisor.  A task landing on one of those returns ``None``
+    instead of raising — the caller discards the partition rather than
+    failing the whole job on an executor the cluster already mourned.
     """
     eid = util.read_executor_id(name=_guard_name(cluster_id))
     if eid is None:
@@ -182,7 +188,26 @@ def _resolve_node(cluster_info, cluster_id) -> dict[str, Any]:
     for meta in cluster_info:
         if meta["executor_id"] == eid:
             return meta
+    if lost_executors and eid in set(lost_executors):
+        return None
     raise RuntimeError(f"executor_id {eid} not present in cluster_info")
+
+
+def _discard_partition(iterator: Iterator, cluster_meta: dict) -> None:
+    """Consume and drop a partition routed to a lost executor.
+
+    On real Spark, losing the executor loses its partition tasks too and
+    the re-submitted task lands on a SURVIVING executor (whose co-located
+    node consumes it); on the bundled local substrate tasks stay pinned to
+    their executor index, so the data is dropped — the elastic feed replay
+    re-feeds the epoch, and this is the slice of it a dead node would have
+    trained.  Logged loudly so the loss is visible either way.
+    """
+    n = sum(1 for _ in iterator)
+    logger.warning(
+        "executor lost in a prior regroup (cluster %s): discarding its "
+        "%d-row partition (a real Spark cluster reschedules the partition "
+        "onto a surviving executor instead)", cluster_meta.get("id"), n)
 
 
 def _connect_mgr(node_meta: dict[str, Any], authkey: bytes):
@@ -485,11 +510,15 @@ class _TrainFn:
         self.qname = qname
 
     def __call__(self, iterator: Iterator) -> None:
-        node = _resolve_node(self.cluster_info, self.meta["id"])
+        node = _resolve_node(self.cluster_info, self.meta["id"],
+                             lost_executors=self.meta.get("lost_executors"))
+        if node is None:  # this executor's node was lost in a regroup
+            _discard_partition(iterator, self.meta)
+            return
         mgr = _connect_mgr(node, bytes.fromhex(self.meta["authkey_hex"]))
         _raise_worker_error(mgr)
         state = mgr.get("state")
-        if state in ("terminating", "finished", "failed"):
+        if state in ("terminating", "finished", "failed", "lost"):
             logger.info("node state %s: discarding partition", state)
             for _ in iterator:
                 pass
@@ -529,12 +558,18 @@ class _TrainFn:
                 "consuming (hung or finished?)"
             ) from None
         # wait for consumption so Spark doesn't consider the epoch done while
-        # data is still queued (reference used queue.join())
+        # data is still queued (reference used queue.join()).  The state
+        # check runs BEFORE the qsize==0 early-return: the manager that
+        # marked its node "lost" also DRAINS the dead trainer's queues, and
+        # a drained queue must still abort this epoch with the attribution
+        # (a feed that "completed" into a corpse would never be replayed by
+        # the elastic supervisor) instead of reading as consumed
         while True:
-            if q.qsize() == 0:
-                return
-            if mgr.get("state") in ("terminating", "finished", "failed"):
+            if mgr.get("state") in ("terminating", "finished", "failed",
+                                    "lost"):
                 _raise_worker_error(mgr)
+                return
+            if q.qsize() == 0:
                 return
             if time.monotonic() > deadline:
                 raise RuntimeError(
@@ -570,7 +605,14 @@ class _InferenceFn:
     def __call__(self, iterator: Iterator):
         import uuid
 
-        node = _resolve_node(self.cluster_info, self.meta["id"])
+        node = _resolve_node(self.cluster_info, self.meta["id"],
+                             lost_executors=self.meta.get("lost_executors"))
+        if node is None:
+            # executor mourned by a regroup: no co-located node to score
+            # this partition — discard it (real Spark reschedules the
+            # partition onto a surviving executor) and return no results
+            _discard_partition(iterator, self.meta)
+            return []
         mgr = _connect_mgr(node, bytes.fromhex(self.meta["authkey_hex"]))
         _raise_worker_error(mgr)
         qin = mgr.get_queue(self.qname_in)
@@ -653,10 +695,20 @@ class _ShutdownFn:
 
     def __call__(self, iterator: Iterator) -> None:
         list(iterator)  # consume the placeholder partition element
-        node = _resolve_node(self.cluster_info, self.meta["id"])
+        node = _resolve_node(self.cluster_info, self.meta["id"],
+                             lost_executors=self.meta.get("lost_executors"))
+        if node is None:
+            # node lost in a regroup: its trainer is dead and its manager
+            # reaped — there is nothing left here to stop
+            logger.info("shutdown: executor was lost in a prior regroup; "
+                        "nothing to stop")
+            return
         mgr = _connect_mgr(node, bytes.fromhex(self.meta["authkey_hex"]))
         state = mgr.get("state")
-        if state in ("finished", "failed"):
+        if state in ("finished", "failed", "lost"):
+            # "lost": the trainer vanished (SIGKILL/preemption) — the
+            # error queue carries the manager's attribution; raise it
+            # rather than burning the grace period on a corpse
             _raise_worker_error(mgr)
             return
         mgr.set("state", "terminating")

@@ -126,6 +126,14 @@ class CheckpointManager:
         return self._mgr.latest_step()
 
     def restore(self, step: int | None = None, target: Any | None = None) -> Any:
+        """Restore checkpoint ``step`` (default: newest committed).
+
+        With ``target`` the restore is resharded to the *target's*
+        topology (``StandardRestore`` carries the target's shardings, not
+        the writer's recorded ones) — the property the elastic-regroup
+        path depends on: survivors rebuild their mesh over a smaller
+        device set and restore the old world's checkpoint straight into
+        it."""
         import orbax.checkpoint as ocp
 
         if step is None:
@@ -135,6 +143,16 @@ class CheckpointManager:
         if target is None:
             return self._mgr.restore(step)
         return self._mgr.restore(step, args=ocp.args.StandardRestore(target))
+
+    def restore_latest(self, target: Any | None = None
+                       ) -> tuple[int, Any] | None:
+        """``(step, state)`` of the newest committed checkpoint, or None
+        when none has committed yet (async saves still in flight do not
+        count — ``latest_step`` names only durable checkpoints)."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        return int(step), self.restore(step, target=target)
 
     def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()

@@ -1,0 +1,551 @@
+"""Elastic cluster membership: survive executor loss, resume from checkpoint.
+
+Reference behavior: TFoS (and this repo's first seven PRs) assumes a fixed
+executor set for the life of the job — the reservation barrier forms once,
+and the documented failure model is ``spark.task.maxFailures=1`` + restart
+the WHOLE job from the last checkpoint.  On real fleets (Spark dynamic
+allocation, preemptible TPU VMs) a single lost executor then costs the
+entire run.  TF-Replicator (PAPERS.md, arXiv:1902.00465) is the pattern
+reference for the fix: decouple the replica topology from the training
+loop, so membership can change without rewriting the step.
+
+Two halves over the generation-fenced rendezvous
+(:mod:`tensorflowonspark_tpu.reservation`):
+
+- :class:`ElasticSupervisor` (driver): subscribes to
+  ``TFCluster.check_anomalies()``; on a confirmed ``anomaly.node_died``
+  finding it initiates a **generation bump** — opens rendezvous generation
+  N+1 sized to the survivors (``Server.begin_generation``), broadcasts a
+  structured ``regroup`` command on the rendezvous kv, barriers the
+  survivors back in, rewires the cluster's data plane to the new
+  membership, and (via :meth:`ElasticSupervisor.train`) replays the
+  aborted epoch to the survivors — the bounded replay window: work since
+  the last checkpoint is retrained, bounded by the checkpoint cadence
+  (``Trainer.checkpoint(every_steps=…)`` / ``TFOS_CKPT_EVERY_STEPS``).
+- :class:`ElasticWorker` (trainer process): a heartbeat-cadence poll
+  thread watches the rendezvous kv for regroup commands; the step loop
+  checks :meth:`ElasticWorker.regroup_pending` between steps (or rides
+  ``Trainer.attach_elastic``, which raises :class:`RegroupSignal` from the
+  step path), then :meth:`ElasticWorker.rejoin` tears down collectives
+  cleanly, re-enters the rendezvous under the new generation, and the
+  caller rebuilds its ``Trainer`` over the surviving device set and
+  restores from the latest checkpoint (``Trainer.restore_latest`` —
+  resharded to the reader's topology by
+  ``ckpt.CheckpointManager.restore``).
+
+Out of scope (documented in DEPLOY.md "Preemption tolerance"): loss of the
+driver (the rendezvous server and the supervisor live there), and loss of
+so many executors that fewer than ``min_nodes`` survive — both remain the
+restart-the-job failure model.
+
+Observability: ``elastic_regroups_total`` / ``elastic_lost_nodes_total``
+counters and the ``recovery_seconds`` histogram in the driver's
+:mod:`tensorflowonspark_tpu.obs` registry, ``elastic.regroup`` /
+``elastic.rejoin`` trace spans, supervisor state on ``/healthz``
+(``TFCluster.health``: ``recovering`` while a regroup is in flight,
+``degraded`` when the supervisor is dead), and a ``bench.py --recovery``
+metric (seconds from SIGKILL to the first post-restore step) gated by
+``tools/bench_gate.py`` from round 10.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable
+
+from tensorflowonspark_tpu import obs, reservation
+
+logger = logging.getLogger(__name__)
+
+#: rendezvous-kv key of the structured regroup command (driver → workers)
+REGROUP_KEY = "elastic:regroup"
+#: per-node post-restore stamp: ``elastic:resumed:<gen>:<node>``
+RESUMED_KEY = "elastic:resumed"
+
+
+class RegroupSignal(Exception):
+    """Raised between steps (``Trainer.attach_elastic``) when a regroup
+    command is pending; carries the command so the catcher can rejoin."""
+
+    def __init__(self, command: dict[str, Any]):
+        super().__init__(
+            f"cluster regroup to generation {command.get('gen')} pending")
+        self.command = command
+
+
+class DeclaredLostError(RuntimeError):
+    """This node was declared lost by the supervisor: it IS the zombie
+    (e.g. it stalled long enough to be regrouped away and then woke up).
+    The only correct move is to exit — its generation is fenced off."""
+
+
+class ElasticWorker:
+    """Trainer-process half of elastic membership.
+
+    Polls the rendezvous kv for regroup commands on a background thread
+    (heartbeat cadence — no per-step RPC on the step path); the training
+    loop checks :meth:`regroup_pending` between steps and calls
+    :meth:`rejoin` to re-enter the rendezvous under the new generation.
+    :meth:`attach` additionally makes a queue-blocked ``DataFeed`` yield
+    (``TFNode.FeedInterrupted``) so a starved survivor still reaches its
+    regroup check instead of wedging the barrier.
+    """
+
+    def __init__(self, ctx, poll_interval: float = 1.0,
+                 auto_start: bool = True):
+        if not (getattr(ctx, "server_addr", None)
+                and getattr(ctx, "auth_token", None)):
+            raise ValueError(
+                "ElasticWorker needs a ctx carrying the rendezvous "
+                "endpoint (server_addr + auth_token)")
+        self.ctx = ctx
+        self.node = f"{ctx.job_name}:{ctx.task_index}"
+        self.poll_interval = poll_interval
+        #: generation this worker currently belongs to
+        self.generation = 0
+        self._pending: dict[str, Any] | None = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        # retries=0: the poll loop's next tick IS the retry — the default
+        # backoff budget would stretch one tick to ~5 s of dead sleep
+        # whenever the driver is briefly unreachable
+        self._client = reservation.Client(ctx.server_addr, ctx.auth_token,
+                                          retries=0)
+        self._thread: threading.Thread | None = None
+        if auto_start:
+            self.start()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._poll, name="tfos-elastic-worker", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _poll(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                cmd = self._client.get(REGROUP_KEY, timeout=0.0)
+            except KeyError:
+                continue
+            except Exception as e:  # driver restarting / transient socket
+                logger.debug("elastic poll failed: %s", e)
+                continue
+            if not isinstance(cmd, dict):
+                continue
+            gen = int(cmd.get("gen", 0))
+            with self._lock:
+                if gen > self.generation and (
+                        self._pending is None
+                        or gen > int(self._pending.get("gen", 0))):
+                    logger.warning(
+                        "node %s: regroup command for generation %d "
+                        "(lost: %s)", self.node, gen, cmd.get("lost"))
+                    self._pending = cmd
+
+    def regroup_pending(self) -> bool:
+        with self._lock:
+            return self._pending is not None
+
+    def command(self) -> dict[str, Any] | None:
+        with self._lock:
+            return self._pending
+
+    def attach(self, feed):
+        """Wire a ``DataFeed`` so that blocking on an empty queue yields
+        ``TFNode.FeedInterrupted`` once a regroup is pending — a survivor
+        starved by the aborted feed must still reach its regroup check."""
+        feed.interrupt = self.regroup_pending
+        return feed
+
+    def rejoin(self, timeout: float = 120.0) -> dict[str, Any]:
+        """Tear down collectives, re-enter the rendezvous at the pending
+        generation, and barrier with the other survivors.
+
+        Returns ``{"gen", "cluster_info", "lost"}``.  Raises
+        :class:`DeclaredLostError` when this node itself is on the
+        command's lost list (it is the zombie the regroup fenced off).
+        After return, ``ctx.cluster_info`` / ``ctx.cluster_spec`` reflect
+        the new membership, so a subsequent
+        ``distributed.maybe_initialize(ctx)`` re-forms the runtime over
+        the survivors.
+        """
+        cmd = self.command()
+        if cmd is None:
+            raise RuntimeError("no regroup pending")
+        gen = int(cmd["gen"])
+        if self.node in (cmd.get("lost") or []):
+            raise DeclaredLostError(
+                f"node {self.node} was declared lost in generation {gen}")
+        from tensorflowonspark_tpu import util
+        from tensorflowonspark_tpu.parallel import distributed
+
+        with obs.span("elastic.rejoin", gen=gen, node=self.node):
+            # collectives of the old world first: a live distributed
+            # runtime pinned to dead peers would wedge the first psum
+            distributed.maybe_shutdown()
+            host, port = util.find_free_port()
+            meta = {
+                "executor_id": self.ctx.executor_id,
+                "host": host,
+                "port": port,
+                "job_name": self.ctx.job_name,
+                "task_index": self.ctx.task_index,
+                "addr": list(self.ctx.mgr_addr),
+                "pid": os.getpid(),
+            }
+            client = reservation.Client(
+                self.ctx.server_addr, self.ctx.auth_token, generation=gen)
+            # same ordering contract as bootstrap: the new coordinator
+            # publishes its address BEFORE registering, so every survivor
+            # can read it after the barrier
+            if cmd.get("coordinator") == self.node:
+                client.put(f"jax_coordinator:gen{gen}", f"{host}:{port}")
+            client.register(meta)
+            info = client.await_reservations(timeout=timeout)
+        with self._lock:
+            self.generation = gen
+            self._pending = None
+        # NOTE: the poll client stays UNSTAMPED — fencing is for writes
+        # and barriers.  A stamped poll would go blind the moment a LATER
+        # regroup bumps the server past its generation (every read would
+        # be rejected as stale), and reads are harmless from any epoch.
+        obs.counter("elastic_rejoins_total").inc()
+        obs.event("elastic.rejoined", gen=gen, node=self.node,
+                  peers=len(info))
+        self.ctx.cluster_info = info
+        spec: dict[str, list[str]] = {}
+        for m in info:
+            spec.setdefault(m["job_name"], []).append(
+                f"{m['host']}:{m['port']}")
+        self.ctx.cluster_spec = spec
+        return {"gen": gen, "cluster_info": info,
+                "lost": cmd.get("lost") or []}
+
+    def report_resumed(self, step: int | None = None,
+                       loss: float | None = None) -> None:
+        """Stamp the first post-restore step on the rendezvous kv — the
+        supervisor's (and ``bench.py --recovery``'s) recovery-time mark."""
+        payload = {"node": self.node, "gen": self.generation,
+                   "ts": time.time(), "step": step, "loss": loss}
+        try:
+            client = reservation.Client(
+                self.ctx.server_addr, self.ctx.auth_token,
+                generation=self.generation)
+            client.put(f"{RESUMED_KEY}:{self.generation}:{self.node}",
+                       payload)
+        except Exception as e:  # observability only — never kill training
+            logger.warning("could not stamp resume: %s", e)
+
+
+class ElasticSupervisor:
+    """Driver-side elastic membership supervisor (see module docstring).
+
+    States: ``watching`` (healthy / recovered, monitoring), ``regrouping``
+    (a generation bump is in flight), ``dead`` (regroup budget exhausted,
+    barrier timed out, or too few survivors — the job is back to the
+    restart-from-checkpoint failure model).  Surfaced on ``/healthz`` via
+    ``TFCluster.health`` as ``status: recovering`` (degraded-but-
+    recovering, HTTP 200) vs ``degraded`` (HTTP 503).
+    """
+
+    def __init__(self, cluster, poll_interval: float = 2.0,
+                 max_regroups: int = 2, regroup_timeout: float = 120.0,
+                 min_nodes: int = 1, resume_wait_s: float = 60.0):
+        self.cluster = cluster
+        self.server = cluster.server
+        self.poll_interval = poll_interval
+        self.max_regroups = max_regroups
+        self.regroup_timeout = regroup_timeout
+        self.min_nodes = max(1, min_nodes)
+        self.resume_wait_s = resume_wait_s
+        self.generation = int(getattr(self.server, "generation", 0))
+        self.state = "watching"
+        self.last_error: str | None = None
+        #: cumulative node names declared lost across all regroups
+        self.lost_nodes: list[str] = []
+        #: cumulative executor ids of lost nodes (feed tasks on these
+        #: executors discard their partitions post-regroup)
+        self.lost_executor_ids: list[int] = []
+        #: one record per completed regroup (gen, lost, nodes,
+        #: barrier_seconds, recovery_seconds once measured)
+        self.regroups: list[dict[str, Any]] = []
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        cluster._elastic = self  # health()/healthz surface our state
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ElasticSupervisor":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._watch, name="tfos-elastic-supervisor",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def status(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "state": self.state,
+                "generation": self.generation,
+                "lost_nodes": list(self.lost_nodes),
+                "regroups": len(self.regroups),
+                "max_regroups": self.max_regroups,
+                "last_error": self.last_error,
+            }
+
+    # -- detection ---------------------------------------------------------
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            with self._lock:
+                if self.state != "watching":
+                    continue
+            try:
+                report = self.cluster.check_anomalies()
+            except Exception as e:  # detection must not kill the driver
+                logger.debug("supervisor anomaly poll failed: %s", e)
+                continue
+            died = [f["node"] for f in (report.get("died") or [])]
+            lost = [n for n in died if n not in self.lost_nodes]
+            if not lost:
+                continue
+            try:
+                self.regroup(lost)
+            except Exception as e:
+                logger.error("elastic regroup failed: %s", e)
+
+    # -- the generation bump -----------------------------------------------
+
+    def regroup(self, lost_nodes: list[str],
+                reason: str = "node_died") -> dict[str, Any] | None:
+        """Initiate (and drive to completion) a generation bump over the
+        survivors of ``lost_nodes``.  Thread-safe and idempotent for
+        already-known losses; returns the regroup record, or None when
+        every named node was already regrouped away."""
+        with self._lock:
+            lost_new = [n for n in lost_nodes if n not in self.lost_nodes]
+            if not lost_new:
+                return None
+            if self.state == "dead":
+                raise RuntimeError(
+                    f"supervisor is dead ({self.last_error}); "
+                    "cannot regroup")
+            if self.state == "regrouping":
+                raise RuntimeError("a regroup is already in flight")
+            if len(self.regroups) >= self.max_regroups:
+                self.state = "dead"
+                self.last_error = (
+                    f"regroup budget exhausted "
+                    f"({self.max_regroups} regroups)")
+                raise RuntimeError(self.last_error)
+            all_lost = sorted(set(self.lost_nodes) | set(lost_new))
+            survivors_meta = [
+                m for m in self.cluster.cluster_info
+                if f"{m['job_name']}:{m['task_index']}" not in all_lost]
+            if len(survivors_meta) < self.min_nodes:
+                self.state = "dead"
+                self.last_error = (
+                    f"only {len(survivors_meta)} survivors — fewer than "
+                    f"min_nodes={self.min_nodes}")
+                raise RuntimeError(self.last_error)
+            lost_ids = sorted(
+                set(self.lost_executor_ids)
+                | {m["executor_id"] for m in self.cluster.cluster_info
+                   if f"{m['job_name']}:{m['task_index']}" in lost_new})
+            self.state = "regrouping"
+            gen = self.generation + 1
+        t_detect = time.time()
+        survivor_names = sorted(f"{m['job_name']}:{m['task_index']}"
+                                for m in survivors_meta)
+        coordinator = min(
+            survivors_meta, key=lambda m: m["executor_id"])
+        coordinator = f"{coordinator['job_name']}:{coordinator['task_index']}"
+        logger.warning(
+            "elastic regroup → generation %d: lost %s, %d survivors (%s)",
+            gen, lost_new, len(survivor_names), ", ".join(survivor_names))
+        try:
+            with obs.span("elastic.regroup", gen=gen,
+                          lost=",".join(lost_new),
+                          survivors=len(survivor_names)):
+                self.server.begin_generation(gen, len(survivors_meta))
+                self.server.kv_put(REGROUP_KEY, {
+                    "gen": gen, "reason": reason, "lost": all_lost,
+                    "survivors": survivor_names,
+                    "coordinator": coordinator, "ts": t_detect})
+                info = self.server.await_generation(
+                    gen, timeout=self.regroup_timeout)
+        except Exception as e:
+            with self._lock:
+                self.state = "dead"
+                self.last_error = f"regroup to generation {gen} failed: {e}"
+            obs.event("elastic.regroup_failed", gen=gen,
+                      error=str(e)[:200])
+            raise
+        barrier_s = time.time() - t_detect
+        record = {
+            "gen": gen, "reason": reason, "lost": lost_new,
+            "nodes": sorted(f"{m['job_name']}:{m['task_index']}"
+                            for m in info),
+            "barrier_seconds": round(barrier_s, 3),
+            "recovery_seconds": None, "ts": t_detect,
+        }
+        with self._lock:
+            self.generation = gen
+            self.lost_nodes = all_lost
+            self.lost_executor_ids = lost_ids
+            self.regroups.append(record)
+            # rewire the data plane: metrics/health/feed closures built
+            # from cluster_info now address only the new membership, and
+            # feed tasks landing on a lost executor discard their
+            # partitions instead of failing the job
+            self.cluster.cluster_info = info
+            self.cluster.cluster_meta["lost_executors"] = lost_ids
+            self.state = "watching"
+        obs.counter("elastic_regroups_total").inc()
+        obs.counter("elastic_lost_nodes_total").inc(len(lost_new))
+        obs.event("elastic.regrouped", gen=gen, lost=",".join(lost_new),
+                  barrier_seconds=round(barrier_s, 3))
+        # recovery_seconds completes asynchronously: survivors stamp their
+        # first post-restore step on the kv; blocking the regroup (and the
+        # feed replay behind it) on that stamp would *inflate* the very
+        # number it measures
+        threading.Thread(
+            target=self._await_resumed,
+            args=(gen, record, t_detect), daemon=True,
+            name=f"tfos-elastic-resumed-g{gen}").start()
+        return record
+
+    def _await_resumed(self, gen: int, record: dict[str, Any],
+                       t_detect: float) -> None:
+        nodes = list(record["nodes"])
+        deadline = time.monotonic() + self.resume_wait_s
+        #: DRIVER-clock time each survivor's stamp was first observed —
+        #: the workers' own ``ts`` values come from OTHER hosts' clocks,
+        #: and NTP skew of a few seconds would corrupt (or, negative,
+        #: silently discard) a ~5 s recovery measurement.  The driver-side
+        #: observation overstates by at most one poll interval.
+        seen: dict[str, float] = {}
+        while time.monotonic() < deadline and len(seen) < len(nodes):
+            for n in nodes:
+                if n in seen:
+                    continue
+                v = self.server.kv_get(f"{RESUMED_KEY}:{gen}:{n}")
+                if isinstance(v, dict):
+                    seen[n] = time.time()
+            if len(seen) < len(nodes):
+                time.sleep(0.25)
+        if not seen:
+            logger.warning(
+                "no survivor stamped a post-restore step within %ss; "
+                "recovery_seconds unmeasured for generation %d",
+                self.resume_wait_s, gen)
+            return
+        # recovery = detection → the LAST survivor's first post-restore
+        # step observed (the mesh is only fully back once everyone steps)
+        recovery = max(seen.values()) - t_detect
+        if recovery <= 0:
+            return
+        record["recovery_seconds"] = round(recovery, 3)
+        obs.histogram("recovery_seconds").observe(recovery)
+        logger.info(
+            "generation %d recovered in %.1fs (%d/%d nodes stamped)",
+            gen, recovery, len(seen), len(nodes))
+
+    # -- feed replay -------------------------------------------------------
+
+    def train(self, dataRDD, num_epochs: int = 1,
+              feed_timeout: float = 600.0, qname: str = "input",
+              metrics_interval: float = 30.0,
+              max_replays: int | None = None,
+              detect_timeout: float = 60.0) -> None:
+        """``cluster.train`` with regroup-and-replay.
+
+        The epoch is the replay unit: an epoch whose feed was aborted by a
+        confirmed executor loss is re-fed in full to the survivors — the
+        bounded replay window (survivors restored at the last checkpoint
+        retrain at most one epoch plus the checkpoint cadence; duplicate
+        samples are ordinary resampling for SGD).  ``max_replays`` bounds
+        total replays across the run (default: ``max_regroups``).  A
+        failure NOT attributable to a lost node re-raises untouched.
+        """
+        if max_replays is None:
+            max_replays = self.max_regroups
+        replays = 0
+        epoch = 0
+        while epoch < num_epochs:
+            regroups_before = len(self.regroups)
+            try:
+                self.cluster.train(
+                    dataRDD, num_epochs=1, feed_timeout=feed_timeout,
+                    qname=qname, metrics_interval=metrics_interval)
+            except Exception:
+                if replays >= max_replays or not self._recovered(
+                        regroups_before, detect_timeout):
+                    raise
+                replays += 1
+                logger.warning(
+                    "epoch %d/%d aborted by executor loss; replaying it "
+                    "to %d survivors (replay %d/%d)", epoch + 1,
+                    num_epochs, len(self.cluster.cluster_info), replays,
+                    max_replays)
+                continue  # replay: epoch counter does not advance
+            epoch += 1
+
+    def _recovered(self, regroups_before: int,
+                   detect_timeout: float) -> bool:
+        """After a feed failure: is (or was) this an executor loss the
+        supervisor has regrouped past?  Blocks while detection/regroup is
+        in flight (manager orphan-grace + anomaly poll latency), actively
+        probing for newly-dead nodes each tick."""
+        deadline = time.monotonic() + detect_timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                state = self.state
+                recovered = len(self.regroups) > regroups_before
+            if state == "dead":
+                return False
+            if recovered and state == "watching":
+                return True
+            if state == "watching":
+                # monitor may not have sampled since the failure: probe now
+                try:
+                    report = self.cluster.check_anomalies()
+                    died = [f["node"] for f in (report.get("died") or [])
+                            if f["node"] not in self.lost_nodes]
+                    if died:
+                        self.regroup(died)
+                        continue
+                except Exception as e:
+                    logger.debug("loss confirmation probe failed: %s", e)
+            time.sleep(0.5)
+        return False
+
+
+def probe_loss(trainer, batch) -> float:
+    """Loss of ``trainer``'s current params on a fixed probe batch — the
+    loss-continuity measure the elastic e2e tests assert across a
+    regroup+restore (restored params must score the same as they did when
+    checkpointed)."""
+    import numpy as np
+
+    params = trainer.state.params
+    if getattr(trainer.loss_fn, "stateful", False):
+        val = trainer.loss_fn(params, trainer.state.collections, batch)
+        val = val[0] if isinstance(val, tuple) else val
+    else:
+        val = trainer.loss_fn(params, batch)
+    return float(np.asarray(val))

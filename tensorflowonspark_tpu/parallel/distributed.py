@@ -30,7 +30,10 @@ _initialized = False
 
 
 def coordinator_address(cluster_info) -> str:
-    node0 = next(m for m in cluster_info if m["executor_id"] == 0)
+    # the LOWEST surviving executor id, not literally 0: after an elastic
+    # regroup executor 0 may be among the lost (elastic.py picks the same
+    # node as the new generation's coordinator)
+    node0 = min(cluster_info, key=lambda m: m["executor_id"])
     return f"{node0['host']}:{node0['port']}"
 
 
@@ -78,15 +81,43 @@ def maybe_initialize(ctx) -> bool:
 
     addr = coordinator_address(ctx.cluster_info)
     timeout_s = int(os.environ.get("TFOS_JAX_DISTRIBUTED_TIMEOUT", "300"))
+    # process ids must be contiguous 0..n-1: after an elastic regroup the
+    # surviving executor ids have holes (e.g. 0 and 2 of an original 3),
+    # so each node's process id is its POSITION among the membership's
+    # sorted executor ids (identical to executor_id for a fresh cluster)
+    ids = sorted(m["executor_id"] for m in ctx.cluster_info)
+    process_id = ids.index(ctx.executor_id)
     logger.info(
         "jax.distributed.initialize(coordinator=%s, num_processes=%d, "
-        "process_id=%d)", addr, num_nodes, ctx.executor_id,
+        "process_id=%d)", addr, num_nodes, process_id,
     )
     jax.distributed.initialize(
         coordinator_address=addr,
         num_processes=num_nodes,
-        process_id=ctx.executor_id,
+        process_id=process_id,
         initialization_timeout=timeout_s,
     )
     _initialized = True
+    return True
+
+
+def maybe_shutdown() -> bool:
+    """Tear down the distributed runtime if this process initialised it.
+
+    The elastic rejoin path (``elastic.ElasticWorker.rejoin``) calls this
+    before re-entering the rendezvous: a runtime still pinned to dead
+    peers would wedge the first collective of the new generation.  No-op
+    (returns False) when the runtime was never formed — the CPU test
+    substrate and single-node clusters.
+    """
+    global _initialized
+    if not _initialized:
+        return False
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception as e:  # best-effort: the old world may be half-dead
+        logger.warning("jax.distributed.shutdown failed: %s", e)
+    _initialized = False
     return True

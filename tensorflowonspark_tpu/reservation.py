@@ -22,12 +22,28 @@ Deliberate departures from the reference design:
   any node or the driver can read it without knowing which executor wrote it.
 - **An auth token** (random, carried in ``cluster_meta``) must accompany every
   message; the reference's server trusts any connection.
+- **Rendezvous generations** (elastic membership, ISSUE 8): the server
+  carries a monotonically increasing ``generation``.  The initial bootstrap
+  barrier is generation 0; every regroup after an executor loss opens the
+  next one (:meth:`Server.begin_generation`, driven by
+  :class:`tensorflowonspark_tpu.elastic.ElasticSupervisor`).  Messages MAY
+  stamp a ``gen`` field — a stamped message older than the server's current
+  generation is rejected (:class:`StaleGenerationError` client-side), so a
+  zombie executor of generation N cannot corrupt the kv or the barriers of
+  generation N+1.  A registration stamped with a FUTURE generation is
+  parked and absorbed when that generation opens — a late or replacement
+  executor lands in the *next* regroup instead of being refused.
+  Unstamped messages are never fenced (pre-elastic compatibility: error
+  attributions and the TensorBoard URL must flow regardless of membership
+  churn).
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import os
+import random
 import secrets
 import socket
 import struct
@@ -39,6 +55,19 @@ logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct(">I")
 _MAX_MSG = 64 * 1024 * 1024
+
+#: transient socket-level failures worth retrying: the server socket being
+#: torn down/rebuilt (driver restart, a regroup racing the listener) shows
+#: up as refused/reset/aborted connections for a bounded window
+_RETRYABLE_ERRORS = (ConnectionRefusedError, ConnectionResetError,
+                     ConnectionAbortedError, BrokenPipeError, TimeoutError)
+
+
+class StaleGenerationError(RuntimeError):
+    """The server rejected a message stamped with a past generation — the
+    caller is a zombie of a membership epoch that has been regrouped away.
+    Deliberately NOT retried by the client: backing off cannot make a
+    stale generation current again."""
 
 
 class MessageSocket:
@@ -161,6 +190,75 @@ class Server:
         self._stop = threading.Event()
         self._listener: socket.socket | None = None
         self.address: tuple[str, int] | None = None
+        #: current membership generation: 0 = the bootstrap barrier; each
+        #: elastic regroup opens the next (see module docstring)
+        self.generation = 0
+        self._gen_lock = threading.Condition()
+        #: per-regroup-generation barriers (gen ≥ 1); gen 0 is
+        #: :attr:`reservations`
+        self._regroups: dict[int, Reservations] = {}
+        #: registrations stamped with a future generation, parked until
+        #: that generation opens (late/replacement executors)
+        self._parked: list[dict[str, Any]] = []
+
+    # -- generations (elastic membership) ----------------------------------
+
+    def begin_generation(self, gen: int, count: int) -> Reservations:
+        """Open regroup generation ``gen`` expecting ``count`` NEW
+        registrations (the survivors).
+
+        Driver in-process API (the elastic supervisor calls this before
+        broadcasting the regroup command).  From this moment every stamped
+        message of an earlier generation is rejected.  Registrations
+        parked for a future generation (late/replacement executors) are
+        absorbed into this one IN ADDITION to ``count`` — they must not
+        consume survivor slots, or the barrier would release before every
+        survivor rejoined (the supervisor sizes ``count`` to the
+        survivors it commanded to regroup).
+        """
+        with self._gen_lock:
+            if gen <= self.generation:
+                raise ValueError(
+                    f"generation {gen} is not past the current "
+                    f"generation {self.generation}")
+            parked, self._parked = self._parked, []
+            res = Reservations(count + len(parked))
+            self._regroups[gen] = res
+            self.generation = gen
+            self._gen_lock.notify_all()
+        for meta in parked:
+            logger.info(
+                "absorbing parked registration of executor %s into "
+                "generation %d", meta.get("executor_id"), gen)
+            res.add(meta)
+        return res
+
+    def await_generation(self, gen: int,
+                         timeout: float | None = None) -> list[dict[str, Any]]:
+        """Block until generation ``gen``'s regroup barrier completes;
+        returns the new membership's cluster info (driver in-process)."""
+        res = self._reservations_for(gen)
+        if not res.wait(timeout):
+            raise TimeoutError(
+                f"timed out waiting for {res.remaining()} of "
+                f"{res.required} nodes to rejoin generation {gen}")
+        return res.get()
+
+    def _reservations_for(self, gen: int) -> Reservations:
+        if gen == 0:
+            return self.reservations
+        with self._gen_lock:
+            res = self._regroups.get(gen)
+        if res is None:
+            raise KeyError(f"generation {gen} was never opened")
+        return res
+
+    def kv_put(self, key: str, value: Any) -> None:
+        """In-process write to the kv blackboard (driver side — the
+        supervisor's regroup broadcast goes through here)."""
+        with self._kv_lock:
+            self._kv[key] = value
+            self._kv_lock.notify_all()
 
     def start(self) -> tuple[str, int]:
         """Bind, spawn the accept loop thread, return ``(host, port)``."""
@@ -234,7 +332,18 @@ class Server:
                 if msg.get("auth") != self.auth_token:
                     ms.send({"ok": False, "error": "bad auth token"})
                     break
-                ms.send(self._handle(msg))
+                try:
+                    reply = self._handle(msg)
+                except Exception as e:
+                    # an unexpected handler failure must become an error
+                    # REPLY, not a dead serve thread — a thread that dies
+                    # between recv and send leaves the client blocked in
+                    # its socket read forever
+                    logger.warning("reservation handler failed on %s: %s",
+                                   msg.get("type"), e)
+                    reply = {"ok": False,
+                             "error": f"handler failed: {e!r}"[:200]}
+                ms.send(reply)
                 if msg.get("type") == "STOP":
                     break
         except (OSError, ValueError) as e:
@@ -244,8 +353,46 @@ class Server:
 
     def _handle(self, msg: dict[str, Any]) -> dict[str, Any]:
         mtype = msg.get("type")
+        gen = msg.get("gen")
+        if gen is not None:
+            gen = int(gen)
+            with self._gen_lock:
+                current = self.generation
+            if gen < current:
+                # generation fencing: a zombie of a regrouped-away epoch
+                # must fail loudly, not corrupt the current epoch's state
+                return {"ok": False, "stale_generation": True,
+                        "current_gen": current,
+                        "error": f"stale generation {gen} "
+                                 f"(current {current})"}
         if mtype == "REG":
-            self.reservations.add(msg["meta"])
+            if gen is not None and gen > self.generation:
+                # a future-generation registration: a late or replacement
+                # executor asking into the NEXT regroup — park it; it is
+                # absorbed when the supervisor opens that generation.
+                # Latest-wins dedup by executor_id, mirroring
+                # Reservations.add: a client-retried REG (reply lost to a
+                # transient reset) must not park twice — each parked entry
+                # inflates the regroup barrier's required count, and a
+                # phantom member would make the barrier unmeetable.
+                with self._gen_lock:
+                    if gen > self.generation:
+                        eid = msg["meta"].get("executor_id")
+                        if eid is not None:
+                            self._parked = [
+                                m for m in self._parked
+                                if m.get("executor_id") != eid]
+                        self._parked.append(msg["meta"])
+                        logger.info(
+                            "parked registration of executor %s for future "
+                            "generation %d (current %d)",
+                            msg["meta"].get("executor_id"), gen,
+                            self.generation)
+                        return {"ok": True, "parked": True,
+                                "current_gen": self.generation}
+            target = (self.reservations if gen is None
+                      else self._reservations_for(gen))
+            target.add(msg["meta"])
             return {"ok": True}
         if mtype == "QUERY":
             return {"ok": True, "done": self.reservations.done()}
@@ -260,16 +407,31 @@ class Server:
             # Server-side blocking wait on the registration barrier — one
             # connection per node instead of the reference's poll loop
             # (``reservation.py::Client.await_reservations`` polls QINFO).
-            done = self.reservations.wait(timeout=msg.get("timeout", 30.0))
+            timeout = msg.get("timeout", 30.0)
+            if gen is not None and gen > 0:
+                deadline = time.monotonic() + timeout
+                with self._gen_lock:
+                    # a barrier wait may arrive before the supervisor
+                    # opens the generation — block until it exists
+                    while gen > self.generation:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            return {"ok": True, "done": False,
+                                    "cluster": None}
+                        self._gen_lock.wait(remaining)
+                res = self._reservations_for(gen)
+                done = res.wait(timeout=max(0.0,
+                                            deadline - time.monotonic()))
+                return {"ok": True, "done": done,
+                        "cluster": res.get() if done else None}
+            done = self.reservations.wait(timeout=timeout)
             return {
                 "ok": True,
                 "done": done,
                 "cluster": self.reservations.get() if done else None,
             }
         if mtype == "PUT":
-            with self._kv_lock:
-                self._kv[msg["key"]] = msg["value"]
-                self._kv_lock.notify_all()
+            self.kv_put(msg["key"], msg["value"])
             return {"ok": True}
         if mtype == "GET":
             with self._kv_lock:
@@ -306,12 +468,61 @@ class Client:
     trainer process inherits it).
     """
 
-    def __init__(self, server_addr: tuple[str, int] | list, auth_token: str):
+    #: bounded retry budget for transient socket errors (see :meth:`_call`);
+    #: override per client or via ``TFOS_RESERVATION_RETRIES``
+    DEFAULT_RETRIES = 4
+    #: first backoff sleep; doubles per attempt, jittered ±50%, capped
+    BACKOFF_BASE_S = 0.2
+    BACKOFF_CAP_S = 5.0
+
+    def __init__(self, server_addr: tuple[str, int] | list, auth_token: str,
+                 generation: int | None = None, retries: int | None = None):
         self.server_addr = (server_addr[0], int(server_addr[1]))
         self.auth_token = auth_token
+        #: when set, every message is stamped with this generation and the
+        #: server fences it (elastic membership; see module docstring)
+        self.generation = generation
+        if retries is None:
+            retries = int(os.environ.get("TFOS_RESERVATION_RETRIES",
+                                         str(self.DEFAULT_RETRIES)))
+        self.retries = max(0, retries)
 
-    def _call(self, msg: dict[str, Any], timeout: float = 30.0) -> dict[str, Any]:
+    def _call(self, msg: dict[str, Any], timeout: float = 30.0,
+              retries: int | None = None) -> dict[str, Any]:
+        """One request/reply, with bounded retry on *transient socket*
+        errors (connection refused/reset/aborted, timeouts — the signatures
+        of a driver restart or a listener mid-regroup), exponential backoff
+        with jitter between attempts, each retry logged so flake rates
+        stay visible.  Server-level error replies are never retried: a
+        semantic rejection (bad auth, stale generation) cannot heal by
+        waiting."""
+        if self.generation is not None and "gen" not in msg:
+            msg = dict(msg, gen=self.generation)
         msg = dict(msg, auth=self.auth_token)
+        if retries is None:
+            retries = self.retries
+        last_exc: Exception | None = None
+        for attempt in range(retries + 1):
+            if attempt:
+                delay = min(self.BACKOFF_CAP_S,
+                            self.BACKOFF_BASE_S * (2 ** (attempt - 1)))
+                delay *= 0.5 + random.random()  # ±50% jitter: no stampedes
+                logger.warning(
+                    "reservation %s to %s failed (%s); retry %d/%d in "
+                    "%.2fs", msg.get("type"), self.server_addr, last_exc,
+                    attempt, retries, delay)
+                time.sleep(delay)
+            try:
+                return self._call_once(msg, timeout)
+            except _RETRYABLE_ERRORS as e:
+                last_exc = e
+            except ConnectionError as e:
+                # server closed mid-exchange (listener torn down under us)
+                last_exc = e
+        assert last_exc is not None
+        raise last_exc
+
+    def _call_once(self, msg: dict[str, Any], timeout: float) -> dict[str, Any]:
         sock = socket.create_connection(self.server_addr, timeout=timeout)
         ms = MessageSocket(sock)
         try:
@@ -322,6 +533,10 @@ class Client:
         if reply is None:
             raise ConnectionError("reservation server closed connection")
         if not reply.get("ok", False):
+            if reply.get("stale_generation"):
+                raise StaleGenerationError(
+                    f"reservation server rejected generation "
+                    f"{msg.get('gen')}: {reply.get('error')}")
             raise RuntimeError(f"reservation server error: {reply.get('error')}")
         return reply
 
@@ -368,6 +583,8 @@ class Client:
 
     def request_stop(self) -> None:
         try:
-            self._call({"type": "STOP"})
+            # no retries: a refused connection means the server is already
+            # gone, which is the goal — backing off would only slow teardown
+            self._call({"type": "STOP"}, retries=0)
         except (ConnectionError, OSError):
             pass  # server already gone — that's what we wanted

@@ -200,6 +200,15 @@ class Trainer:
         # DataFeed's wait/ingest halves accumulated into — one bottleneck
         # verdict per training step
         self._flight = obs.flight.recorder("feed")
+        # periodic checkpointing (enable via checkpoint()) and elastic
+        # regroup cooperation (attach_elastic()) both ride _after_step
+        self._ckpt_mgr = None
+        self._ckpt_every = 0
+        #: step number of the most recent periodic checkpoint request
+        #: (async: the write may still be in flight; latest_step() reports
+        #: only committed ones)
+        self.last_checkpoint_step: int | None = None
+        self._elastic = None
         obs.get_tracer().record(
             "trainer.init", "X", _t0_wall * 1e6,
             (time.perf_counter() - _t0) * 1e6,
@@ -282,8 +291,17 @@ class Trainer:
         # close the feed-plane flight record (DataFeed wait/ingest + this
         # step's stage/compute) into one classified bottleneck verdict
         self._flight.commit()
+        self._maybe_checkpoint()
         for cb in self._step_callbacks:
             cb(loss, n, dt)
+        # elastic membership: the regroup flag is checked HERE, between
+        # steps, riding the same per-step plumbing as the watchdog and
+        # heartbeat — the step that just completed is fully accounted
+        # (checkpoint cadence included) before the loop is interrupted
+        if self._elastic is not None and self._elastic.regroup_pending():
+            from tensorflowonspark_tpu import elastic as elastic_lib
+
+            raise elastic_lib.RegroupSignal(self._elastic.command())
         return loss
 
     @staticmethod
@@ -356,15 +374,88 @@ class Trainer:
 
     # -- checkpointing -------------------------------------------------------
 
-    def save(self, path: str) -> None:
-        from tensorflowonspark_tpu import ckpt
-
+    def _state_tree(self) -> dict:
         tree = {"params": self.state.params,
                 "opt_state": self.state.opt_state,
                 "step": self.state.step}
         if self.state.collections:
             tree["collections"] = self.state.collections
-        ckpt.save_pytree(tree, path)
+        return tree
+
+    def save(self, path: str) -> None:
+        from tensorflowonspark_tpu import ckpt
+
+        ckpt.save_pytree(self._state_tree(), path)
+
+    def checkpoint(self, directory: str, every_steps: int | None = None,
+                   max_to_keep: int = 3, async_save: bool = True):
+        """Enable periodic step-numbered checkpoints (preemption tolerance).
+
+        Every ``every_steps`` completed steps (default: the
+        ``TFOS_CKPT_EVERY_STEPS`` env, 0 = manual-only), the full train
+        state is saved through a :class:`ckpt.CheckpointManager` — async
+        by default, so the write happens OFF the step path (the step pays
+        one device→host snapshot; orbax finalises in the background and
+        ``latest_step`` only ever names committed checkpoints, so a crash
+        mid-write costs nothing).  The cadence bounds lost work on
+        executor loss: the elastic regroup restores survivors from the
+        last committed step (:meth:`restore_latest`).  Returns the
+        manager (also used for manual ``save``/``restore``)."""
+        from tensorflowonspark_tpu import ckpt
+
+        if every_steps is None:
+            env = os.environ.get("TFOS_CKPT_EVERY_STEPS", "")
+            every_steps = int(env) if env else 0
+        self._ckpt_every = max(0, int(every_steps))
+        self._ckpt_mgr = ckpt.CheckpointManager(
+            directory, max_to_keep=max_to_keep, async_save=async_save)
+        return self._ckpt_mgr
+
+    def _maybe_checkpoint(self) -> None:
+        if self._ckpt_mgr is None or self._ckpt_every <= 0:
+            return
+        if self._steps_done % self._ckpt_every:
+            return
+        import numpy as np
+
+        # forcing state.step syncs the device — but only on the save
+        # cadence, where the save itself snapshots the same state anyway
+        step = int(np.asarray(self.state.step))
+        self._ckpt_mgr.save(step, self._state_tree())
+        self.last_checkpoint_step = step
+
+    def restore_latest(self) -> int | None:
+        """Restore the newest committed periodic checkpoint into this
+        trainer; returns its step, or None when there is none yet.
+
+        The restore targets THIS trainer's (possibly re-built, possibly
+        differently-meshed) state template, so the checkpoint is resharded
+        to the reader's topology — the elastic-regroup path rebuilds the
+        mesh over the survivors and restores straight into it."""
+        if self._ckpt_mgr is None:
+            raise RuntimeError("checkpoint() was never enabled")
+        hit = self._ckpt_mgr.restore_latest(target=self._state_tree())
+        if hit is None:
+            return None
+        step, restored = hit
+        self.state = TrainState(restored["params"], restored["opt_state"],
+                                restored["step"],
+                                restored.get("collections", {}))
+        return step
+
+    def finish_checkpoints(self) -> None:
+        """Barrier on in-flight async checkpoint writes (shutdown/rejoin:
+        the last snapshot must commit before this process lets go)."""
+        if self._ckpt_mgr is not None:
+            self._ckpt_mgr.wait_until_finished()
+
+    def attach_elastic(self, worker) -> None:
+        """Ride the step loop's between-steps plumbing with an elastic
+        regroup check: once ``worker.regroup_pending()``, the NEXT
+        completed step raises :class:`elastic.RegroupSignal` (after its
+        metrics, checkpoint cadence, and callbacks ran), so the training
+        loop can tear down and rejoin at a step boundary."""
+        self._elastic = worker
 
     def export(self, export_dir: str, *, self_describing: bool = True) -> str:
         """Write a serving export: weights + serialized forward + signature.

@@ -1,0 +1,130 @@
+"""Fault-injection helpers for robustness tests (ISSUE 8 satellite).
+
+Small, composable chaos primitives used by the elastic-regroup e2e test and
+reusable by future robustness tests:
+
+- :func:`kill_trainer` / :func:`kill_trainer_at_step` — SIGKILL a node's
+  spawned trainer process (the local-substrate analogue of losing a
+  preemptible executor: the trainer dies instantly, the manager's orphan
+  watch reaps the node's data plane moments later).
+- :class:`FlakyClient` — a ``reservation.Client`` whose first N calls (or
+  calls matching a predicate) fail with a transient socket error; drives
+  the bounded-retry/backoff path deterministically.
+- :class:`DroppingClient` — a kv wrapper that silently drops PUTs matching
+  a key pattern (up to a count): lost-message chaos for kv-dependent
+  protocols (e.g. a survivor whose resume stamp never arrives).
+- :func:`delay_heartbeat` — a ``Trainer`` step callback that sleeps,
+  simulating a straggling/stalling node for the anomaly detectors.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import signal
+import threading
+import time
+from typing import Any
+
+from tensorflowonspark_tpu import TFManager, reservation
+
+
+def _node_manager(cluster, node_meta):
+    authkey = bytes.fromhex(cluster.cluster_meta["authkey_hex"])
+    return TFManager.connect(tuple(node_meta["addr"]), authkey)
+
+
+def kill_trainer(cluster, node_meta) -> int:
+    """SIGKILL the spawned trainer process of ``node_meta``'s node
+    (same-host substrates only); returns the killed pid."""
+    pid = int(_node_manager(cluster, node_meta).get("trainer_pid"))
+    os.kill(pid, signal.SIGKILL)
+    return pid
+
+
+def kill_trainer_at_step(cluster, node_meta, at_step: int,
+                         timeout: float = 300.0,
+                         poll_interval: float = 0.5) -> dict[str, Any]:
+    """Background thread: wait until the node's published metrics reach
+    ``at_step``, then SIGKILL its trainer.  Returns a result dict that is
+    filled in when the kill fires: ``{"killed_ts", "pid", "step",
+    "error"}`` — join on ``result["event"]`` to synchronize."""
+    name = f"{node_meta['job_name']}:{node_meta['task_index']}"
+    result: dict[str, Any] = {"event": threading.Event(), "node": name}
+
+    def watch_and_kill() -> None:
+        deadline = time.monotonic() + timeout
+        try:
+            while time.monotonic() < deadline:
+                try:
+                    snap = _node_manager(cluster, node_meta).get("metrics")
+                except Exception:
+                    snap = None
+                if snap and snap.get("step", 0) >= at_step:
+                    result["step"] = snap["step"]
+                    result["pid"] = kill_trainer(cluster, node_meta)
+                    result["killed_ts"] = time.time()
+                    return
+                time.sleep(poll_interval)
+            result["error"] = (
+                f"node {name} never reached step {at_step} "
+                f"within {timeout}s")
+        except Exception as e:
+            result["error"] = repr(e)
+        finally:
+            result["event"].set()
+
+    t = threading.Thread(target=watch_and_kill, daemon=True,
+                         name=f"chaos-kill-{name}")
+    t.start()
+    result["thread"] = t
+    return result
+
+
+class FlakyClient(reservation.Client):
+    """A rendezvous client whose first ``fail_first`` calls raise a
+    transient connection error before the real call runs — deterministic
+    fuel for the bounded-retry/backoff path."""
+
+    def __init__(self, server_addr, auth_token, fail_first: int = 2,
+                 error: type[Exception] = ConnectionRefusedError, **kw):
+        super().__init__(server_addr, auth_token, **kw)
+        self.fail_first = fail_first
+        self.error = error
+        self.failures = 0
+
+    def _call_once(self, msg, timeout):
+        if self.failures < self.fail_first:
+            self.failures += 1
+            raise self.error(
+                f"chaos: simulated transient failure "
+                f"{self.failures}/{self.fail_first}")
+        return super()._call_once(msg, timeout)
+
+
+class DroppingClient(reservation.Client):
+    """A rendezvous client that silently drops PUTs whose key matches
+    ``pattern`` (up to ``drop`` of them) — lost-kv-message chaos."""
+
+    def __init__(self, server_addr, auth_token, pattern: str = ".*",
+                 drop: int = 1, **kw):
+        super().__init__(server_addr, auth_token, **kw)
+        self.pattern = re.compile(pattern)
+        self.drop = drop
+        self.dropped: list[str] = []
+
+    def put(self, key: str, value: Any) -> None:
+        if len(self.dropped) < self.drop and self.pattern.search(key):
+            self.dropped.append(key)
+            return
+        super().put(key, value)
+
+
+def delay_heartbeat(seconds: float):
+    """A ``Trainer`` step callback that sleeps ``seconds`` per step —
+    turns a healthy node into a straggler for the anomaly detectors."""
+
+    def cb(loss, examples, dt) -> None:
+        time.sleep(seconds)
+
+    return cb

@@ -580,3 +580,102 @@ def test_flight_breakdown_null_with_reason_is_exempt(tmp_path):
         [_write(tmp_path, "BENCH_r09.json", bare_null)])
     assert verdict["verdict"] == "fail"
     assert any("feed_stage_breakdown" in r for r in verdict["reasons"])
+
+
+# -- elastic recovery (ISSUE 8) ----------------------------------------------
+
+
+def _recovery_fields(seconds=14.0, **extra):
+    fields = {"recovery_seconds": seconds,
+              "recovery_num_executors": 3,
+              "recovery_ckpt_every_steps": 4,
+              "recovery_kill_at_step": 8,
+              "recovery_batch_size": 32}
+    fields.update(extra)
+    return fields
+
+
+def _r10(**extra):
+    """A round-10-complete primary half: all microbenches + recovery."""
+    half = _r9(**_recovery_fields())
+    half.update(extra)
+    return half
+
+
+def test_recovery_field_required_on_primary_from_round_10(tmp_path):
+    # round 9: grandfathered — no recovery number owed
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r09.json", _r9())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # round 10+: the primary must carry it (or explicit null + reason)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r10.json", _r9())])
+    assert verdict["verdict"] == "fail"
+    assert any("recovery_seconds" in r for r in verdict["reasons"])
+    # complete round 10 passes
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r10.json", _r10())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # explicit null + reason satisfies (e.g. wall budget exhausted)
+    half = _r9(recovery_seconds=None,
+               recovery_reason="wall budget exhausted before recovery "
+                               "microbench")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r10.json", half)])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # bare null does not
+    half = _r9(recovery_seconds=None)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r10.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("recovery_reason" in r for r in verdict["reasons"])
+
+
+def test_recovery_value_without_config_identity_fails(tmp_path):
+    half = _r9(recovery_seconds=14.0)  # number without its config
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r10.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("config identity" in r for r in verdict["reasons"])
+
+
+def test_recovery_regression_is_lower_is_better(tmp_path):
+    """recovery_seconds is a latency: a faster newest run passes, a
+    slower-beyond-1/threshold newest run fails."""
+    paths = [
+        _write(tmp_path, "BENCH_r10.json", _r10()),
+        _write(tmp_path, "BENCH_r11.json",
+               _r10(**_recovery_fields(seconds=12.0))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    paths = [
+        _write(tmp_path, "BENCH_r10.json", _r10()),
+        _write(tmp_path, "BENCH_r11.json",
+               _r10(**_recovery_fields(seconds=30.0))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("recovery slowed" in r for r in verdict["reasons"])
+
+
+def test_recovery_not_compared_across_configs(tmp_path):
+    """A different checkpoint cadence bounds a different amount of lost
+    work: 30s at cadence 16 must not regress against 14s at cadence 4."""
+    paths = [
+        _write(tmp_path, "BENCH_r10.json", _r10()),
+        _write(tmp_path, "BENCH_r11.json",
+               _r10(**_recovery_fields(seconds=30.0,
+                                       recovery_ckpt_every_steps=16))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+
+
+def test_recovery_judged_even_on_degraded_newest(tmp_path):
+    """Host-side like the feed/serving microbenches: a degraded
+    accelerator half still measured the real recovery path, so its
+    number stays gated."""
+    paths = [
+        _write(tmp_path, "BENCH_r10.json", _r10()),
+        _write(tmp_path, "BENCH_r11.json",
+               _r10(**_recovery_fields(seconds=40.0),
+                    degraded="accelerator unavailable: probe timeout")),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("recovery slowed" in r for r in verdict["reasons"])

@@ -1,0 +1,848 @@
+"""Elastic cluster membership (ISSUE 8): generation-fenced rendezvous,
+driver-side supervisor, worker-side rejoin, checkpoint-cadence recovery.
+
+Unit layer: real reservation Server/Client over localhost sockets, fake
+survivors on threads.  E2E (slow-marked): a 3-executor local-substrate
+SPARK train whose victim trainer is SIGKILLed mid-run — the supervisor
+regroups over the 2 survivors, they restore from the last async
+checkpoint, and training resumes to completion with loss continuity
+asserted.
+"""
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+import cloudpickle
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import chaos  # noqa: E402
+from tensorflowonspark_tpu import TFCluster, TFManager, elastic, reservation
+from tensorflowonspark_tpu.TFSparkNode import TFNodeContext
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+cloudpickle.register_pickle_by_value(chaos)
+
+
+# -- rendezvous generations --------------------------------------------------
+
+
+def _server(count=1):
+    server = reservation.Server(count=count)
+    addr = server.start()
+    return server, addr
+
+
+def test_generation_fencing_rejects_stale_messages():
+    """kv writes, registrations, and barrier waits stamped with a past
+    generation are rejected (StaleGenerationError); unstamped messages
+    keep flowing (pre-elastic compatibility: error attributions must
+    survive membership churn)."""
+    server, addr = _server()
+    server.begin_generation(1, count=1)
+
+    zombie = reservation.Client(addr, server.auth_token, generation=0)
+    with pytest.raises(reservation.StaleGenerationError):
+        zombie.put("elastic:resumed:0:worker:0", {"ts": 1})
+    with pytest.raises(reservation.StaleGenerationError):
+        zombie.register({"executor_id": 0})
+    with pytest.raises(reservation.StaleGenerationError):
+        zombie._call({"type": "WAIT", "timeout": 0.1})
+    with pytest.raises(reservation.StaleGenerationError):
+        zombie.get("anything", timeout=0.0)
+
+    # unstamped (legacy) messages are not fenced
+    legacy = reservation.Client(addr, server.auth_token)
+    legacy.put("node_error:worker:0", ["still flows"])
+    assert legacy.get("node_error:worker:0") == ["still flows"]
+    server.stop()
+
+
+def test_current_generation_messages_accepted():
+    server, addr = _server()
+    server.begin_generation(1, count=1)
+    c = reservation.Client(addr, server.auth_token, generation=1)
+    c.put("k", "v")
+    assert c.get("k") == "v"
+    c.register({"executor_id": 0})
+    assert server.await_generation(1, timeout=5.0)
+    server.stop()
+
+
+def test_future_registration_parked_and_absorbed():
+    """A replacement executor registering for a generation that has not
+    opened yet is parked — and absorbed into the regroup when the
+    supervisor opens it, IN ADDITION to the expected survivors (it must
+    not consume a survivor slot, or the barrier would release before
+    every survivor rejoined) — instead of being refused."""
+    server, addr = _server()
+    replacement = reservation.Client(addr, server.auth_token, generation=1)
+    reply = replacement._call(
+        {"type": "REG", "meta": {"executor_id": 7}})
+    assert reply.get("parked") is True
+
+    res = server.begin_generation(1, count=2)  # 2 survivors expected
+    # parked replacement absorbed ADDITIVELY: 3 total, 2 still owed
+    assert res.required == 3 and res.remaining() == 2
+    for eid in (0, 1):
+        reservation.Client(addr, server.auth_token,
+                           generation=1).register({"executor_id": eid})
+    info = server.await_generation(1, timeout=5.0)
+    assert sorted(m["executor_id"] for m in info) == [0, 1, 7]
+    server.stop()
+
+
+def test_parked_registration_retries_dedupe_by_executor_id():
+    """A client-retried REG (reply lost to a transient reset) must not
+    park twice: each parked entry adds to the regroup barrier's required
+    count, and a phantom member would make the barrier unmeetable."""
+    server, addr = _server()
+    c = reservation.Client(addr, server.auth_token, generation=1)
+    for _ in range(3):  # the same replacement, re-sent
+        c.register({"executor_id": 7})
+    res = server.begin_generation(1, count=1)
+    assert res.required == 2  # 1 survivor + ONE parked replacement
+    reservation.Client(addr, server.auth_token,
+                       generation=1).register({"executor_id": 0})
+    info = server.await_generation(1, timeout=5.0)
+    assert sorted(m["executor_id"] for m in info) == [0, 7]
+    server.stop()
+
+
+def test_begin_generation_must_move_forward():
+    server, addr = _server()
+    server.begin_generation(1, count=1)
+    with pytest.raises(ValueError):
+        server.begin_generation(1, count=1)
+    with pytest.raises(ValueError):
+        server.begin_generation(0, count=1)
+    server.stop()
+
+
+def test_wait_blocks_until_future_generation_opens():
+    """A barrier wait (here: a parked replacement's) may arrive before
+    the supervisor opens the generation: it blocks, and completes once
+    the generation forms AND its survivors register."""
+    server, addr = _server()
+    results = []
+
+    def waiter():
+        c = reservation.Client(addr, server.auth_token, generation=1)
+        c.register({"executor_id": 3})  # parked (gen 1 not open yet)
+        results.append(c.await_reservations(timeout=10.0))
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    server.begin_generation(1, count=1)  # + the parked replacement = 2
+    reservation.Client(addr, server.auth_token,
+                       generation=1).register({"executor_id": 0})
+    t.join(timeout=10.0)
+    assert results and sorted(
+        m["executor_id"] for m in results[0]) == [0, 3]
+    server.stop()
+
+
+# -- client retry (satellite) ------------------------------------------------
+
+
+def test_client_retries_transient_errors_with_backoff(caplog):
+    """Transient connection errors are retried (bounded, logged); the call
+    eventually succeeds without the caller seeing the flake."""
+    import logging
+
+    server, addr = _server()
+    flaky = chaos.FlakyClient(addr, server.auth_token, fail_first=2,
+                              retries=4)
+    with caplog.at_level(logging.WARNING,
+                         logger="tensorflowonspark_tpu.reservation"):
+        flaky.put("k", "v")
+    assert flaky.failures == 2
+    retry_logs = [r for r in caplog.records if "retry" in r.getMessage()]
+    assert len(retry_logs) == 2  # each retry visible
+    assert reservation.Client(addr, server.auth_token).get("k") == "v"
+    server.stop()
+
+
+def test_client_retry_budget_bounded():
+    server, addr = _server()
+    flaky = chaos.FlakyClient(addr, server.auth_token, fail_first=99,
+                              retries=2)
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionRefusedError):
+        flaky.put("k", "v")
+    assert flaky.failures == 3  # initial attempt + 2 retries
+    assert time.monotonic() - t0 < 10
+    server.stop()
+
+
+def test_client_does_not_retry_semantic_rejections():
+    """Server-level rejections (stale generation, bad auth) fail
+    immediately: backing off cannot make them succeed."""
+    server, addr = _server()
+    server.begin_generation(1, count=1)
+    zombie = reservation.Client(addr, server.auth_token, generation=0,
+                                retries=5)
+    t0 = time.monotonic()
+    with pytest.raises(reservation.StaleGenerationError):
+        zombie.put("k", 1)
+    assert time.monotonic() - t0 < 2  # no backoff sleeps happened
+    bad = reservation.Client(addr, "wrong-token", retries=5)
+    t0 = time.monotonic()
+    with pytest.raises((RuntimeError, ConnectionError)):
+        bad.put("k", 1)
+    assert time.monotonic() - t0 < 2
+    server.stop()
+
+
+# -- worker-side rejoin ------------------------------------------------------
+
+
+def _worker_ctx(addr, token, executor_id=0, task_index=0):
+    return TFNodeContext(
+        executor_id=executor_id, job_name="worker", task_index=task_index,
+        cluster_spec={}, default_fs="file://", working_dir=".",
+        mgr_addr=("127.0.0.1", 1), authkey=b"k", cluster_info=[],
+        cluster_id="c1", server_addr=addr, auth_token=token)
+
+
+def test_elastic_worker_sees_regroup_and_rejoins():
+    server, addr = _server()
+    ctx = _worker_ctx(addr, server.auth_token, executor_id=0)
+    worker = elastic.ElasticWorker(ctx, poll_interval=0.1)
+    assert not worker.regroup_pending()
+
+    server.begin_generation(1, count=2)
+    server.kv_put(elastic.REGROUP_KEY, {
+        "gen": 1, "lost": ["worker:2"],
+        "survivors": ["worker:0", "worker:1"],
+        "coordinator": "worker:0", "ts": time.time()})
+    deadline = time.monotonic() + 10
+    while not worker.regroup_pending() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert worker.regroup_pending()
+
+    # a peer survivor registers concurrently
+    def peer():
+        c = reservation.Client(addr, server.auth_token, generation=1)
+        c.register({"executor_id": 1, "job_name": "worker",
+                    "task_index": 1, "host": "h", "port": 1,
+                    "addr": ["127.0.0.1", 1]})
+
+    threading.Thread(target=peer, daemon=True).start()
+    result = worker.rejoin(timeout=10.0)
+    assert result["gen"] == 1 and len(result["cluster_info"]) == 2
+    assert worker.generation == 1 and not worker.regroup_pending()
+    # ctx rewired to the new membership
+    assert len(ctx.cluster_info) == 2
+    assert set(ctx.cluster_spec) == {"worker"}
+    # the new coordinator published its address under the new generation
+    coord = reservation.Client(addr, server.auth_token).get(
+        "jax_coordinator:gen1")
+    assert ":" in coord
+    worker.stop()
+    server.stop()
+
+
+def test_declared_lost_worker_refuses_rejoin():
+    """The zombie itself (stalled long enough to be regrouped away, then
+    woke up) must not rejoin — its generation is fenced off."""
+    server, addr = _server()
+    ctx = _worker_ctx(addr, server.auth_token, executor_id=2, task_index=2)
+    worker = elastic.ElasticWorker(ctx, poll_interval=0.1)
+    server.begin_generation(1, count=1)
+    server.kv_put(elastic.REGROUP_KEY, {
+        "gen": 1, "lost": ["worker:2"], "survivors": ["worker:0"],
+        "coordinator": "worker:0", "ts": time.time()})
+    deadline = time.monotonic() + 10
+    while not worker.regroup_pending() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    with pytest.raises(elastic.DeclaredLostError):
+        worker.rejoin(timeout=5.0)
+    worker.stop()
+    server.stop()
+
+
+# -- driver-side supervisor --------------------------------------------------
+
+
+class _FakeCluster:
+    """Just enough TFCluster for the supervisor: a real rendezvous server,
+    static cluster_info, scripted anomaly reports and train outcomes."""
+
+    def __init__(self, server, cluster_info):
+        self.server = server
+        self.cluster_info = cluster_info
+        self.cluster_meta = {"num_executors": len(cluster_info)}
+        self._elastic = None
+        self.died_script: list[list[str]] = []
+        #: per-train-call outcome: an exception instance to raise, or None
+        self.train_script: list[Exception | None] = []
+        self.train_calls = 0
+
+    def check_anomalies(self):
+        died = self.died_script.pop(0) if self.died_script else []
+        return {"died": [{"node": n, "last_state": "running"}
+                         for n in died]}
+
+    def train(self, dataRDD, num_epochs=1, feed_timeout=600.0,
+              qname="input", metrics_interval=30.0):
+        self.train_calls += 1
+        outcome = (self.train_script.pop(0) if self.train_script else None)
+        if outcome is not None:
+            raise outcome
+
+
+def _metas(n):
+    return [{"executor_id": i, "job_name": "worker", "task_index": i,
+             "host": "h", "port": 1000 + i, "addr": ["127.0.0.1", 1]}
+            for i in range(n)]
+
+
+def _run_survivor(addr, token, eid, stamp_resumed=True, client_cls=None):
+    """Thread simulating a survivor trainer: watch the kv for the regroup
+    command, rejoin the new generation, optionally stamp its first
+    post-restore step."""
+    client_cls = client_cls or reservation.Client
+
+    def run():
+        try:
+            watcher = reservation.Client(addr, token, retries=0)
+            cmd = None
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                try:
+                    cmd = watcher.get(elastic.REGROUP_KEY, timeout=0.5)
+                    break
+                except KeyError:
+                    continue
+            assert cmd, "regroup command never arrived"
+            gen = int(cmd["gen"])
+            c = client_cls(addr, token, generation=gen)
+            c.register({"executor_id": eid, "job_name": "worker",
+                        "task_index": eid, "host": "h", "port": 2000 + eid,
+                        "addr": ["127.0.0.1", 1]})
+            c.await_reservations(timeout=15.0)
+            if stamp_resumed:
+                c.put(f"{elastic.RESUMED_KEY}:{gen}:worker:{eid}",
+                      {"node": f"worker:{eid}", "gen": gen,
+                       "ts": time.time(), "step": 11})
+        except (ConnectionError, OSError):
+            pass  # test teardown stopped the server mid-flight
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def test_supervisor_regroups_and_measures_recovery():
+    from tensorflowonspark_tpu import obs
+
+    server, addr = _server(count=3)
+    cluster = _FakeCluster(server, _metas(3))
+    sup = elastic.ElasticSupervisor(cluster, poll_interval=0.2,
+                                    regroup_timeout=15.0,
+                                    resume_wait_s=10.0)
+    regroups_before = obs.counter("elastic_regroups_total").value
+    threads = [_run_survivor(addr, server.auth_token, eid)
+               for eid in (0, 1)]
+    record = sup.regroup(["worker:2"])
+    for t in threads:
+        t.join(timeout=15.0)
+
+    assert record["gen"] == 1
+    assert sup.generation == 1 and sup.state == "watching"
+    assert sup.lost_nodes == ["worker:2"]
+    assert cluster.cluster_meta["lost_executors"] == [2]
+    # the data plane was rewired to the survivors' fresh registrations
+    assert sorted(m["executor_id"] for m in cluster.cluster_info) == [0, 1]
+    assert obs.counter("elastic_regroups_total").value == regroups_before + 1
+    # recovery_seconds lands asynchronously once both survivors stamp
+    deadline = time.monotonic() + 10
+    while record["recovery_seconds"] is None and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert record["recovery_seconds"] is not None
+    assert obs.histogram("recovery_seconds").export()["count"] >= 1
+    # already-known losses are idempotent
+    assert sup.regroup(["worker:2"]) is None
+    sup.stop()
+    server.stop()
+
+
+def test_supervisor_monitor_thread_triggers_on_died_finding():
+    server, addr = _server(count=3)
+    cluster = _FakeCluster(server, _metas(3))
+    cluster.died_script = [[], ["worker:1"]]
+    sup = elastic.ElasticSupervisor(cluster, poll_interval=0.1,
+                                    regroup_timeout=15.0,
+                                    resume_wait_s=1.0).start()
+    threads = [_run_survivor(addr, server.auth_token, eid,
+                             stamp_resumed=False) for eid in (0, 2)]
+    deadline = time.monotonic() + 20
+    while sup.generation < 1 and time.monotonic() < deadline:
+        time.sleep(0.1)
+    for t in threads:
+        t.join(timeout=15.0)
+    assert sup.generation == 1
+    assert sup.lost_nodes == ["worker:1"]
+    sup.stop()
+    server.stop()
+
+
+def test_supervisor_dead_after_budget_or_barrier_timeout():
+    # barrier timeout (no survivors rejoin) → dead
+    server, addr = _server(count=2)
+    cluster = _FakeCluster(server, _metas(2))
+    sup = elastic.ElasticSupervisor(cluster, regroup_timeout=0.5,
+                                    resume_wait_s=0.5)
+    with pytest.raises(TimeoutError):
+        sup.regroup(["worker:1"])
+    assert sup.state == "dead" and "failed" in (sup.last_error or "")
+    with pytest.raises(RuntimeError):
+        sup.regroup(["worker:0"])
+    server.stop()
+
+    # regroup budget exhausted → dead
+    server2, addr2 = _server(count=3)
+    cluster2 = _FakeCluster(server2, _metas(3))
+    sup2 = elastic.ElasticSupervisor(cluster2, max_regroups=1,
+                                     regroup_timeout=10.0,
+                                     resume_wait_s=0.5)
+    _run_survivor(addr2, server2.auth_token, 0, stamp_resumed=False)
+    _run_survivor(addr2, server2.auth_token, 1, stamp_resumed=False)
+    assert sup2.regroup(["worker:2"])["gen"] == 1
+    with pytest.raises(RuntimeError, match="budget"):
+        sup2.regroup(["worker:1"])
+    assert sup2.state == "dead"
+    server2.stop()
+
+
+def test_supervisor_train_replays_aborted_epoch():
+    """The epoch is the replay unit: a feed failure attributable to a
+    confirmed executor loss replays that epoch to the survivors; the
+    epoch counter does not advance on a replay."""
+    server, addr = _server(count=3)
+    cluster = _FakeCluster(server, _metas(3))
+    # first train call fails (the loss); the regroup confirms it; every
+    # later call succeeds
+    cluster.train_script = [RuntimeError("feed failed: executor died")]
+    cluster.died_script = [["worker:2"]]
+    sup = elastic.ElasticSupervisor(cluster, regroup_timeout=15.0,
+                                    resume_wait_s=0.5)
+    for eid in (0, 1):
+        _run_survivor(addr, server.auth_token, eid, stamp_resumed=False)
+    sup.train(None, num_epochs=3, detect_timeout=20.0)
+    # 3 epochs + 1 replay of the aborted one
+    assert cluster.train_calls == 4
+    assert sup.generation == 1
+    server.stop()
+
+
+def test_supervisor_train_reraises_unattributable_failures():
+    """A failure with no confirmed executor loss behind it re-raises —
+    a deterministic map_fun bug must not loop through replays."""
+    server, addr = _server(count=2)
+    cluster = _FakeCluster(server, _metas(2))
+    cluster.train_script = [ValueError("map_fun bug")]
+    sup = elastic.ElasticSupervisor(cluster, regroup_timeout=5.0,
+                                    resume_wait_s=0.5)
+    with pytest.raises(ValueError, match="map_fun bug"):
+        sup.train(None, num_epochs=2, detect_timeout=2.0)
+    assert sup.generation == 0
+    server.stop()
+
+
+def test_supervisor_min_nodes_floor():
+    server, addr = _server(count=2)
+    cluster = _FakeCluster(server, _metas(2))
+    sup = elastic.ElasticSupervisor(cluster, min_nodes=2)
+    with pytest.raises(RuntimeError, match="min_nodes"):
+        sup.regroup(["worker:1"])
+    assert sup.state == "dead"
+    server.stop()
+
+
+def test_dropped_resume_stamp_leaves_recovery_unmeasured():
+    """Chaos: survivors whose resume stamps are dropped (lost kv messages)
+    leave recovery_seconds explicitly unmeasured — never a fabricated
+    number."""
+    server, addr = _server(count=2)
+    cluster = _FakeCluster(server, _metas(2))
+    sup = elastic.ElasticSupervisor(cluster, regroup_timeout=15.0,
+                                    resume_wait_s=1.0)
+
+    def dropping(*a, **kw):
+        return chaos.DroppingClient(*a, pattern=r"^elastic:resumed:",
+                                    drop=99, **kw)
+
+    t = _run_survivor(addr, server.auth_token, 0, stamp_resumed=True,
+                      client_cls=dropping)
+    record = sup.regroup(["worker:1"])
+    t.join(timeout=15.0)
+    time.sleep(1.5)  # past resume_wait_s
+    assert record["recovery_seconds"] is None
+    server.stop()
+
+
+# -- health / healthz surface ------------------------------------------------
+
+
+def test_health_surfaces_supervisor_state():
+    server, _ = _server()
+    cluster = TFCluster.TFCluster(
+        sc=None, cluster_meta={"authkey_hex": "00" * 16,
+                               "num_executors": 0},
+        cluster_info=[], server=server,
+        input_mode=TFCluster.InputMode.SPARK,
+        bootstrap_thread=threading.Thread(target=lambda: None))
+    sup = elastic.ElasticSupervisor(cluster)
+    doc = cluster.health()
+    assert doc["elastic"]["state"] == "watching"
+    assert doc["status"] == "ok"
+
+    sup.state = "regrouping"
+    assert cluster.health()["status"] == "recovering"
+
+    sup.state = "dead"
+    sup.last_error = "regroup budget exhausted"
+    doc = cluster.health()
+    assert doc["status"] == "degraded"
+    assert doc["elastic"]["last_error"] == "regroup budget exhausted"
+
+    # a lost node's unreachability must not keep the whole cluster
+    # degraded once the supervisor has regrouped past it
+    sup.state = "watching"
+    sup.lost_nodes = ["worker:1"]
+    cluster._last_node_state["worker:1"] = "running"
+    cluster.cluster_info = [{"job_name": "worker", "task_index": 1,
+                             "addr": ["127.0.0.1", 1]}]  # unreachable
+    doc = cluster.health()
+    assert doc["nodes"]["worker:1"] == "lost"
+    assert doc["status"] == "ok"
+    server.stop()
+
+
+# -- trainer cooperation -----------------------------------------------------
+
+
+def _tiny_trainer():
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    return Trainer("mnist_mlp", config=mnist.Config.tiny(),
+                   learning_rate=1e-2)
+
+
+def test_trainer_checkpoint_cadence_and_topology_restore(tmp_path):
+    """Periodic async checkpoints ride _after_step; restore_latest brings
+    a FRESH trainer (new mesh over this process's devices) to the saved
+    step with identical params — the loss-continuity property the e2e
+    asserts through a real kill."""
+    from tensorflowonspark_tpu.models import mnist
+
+    t = _tiny_trainer()
+    t.checkpoint(str(tmp_path / "ck"), every_steps=2)
+    batch = mnist.example_batch(t.config, batch_size=8)
+    for _ in range(5):
+        t.step(batch)
+    t.finish_checkpoints()
+    assert t.last_checkpoint_step == 4
+    assert t._ckpt_mgr.latest_step() == 4
+
+    t2 = _tiny_trainer()  # fresh mesh over this process's devices
+    t2.checkpoint(str(tmp_path / "ck"), every_steps=2)
+    assert t2.restore_latest() == 4
+    assert int(np.asarray(t2.state.step)) == 4
+    # restored state continues training (optimizer state restored too)
+    loss2 = float(np.asarray(t2.step(batch)))
+    assert np.isfinite(loss2)
+    assert int(np.asarray(t2.state.step)) == 5
+
+
+def test_trainer_restore_latest_roundtrips_probe_loss(tmp_path):
+    """Save → restore into a fresh trainer: probe loss identical (the
+    restored params ARE the checkpointed params)."""
+    from tensorflowonspark_tpu.models import mnist
+
+    t = _tiny_trainer()
+    t.checkpoint(str(tmp_path / "ck"), every_steps=3)
+    batch = mnist.example_batch(t.config, batch_size=8)
+    probe = mnist.example_batch(t.config, batch_size=16, seed=99)
+    for _ in range(3):
+        t.step(batch)
+    t.finish_checkpoints()
+    saved_loss = elastic.probe_loss(t, probe)  # params at step 3 == saved
+
+    t2 = _tiny_trainer()
+    t2.checkpoint(str(tmp_path / "ck"), every_steps=3)
+    assert t2.restore_latest() == 3
+    restored_loss = elastic.probe_loss(t2, probe)
+    np.testing.assert_allclose(restored_loss, saved_loss, rtol=1e-5)
+
+
+def test_trainer_ckpt_every_steps_env(tmp_path, monkeypatch):
+    from tensorflowonspark_tpu.models import mnist
+
+    monkeypatch.setenv("TFOS_CKPT_EVERY_STEPS", "2")
+    t = _tiny_trainer()
+    t.checkpoint(str(tmp_path / "ck"))
+    batch = mnist.example_batch(t.config, batch_size=8)
+    t.step(batch)
+    t.step(batch)
+    t.finish_checkpoints()
+    assert t._ckpt_mgr.latest_step() == 2
+
+
+def test_trainer_attach_elastic_raises_regroup_signal_between_steps():
+    from tensorflowonspark_tpu.models import mnist
+
+    class _FakeWorker:
+        def __init__(self):
+            self.pending = False
+
+        def regroup_pending(self):
+            return self.pending
+
+        def command(self):
+            return {"gen": 1, "lost": []}
+
+    t = _tiny_trainer()
+    worker = _FakeWorker()
+    t.attach_elastic(worker)
+    batch = mnist.example_batch(t.config, batch_size=8)
+    t.step(batch)  # no regroup pending: normal step
+    worker.pending = True
+    with pytest.raises(elastic.RegroupSignal) as ei:
+        t.step(batch)
+    assert ei.value.command["gen"] == 1
+    # the interrupted step still completed and was accounted
+    assert t._steps_done == 2
+
+
+def test_datafeed_interrupt_unblocks_starved_consumer():
+    from tensorflowonspark_tpu.TFNode import DataFeed, FeedInterrupted
+
+    mgr = TFManager.start(b"k", ["input", "output", "error"])
+    try:
+        feed = DataFeed(mgr, train_mode=True, input_mapping=["x"])
+        flag = {"v": False}
+        feed.interrupt = lambda: flag["v"]
+        feed._interrupt_poll_s = 0.05
+        flag["v"] = True
+        t0 = time.monotonic()
+        with pytest.raises(FeedInterrupted):
+            feed.next_batch(4)
+        assert time.monotonic() - t0 < 5
+        # data still flows afterwards once the condition clears
+        flag["v"] = False
+        mgr.get_queue("input").put([(1.0,), (2.0,)])
+        import tensorflowonspark_tpu.marker as marker
+
+        mgr.get_queue("input").put(marker.EndPartition())
+        batch = feed.next_batch(4)
+        assert batch["x"].shape[0] == 2
+    finally:
+        mgr.shutdown()
+
+
+def test_prefetched_datafeed_survives_interrupt():
+    """FeedInterrupted's contract — 'may keep consuming afterwards' —
+    must hold on the PREFETCHED path too: the interrupt kills the pump
+    thread, so the feed restarts it on the next call instead of blocking
+    forever on a dead pump's staging queue."""
+    import tensorflowonspark_tpu.marker as marker
+    from tensorflowonspark_tpu.TFNode import DataFeed, FeedInterrupted
+
+    mgr = TFManager.start(b"k", ["input", "output", "error"])
+    try:
+        feed = DataFeed(mgr, train_mode=True, input_mapping=["x"],
+                        prefetch=2)
+        flag = {"v": True}
+        feed.interrupt = lambda: flag["v"]
+        feed._interrupt_poll_s = 0.05
+        with pytest.raises(FeedInterrupted):
+            feed.next_batch(4)
+        flag["v"] = False
+        mgr.get_queue("input").put([(1.0,), (2.0,)])
+        mgr.get_queue("input").put(marker.EndPartition())
+        batch = feed.next_batch(4)  # pump restarted, data flows again
+        assert batch["x"].shape[0] == 2
+    finally:
+        mgr.shutdown()
+
+
+# -- e2e: SIGKILL one of three executors mid-train ---------------------------
+
+
+def _make_mnist_data(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(rng.random(64).astype(np.float32), int(i % 10))
+            for i in range(n)]
+
+
+def elastic_train_fun(args, ctx):
+    """Elastic map_fun: Trainer + periodic async checkpoints + regroup
+    cooperation.  Records loss-continuity evidence: the probe-batch loss
+    at every checkpoint (durable rendezvous kv) and right after restore
+    (own manager kv)."""
+    from tensorflowonspark_tpu import util
+
+    util.ensure_jax_platform()
+    import numpy as np
+
+    from tensorflowonspark_tpu import TFNode, elastic, reservation
+    from tensorflowonspark_tpu.metrics import MetricsReporter
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    node = f"{ctx.job_name}:{ctx.task_index}"
+    ckpt_dir = f"{args.model_dir}/{ctx.job_name}_{ctx.task_index}"
+    probe = mnist.example_batch(mnist.Config.tiny(), batch_size=16,
+                                seed=123)
+    client = reservation.Client(ctx.server_addr, ctx.auth_token)
+
+    def build():
+        t = Trainer("mnist_mlp", config=mnist.Config.tiny(),
+                    learning_rate=1e-2)
+        t.checkpoint(ckpt_dir, every_steps=args.ckpt_every)
+        t.add_step_callback(MetricsReporter(ctx, interval=1))
+        return t
+
+    trainer = build()
+    worker = elastic.ElasticWorker(ctx, poll_interval=0.5)
+    trainer.attach_elastic(worker)
+    feed = worker.attach(ctx.get_data_feed(
+        train_mode=True, input_mapping=["image", "label"]))
+
+    last_ck = None
+    need_resume_report = False
+    while not feed.should_stop():
+        try:
+            batch = feed.next_batch(args.batch_size)
+            if batch and batch["image"].shape[0] > 0:
+                loss = trainer.step(
+                    {"image": np.asarray(batch["image"], np.float32),
+                     "label": np.asarray(batch["label"], np.int32)})
+                if need_resume_report:
+                    worker.report_resumed(
+                        step=int(np.asarray(trainer.state.step)),
+                        loss=float(np.asarray(loss)))
+                    need_resume_report = False
+                if trainer.last_checkpoint_step != last_ck:
+                    last_ck = trainer.last_checkpoint_step
+                    client.put(f"elastic:ckpt_loss:{node}:{last_ck}",
+                               elastic.probe_loss(trainer, probe))
+        except (TFNode.FeedInterrupted, elastic.RegroupSignal):
+            pass
+        if worker.regroup_pending():
+            trainer.finish_checkpoints()
+            worker.rejoin(timeout=90.0)
+            trainer = build()
+            trainer.attach_elastic(worker)
+            restored_step = trainer.restore_latest()
+            ctx.mgr.set("restore_check", {
+                "step": restored_step,
+                "loss": elastic.probe_loss(trainer, probe)})
+            last_ck = restored_step
+            need_resume_report = True
+    trainer.finish_checkpoints()
+    ctx.mgr.set("final", {
+        "step": int(np.asarray(trainer.state.step)),
+        "loss": elastic.probe_loss(trainer, probe)})
+
+
+@pytest.mark.slow
+def test_executor_loss_regroups_and_resumes(tmp_path, monkeypatch):
+    """ISSUE 8 acceptance e2e: 3-executor SPARK train, one trainer
+    SIGKILLed mid-run → the supervisor regroups over the 2 survivors,
+    they restore from the last async checkpoint (loss continuity within
+    float tolerance of the pre-kill checkpoint), training resumes and
+    reaches the target step; /dev/shm, queues, and the supervisor state
+    are clean after shutdown."""
+    from tensorflowonspark_tpu import obs, shm
+    from tensorflowonspark_tpu.sparkapi import LocalSparkContext
+
+    # shrink the dead node's manager lingering so detection is fast
+    monkeypatch.setenv("TFOS_MANAGER_ORPHAN_GRACE_S", "3")
+    sc = LocalSparkContext("local-cluster[3,1,1024]", "elastic-e2e")
+    try:
+        args = argparse.Namespace(model_dir=str(tmp_path / "ckpt"),
+                                  ckpt_every=4, batch_size=32)
+        cluster = TFCluster.run(sc, elastic_train_fun, tf_args=args,
+                                num_executors=3,
+                                input_mode=TFCluster.InputMode.SPARK)
+        sup = elastic.ElasticSupervisor(
+            cluster, poll_interval=1.0, max_regroups=2,
+            regroup_timeout=120.0, resume_wait_s=90.0).start()
+        victim = max(cluster.cluster_info,
+                     key=lambda m: m["executor_id"])
+        victim_name = f"{victim['job_name']}:{victim['task_index']}"
+        kill = chaos.kill_trainer_at_step(cluster, victim, at_step=8,
+                                          timeout=300.0,
+                                          poll_interval=0.25)
+
+        data = _make_mnist_data(576)
+        sup.train(sc.parallelize(data, 3), num_epochs=16,
+                  feed_timeout=180.0, metrics_interval=1.0,
+                  detect_timeout=90.0)
+
+        kill["event"].wait(timeout=10.0)
+        assert "error" not in kill, kill
+        assert sup.generation == 1 and sup.state == "watching"
+        assert sup.lost_nodes == [victim_name]
+        assert len(cluster.cluster_info) == 2
+
+        # health while managers are still alive: recovered, lost node
+        # annotated, supervisor state surfaced
+        health = cluster.health()
+        assert health["status"] == "ok", health
+        assert health["elastic"]["generation"] == 1
+
+        # recovery_seconds measured (survivors stamped their first
+        # post-restore step)
+        record = sup.regroups[0]
+        deadline = time.monotonic() + 90
+        while record["recovery_seconds"] is None \
+                and time.monotonic() < deadline:
+            time.sleep(0.5)
+        assert record["recovery_seconds"] is not None
+        assert obs.histogram("recovery_seconds").export()["count"] >= 1
+        assert obs.counter("elastic_regroups_total").value >= 1
+        assert obs.counter("elastic_lost_nodes_total").value >= 1
+        # SIGKILL → first post-restore step, bounded sanity
+        stamps = cluster.server.kv_items(f"{elastic.RESUMED_KEY}:1:")
+        assert len(stamps) == 2, stamps
+        sigkill_to_resume = max(
+            v["ts"] for v in stamps.values()) - kill["killed_ts"]
+        assert 0 < sigkill_to_resume < 180, sigkill_to_resume
+
+        cluster.shutdown(grace_secs=90)
+        sup.stop()
+
+        # loss continuity + target step on every survivor
+        authkey = bytes.fromhex(cluster.cluster_meta["authkey_hex"])
+        for meta in cluster.cluster_info:
+            name = f"{meta['job_name']}:{meta['task_index']}"
+            mgr = TFManager.connect(tuple(meta["addr"]), authkey)
+            assert mgr.get("state") == "finished"
+            rc = mgr.get("restore_check")
+            assert rc and rc["step"], rc
+            recorded = cluster.server.kv_get(
+                f"elastic:ckpt_loss:{name}:{rc['step']}")
+            assert recorded is not None, (name, rc)
+            # restored params must score the same as they did when
+            # checkpointed — loss continuity across the regroup
+            np.testing.assert_allclose(rc["loss"], recorded, rtol=1e-4)
+            final = mgr.get("final")
+            assert final["step"] >= 30, final  # training reached target
+            assert np.isfinite(final["loss"])
+            assert mgr.get_queue("input").qsize() == 0  # queues drained
+        # /dev/shm clean after shutdown
+        count, nbytes = shm.resident_stats()
+        assert (count, nbytes) == (0, 0)
+    finally:
+        sc.stop()

@@ -285,3 +285,79 @@ def test_trainer_pid_start_rides_the_kv(mgr):
     assert mgr.get("trainer_pid") == os.getpid()
     assert mgr.get("trainer_pid_start") == TFManager.proc_start_time(
         os.getpid())
+
+
+def test_pid_alive_treats_zombie_as_dead():
+    """A SIGKILLed child lingers as a zombie (same pid, same start tick,
+    accepts signal 0) until reaped — it must still read as DEAD, or the
+    orphan watch and the elastic trainer-death detection never fire on a
+    preempted trainer whose executor parent survives."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    child = subprocess.Popen([sys.executable, "-c",
+                              "import time; time.sleep(600)"])
+    try:
+        start = TFManager.proc_start_time(child.pid)
+        assert TFManager._pid_alive(child.pid, start) is True
+        os.kill(child.pid, signal.SIGKILL)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            # deliberately NOT reaped: the kernel keeps the zombie entry
+            if TFManager._pid_alive(child.pid, start) is False:
+                break
+            time.sleep(0.05)
+        assert TFManager._pid_alive(child.pid, start) is False
+    finally:
+        child.kill()
+        child.wait()
+
+
+def test_manager_marks_node_lost_when_trainer_vanishes(mgr):
+    """ISSUE 8: a trainer that vanishes (SIGKILL/preemption) while its
+    node reads "running" is marked "lost" by the manager's watch thread,
+    with an attributed message on the error queue — the detection path
+    that works even where the executor (and so this manager) survives."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    child = subprocess.Popen([sys.executable, "-c",
+                              "import time; time.sleep(600)"])
+    try:
+        mgr.set("trainer_pid_start", TFManager.proc_start_time(child.pid))
+        mgr.set("trainer_pid", child.pid)
+        mgr.set("state", "running")
+        os.kill(child.pid, signal.SIGKILL)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if mgr.get("state") == "lost":
+                break
+            time.sleep(0.2)
+        assert mgr.get("state") == "lost"
+        msg = mgr.get_queue("error").get(timeout=5)
+        assert "vanished" in msg and str(child.pid) in msg
+    finally:
+        child.kill()
+        child.wait()
+
+
+def test_manager_does_not_mark_finished_node_lost(mgr):
+    """A trainer that reported "finished" before exiting is NOT a loss —
+    the lost marking only covers deaths no code path could report."""
+    import subprocess
+    import sys
+    import time
+
+    child = subprocess.Popen([sys.executable, "-c", "pass"])
+    child.wait()
+    mgr.set("trainer_pid_start", None)
+    mgr.set("trainer_pid", child.pid)
+    mgr.set("state", "finished")
+    time.sleep(4.5)  # two watch cycles
+    assert mgr.get("state") == "finished"

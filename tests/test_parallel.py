@@ -107,8 +107,13 @@ def test_train_step_matches_single_device():
         grads = jax.grad(loss_fn)(ref_params, batch)
         updates, ref_opt = opt.update(grads, ref_opt, ref_params)
         ref_params = optax.apply_updates(ref_params, updates)
+    # f32 with different reduction orders (sharded psum-mean vs single
+    # device): bare rtol=1e-5/atol=0 flakes on near-zero elements where a
+    # 1e-8 absolute difference reads as >1e-5 relative — tolerance must
+    # cover both regimes
     np.testing.assert_allclose(
-        np.asarray(state.params["w"]), np.asarray(ref_params["w"]), rtol=1e-5
+        np.asarray(state.params["w"]), np.asarray(ref_params["w"]),
+        rtol=5e-5, atol=1e-7
     )
 
 

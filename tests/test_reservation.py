@@ -112,10 +112,11 @@ def test_bad_auth_rejected():
 def test_request_stop():
     server = reservation.Server(count=1)
     addr = server.start()
-    c = reservation.Client(addr, server.auth_token)
+    c = reservation.Client(addr, server.auth_token, retries=0)
     c.request_stop()
     time.sleep(0.1)
-    # after stop, new connections fail
+    # after stop, new connections fail (retries=0: the refused connection
+    # must surface, not be retried away)
     with pytest.raises((ConnectionError, OSError, RuntimeError)):
         c.register({"executor_id": 0})
 
@@ -130,27 +131,33 @@ def test_server_survives_garbage_and_oversized_bytes():
     server = reservation.Server(count=1)
     addr = server.start()
 
+    # NOTE: timeouts here are deliberately generous (30 s): they bound a
+    # missing-guard HANG, not healthy latency — on a loaded single-core CI
+    # box the server's accept/serve threads can be scheduled seconds late,
+    # and a 5 s recv timeout flaked this test (the pre-existing tier-1
+    # reservation failure) while proving nothing extra
+
     # 1. pure garbage (not even a length prefix worth of structure)
-    s = socket.create_connection(addr, timeout=5)
+    s = socket.create_connection(addr, timeout=30)
     s.sendall(b"\xde\xad\xbe\xef" * 16)
     s.close()
 
     # 2. oversized length prefix (> _MAX_MSG): the server must actively
     #    refuse (close the connection), not sit in a 1 GiB recv — keep our
     #    end open so a missing guard shows up as a hang/timeout here
-    s = socket.create_connection(addr, timeout=5)
-    s.settimeout(5)
+    s = socket.create_connection(addr, timeout=30)
+    s.settimeout(30)
     s.sendall(struct.pack(">I", 1 << 30) + b"x" * 64)
     assert s.recv(1) == b""  # EOF: server dropped us
     s.close()
 
     # 3. valid length prefix, truncated body, abrupt close mid-message
-    s = socket.create_connection(addr, timeout=5)
+    s = socket.create_connection(addr, timeout=30)
     s.sendall(struct.pack(">I", 1024) + b"{")
     s.close()
 
     # 4. valid length, non-JSON body
-    s = socket.create_connection(addr, timeout=5)
+    s = socket.create_connection(addr, timeout=30)
     payload = b"\x00\x01\x02 not json"
     s.sendall(struct.pack(">I", len(payload)) + payload)
     s.close()

@@ -39,7 +39,13 @@ healthy feed/serving number must also ship its stage-time breakdown
 (``feed_stage_breakdown`` / ``serve_stage_breakdown``) with a bottleneck
 verdict, and the breakdown's additive stage sum must reconcile with the
 measured wall time within ``--flight-tolerance`` (default 0.15) — a
-decomposition that does not add up fails the artifact.
+decomposition that does not add up fails the artifact.  From round
+``--require-recovery-from`` (default 10, the round that introduced elastic
+membership) the primary half must carry ``recovery_seconds`` (SIGKILL →
+first post-restore step; explicit ``null`` + ``recovery_reason`` allowed);
+recovery is a latency, so a healthy number is regression-judged LOWER-is-
+better against the best (minimum) prior run with the same cluster /
+checkpoint-cadence / kill config.
 
 Usage::
 
@@ -76,6 +82,9 @@ DEFAULT_REQUIRE_SERVING_FROM = 8
 #: first round whose feed/serving numbers must each ship a flight-recorder
 #: stage breakdown that reconciles with measured wall time
 DEFAULT_REQUIRE_FLIGHT_FROM = 9
+#: first round whose primary half must carry the elastic recovery-time
+#: microbench (``recovery_seconds``, introduced with elastic membership)
+DEFAULT_REQUIRE_RECOVERY_FROM = 10
 #: |stage_sum / wall - 1| beyond this fails the artifact: a breakdown that
 #: does not add up is decoration, not attribution
 DEFAULT_FLIGHT_TOLERANCE = 0.15
@@ -84,6 +93,14 @@ _REQUIRED_HALF_KEYS = ("metric", "value", "unit", "vs_baseline")
 _ROOFLINE_KEYS = ("mem_bw_gbps", "ici_bw_gbps")
 _FEED_KEY = "feed_rows_per_sec"
 _SERVE_KEY = "serve_rows_per_sec"
+_RECOVERY_KEY = "recovery_seconds"
+#: the recovery microbench's config identity: SIGKILL→first-step seconds
+#: are only comparable across runs with the same cluster size, checkpoint
+#: cadence, and kill point — a different cadence bounds a different
+#: amount of lost work
+_RECOVERY_IDENT_KEYS = ("recovery_num_executors",
+                        "recovery_ckpt_every_steps",
+                        "recovery_kill_at_step", "recovery_batch_size")
 #: the serving microbench's config identity: runs are only regression-
 #: compared within the same ingest representation AND bucket geometry —
 #: rows/sec across different bucket sets (or arrow- vs row-shaped
@@ -196,7 +213,8 @@ def halves(parsed: dict[str, Any]) -> list[tuple[str, dict[str, Any]]]:
 def validate_half(half: dict[str, Any], *,
                   require_roofline: bool,
                   require_feed: bool = False,
-                  require_serving: bool = False) -> list[str]:
+                  require_serving: bool = False,
+                  require_recovery: bool = False) -> list[str]:
     """Schema problems of one measured result (a wrapper's half)."""
     problems = []
     for key in _REQUIRED_HALF_KEYS:
@@ -252,6 +270,26 @@ def validate_half(half: dict[str, Any], *,
                 f"{_SERVE_KEY!r} without 'serve_ingest' attribution "
                 "(arrow|rows) — ingest representations are different "
                 "experiments")
+    # recovery microbench (elastic membership): host-side like the feed
+    # and serving ones — required on primary from r10 even when the
+    # accelerator halves degraded; null + 'recovery_reason' always
+    # satisfies (degraded runs legitimately spend their wall budget)
+    if require_recovery or _RECOVERY_KEY in half:
+        if _RECOVERY_KEY not in half:
+            problems.append(
+                f"missing {_RECOVERY_KEY!r} (recovery microbench is part "
+                "of the schema from r10: measure it or stamp an explicit "
+                "null + 'recovery_reason')")
+        elif half[_RECOVERY_KEY] is None and "recovery_reason" not in half:
+            problems.append(
+                f"{_RECOVERY_KEY!r} is null without a 'recovery_reason'")
+        elif isinstance(half.get(_RECOVERY_KEY), (int, float)):
+            missing = [k for k in _RECOVERY_IDENT_KEYS if k not in half]
+            if missing:
+                problems.append(
+                    f"{_RECOVERY_KEY!r} without its config identity "
+                    f"({', '.join(missing)}) — recovery times are only "
+                    "comparable within one cluster/cadence/kill config")
     return problems
 
 
@@ -309,12 +347,26 @@ def _comparable_prior_serving(artifacts: list[dict], newest: dict,
                                       _SERVE_KEY, _SERVE_IDENT_KEYS)
 
 
+def _comparable_prior_recovery(artifacts: list[dict], newest: dict,
+                               half: dict) -> tuple[float, str] | None:
+    """Best (i.e. LOWEST — recovery is a latency) prior
+    ``recovery_seconds`` under the same cluster/cadence/kill config.
+    Host-side like the other microbenches: degraded-accelerator priors
+    still count."""
+    return _comparable_prior_hostside(artifacts, newest, half,
+                                      _RECOVERY_KEY, _RECOVERY_IDENT_KEYS,
+                                      better=min)
+
+
 def _comparable_prior_hostside(artifacts: list[dict], newest: dict,
                                half: dict, key: str,
-                               ident_keys: tuple[str, ...]
-                               ) -> tuple[float, str] | None:
+                               ident_keys: tuple[str, ...],
+                               better=max) -> tuple[float, str] | None:
     """Best prior value of a host-side microbench metric among runs whose
-    config identity (``ident_keys``) matches the newest half's."""
+    config identity (``ident_keys``) matches the newest half's.
+
+    ``better`` picks the comparison direction: ``max`` for throughputs,
+    ``min`` for latencies (``recovery_seconds``)."""
     best: tuple[float, str] | None = None
     for art in artifacts:
         if art["n"] >= newest["n"] or not art["parsed"]:
@@ -325,7 +377,8 @@ def _comparable_prior_hostside(artifacts: list[dict], newest: dict,
                            for k in ident_keys)):
                 continue
             src = f"{os.path.basename(art['path'])}:{plabel}"
-            if best is None or phalf[key] > best[0]:
+            if (best is None
+                    or better(phalf[key], best[0]) == phalf[key]):
                 best = (float(phalf[key]), src)
     return best
 
@@ -336,7 +389,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
          require_feed_from: int = DEFAULT_REQUIRE_FEED_FROM,
          require_serving_from: int = DEFAULT_REQUIRE_SERVING_FROM,
          require_flight_from: int = DEFAULT_REQUIRE_FLIGHT_FROM,
-         flight_tolerance: float = DEFAULT_FLIGHT_TOLERANCE
+         flight_tolerance: float = DEFAULT_FLIGHT_TOLERANCE,
+         require_recovery_from: int = DEFAULT_REQUIRE_RECOVERY_FROM
          ) -> dict[str, Any]:
     """Run the gate over a trajectory; returns the verdict document."""
     checks: list[dict[str, Any]] = []
@@ -376,9 +430,12 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                           and art["n"] >= require_feed_from)
             require_sv = (label == "primary"
                           and art["n"] >= require_serving_from)
+            require_rc = (label == "primary"
+                          and art["n"] >= require_recovery_from)
             for problem in validate_half(half, require_roofline=require_rf,
                                          require_feed=require_fd,
-                                         require_serving=require_sv):
+                                         require_serving=require_sv,
+                                         require_recovery=require_rc):
                 check(f"schema:{name}:{label}",
                       "fail" if is_newest else "warn", problem)
             # flight breakdowns ride the primary half with the microbench
@@ -438,6 +495,31 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                           f"{sval} is {round(sval / sprior[0], 4)}× best "
                           f"prior {sprior[0]} ({sprior[1]}) — the serving "
                           f"data plane regressed below {threshold}")
+            # recovery microbench: host-side, judged before the degraded
+            # skip too.  LOWER is better (it is a latency): the newest run
+            # fails when it exceeds the best comparable prior by more than
+            # 1/threshold
+            if isinstance(half.get(_RECOVERY_KEY), (int, float)):
+                rprior = _comparable_prior_recovery(artifacts, newest,
+                                                    half)
+                rname = f"regression:{_RECOVERY_KEY}"
+                rval = float(half[_RECOVERY_KEY])
+                if rprior is None:
+                    check(rname, "pass",
+                          "no comparable prior recovery measurement "
+                          "(same cluster/cadence/kill config) — nothing "
+                          "to regress against")
+                elif rval * threshold <= rprior[0]:
+                    check(rname, "pass",
+                          f"{rval}s vs best prior {rprior[0]}s "
+                          f"({rprior[1]}): ratio "
+                          f"{round(rval / rprior[0], 4)} ≤ "
+                          f"{round(1 / threshold, 4)}")
+                else:
+                    check(rname, "fail",
+                          f"{rval}s is {round(rval / rprior[0], 4)}× the "
+                          f"best prior {rprior[0]}s ({rprior[1]}) — "
+                          f"recovery slowed beyond 1/{threshold}")
             if "degraded" in half:
                 check(f"degraded:{cname}", "skip",
                       f"newest run degraded ({half['degraded'][:120]}); "
@@ -515,6 +597,8 @@ def main(argv: list[str] | None = None) -> int:
                    default=DEFAULT_REQUIRE_FLIGHT_FROM)
     p.add_argument("--flight-tolerance", type=float,
                    default=DEFAULT_FLIGHT_TOLERANCE)
+    p.add_argument("--require-recovery-from", type=int,
+                   default=DEFAULT_REQUIRE_RECOVERY_FROM)
     args = p.parse_args(argv)
     paths = args.paths or discover(args.repo)
     if not paths:
@@ -527,7 +611,8 @@ def main(argv: list[str] | None = None) -> int:
                require_feed_from=args.require_feed_from,
                require_serving_from=args.require_serving_from,
                require_flight_from=args.require_flight_from,
-               flight_tolerance=args.flight_tolerance)
+               flight_tolerance=args.flight_tolerance,
+               require_recovery_from=args.require_recovery_from)
     print(json.dumps(doc))
     return 1 if doc["verdict"] == "fail" else 0
 
