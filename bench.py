@@ -229,6 +229,13 @@ def _parse_args(argv=None):
                         "rows/sec through the real _RunModel path, bucketed "
                         "columnar pipeline vs the legacy row loop "
                         "(host-side, no accelerator involved)")
+    p.add_argument("--serving-online", action="store_true",
+                   help="measure the continuous-batching online tier: "
+                        "closed-loop rows/sec of N concurrent clients "
+                        "through the real coalescer → bucketed forward → "
+                        "scatter path vs N independent single-request "
+                        "callers at the same p99 SLO (host-side, no "
+                        "accelerator involved)")
     p.add_argument("--recovery", action="store_true",
                    help="measure executor-loss recovery: seconds from "
                         "SIGKILLing one of three trainers mid-run to the "
@@ -959,6 +966,237 @@ def measure_serving(rows_total: int = 16384, feature_dim: int = 256,
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def measure_serving_online(clients: int = 32, reqs_per_client: int = 100,
+                           feature_dim: int = 256, hidden_dim: int = 1024,
+                           out_dim: int = 8, batch_size: int = 64,
+                           flush_ms: float = 4.0,
+                           slo_ms: float = 500.0) -> dict:
+    """Online-serving microbench: closed-loop rows/sec through the REAL
+    coalescer → bucketed forward → scatter path, vs N independent
+    single-request callers, at the same p99 SLO.
+
+    ``clients`` threads each submit single-row requests back-to-back
+    (closed loop — a new request only after the previous reply), once
+    through a live :class:`tensorflowonspark_tpu.online.OnlineServer`
+    (tenant warmed on load, bucket ladder ``[batch_size//4, batch_size]``,
+    ``flush_ms`` deadline) and once as the uncoalesced baseline: the same
+    threads calling the same jitted forward directly, one request per
+    forward — what N independent callers sharing a process pay without a
+    coalescing tier.  The forward is a CTR-serving-shaped MLP
+    (``feature_dim → hidden_dim → out_dim``): heavy enough that a
+    single-row call is real work (one vector-matrix pass per request, the
+    per-request jit dispatch on top), which is exactly the regime
+    coalescing exists for — one batch-N matrix-matrix forward amortizes
+    both the dispatch and the memory traffic N single-row calls pay
+    separately.  Every reply is checked against the precomputed expected
+    outputs before either number is stamped, and both paths' p99 must
+    meet ``slo_ms`` for the numbers to stand (a throughput claimed at an
+    SLO it missed is not a measurement).  Any shed or dropped request
+    fails the measurement into null + reason — the closed loop is sized
+    inside the admission bound, so a shed here is a bug, not load.
+
+    Host-side and CPU-capable like the other microbenches, so the number
+    stays valid on accelerator-degraded rounds.  From r11 the artifact
+    also carries ``online_stage_breakdown`` (the ``"online"`` flight
+    plane: consumer ``wait``/``compute``/``reply`` reconciling with the
+    measured wall, coalescer ``coalesce``/``pad`` overlapped beside it).
+    """
+    import shutil
+    import tempfile as _tempfile
+    import threading
+
+    import numpy as np
+
+    from tensorflowonspark_tpu import compat, online, serving
+    from tensorflowonspark_tpu.obs import flight
+
+    rng = np.random.default_rng(0)
+    w1 = (rng.standard_normal((feature_dim, hidden_dim))
+          .astype(np.float32) * (2.0 / feature_dim) ** 0.5)
+    w2 = (rng.standard_normal((hidden_dim, out_dim))
+          .astype(np.float32) * (2.0 / hidden_dim) ** 0.5)
+    params = {"w1": w1, "w2": w2}
+    rows_total = clients * reqs_per_client
+    feats = rng.standard_normal(
+        (rows_total, feature_dim)).astype(np.float32)
+    expected = np.maximum(feats @ w1, 0.0) @ w2
+    # three-bucket ladder: continuous batching produces a spread of
+    # coalesce sizes (arrival ÷ service rate), and a sparse ladder pads
+    # most of them up to batch_size — compute spent on invented rows
+    bucket_sizes = [max(1, batch_size // 4), max(1, batch_size // 2),
+                    batch_size]
+
+    tmpdir = _tempfile.mkdtemp(prefix="tfos_online_")
+    srv = None
+    try:
+        export_dir = os.path.join(tmpdir, "export")
+        compat.export_saved_model({"params": params}, export_dir)
+        import jax
+
+        predict = jax.jit(lambda p, b: {
+            "score": jax.nn.relu(b["features"] @ p["w1"]) @ p["w2"]})
+        srv = online.OnlineServer()
+        tenant = srv.add_tenant(
+            "bench", export_dir=export_dir, predict_fn=predict,
+            batch_size=batch_size, bucket_sizes=bucket_sizes,
+            flush_ms=flush_ms,
+            warmup_example={"features": np.zeros(feature_dim,
+                                                 np.float32)})
+        srv.start()
+
+        def closed_loop(call) -> tuple[float, list[float], list[str]]:
+            """clients threads × reqs_per_client single-row requests;
+            returns (wall_s, per-request latencies, errors)."""
+            lats: list[list[float]] = [[] for _ in range(clients)]
+            errs: list[str] = []
+
+            def client(ci: int) -> None:
+                base = ci * reqs_per_client
+                try:
+                    for k in range(reqs_per_client):
+                        i = base + k
+                        t0 = time.perf_counter()
+                        out = call(feats[i:i + 1])
+                        lats[ci].append(time.perf_counter() - t0)
+                        if not np.allclose(out, expected[i:i + 1],
+                                           atol=1e-5):
+                            raise RuntimeError(
+                                f"row {i}: output diverges from the "
+                                "uncoalesced expectation")
+                except Exception as e:
+                    errs.append(f"client {ci}: {e!r}")
+
+            threads = [threading.Thread(target=client, args=(ci,),
+                                        daemon=True)
+                       for ci in range(clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=240.0)
+            wall = time.perf_counter() - t0
+            if any(t.is_alive() for t in threads):
+                errs.append("client thread(s) still alive after 240s — "
+                            "wedged caller")
+            return wall, [v for per in lats for v in per], errs
+
+        def via_server(x):
+            return srv.submit("bench", {"features": x}, timeout=60.0)[
+                "score"]
+
+        # the uncoalesced baseline: same forward, one request per call —
+        # warm its (1, d) signature first so neither path pays a compile
+        # inside the timed window (the coalesced tenant was warmed on load)
+        np.asarray(predict(params, {"features": feats[:1]})["score"])
+
+        def via_direct(x):
+            return np.asarray(predict(params, {"features": x})["score"])
+
+        # un-timed warm passes exercise both full paths once
+        for call in (via_server, via_direct):
+            call(feats[:1])
+
+        rec = flight.recorder("online")
+        shed_before = int(srv._shed_total.value)
+        rec.reset()
+        wall, lats, errs = closed_loop(via_server)
+        if errs:
+            raise RuntimeError("; ".join(errs[:3]))
+        shed = int(srv._shed_total.value) - shed_before
+        if shed:
+            raise RuntimeError(
+                f"{shed} request(s) shed during a closed loop sized "
+                "inside the admission bound — refusing to stamp")
+        if len(lats) != rows_total:
+            raise RuntimeError(
+                f"lost replies: {len(lats)}/{rows_total}")
+        breakdown = rec.breakdown(wall)
+        p99 = float(np.percentile(lats, 99))
+        p50 = float(np.percentile(lats, 50))
+
+        uwall, ulats, uerrs = closed_loop(via_direct)
+        if uerrs:
+            raise RuntimeError("; ".join(uerrs[:3]))
+        up99 = float(np.percentile(ulats, 99))
+        for name, val in (("coalesced", p99), ("uncoalesced", up99)):
+            if val * 1000 > slo_ms:
+                raise RuntimeError(
+                    f"{name} p99 {val * 1000:.1f}ms misses the "
+                    f"{slo_ms}ms SLO — a rows/sec claimed at an SLO it "
+                    "missed is not a measurement")
+
+        rps = rows_total / wall
+        urps = rows_total / uwall
+        return {
+            "online_rows_per_sec": round(rps, 1),
+            "online_rows_per_sec_uncoalesced": round(urps, 1),
+            "online_speedup": round(rps / urps, 2),
+            "online_p50_ms": round(p50 * 1000, 3),
+            "online_p99_ms": round(p99 * 1000, 3),
+            "online_p99_ms_uncoalesced": round(up99 * 1000, 3),
+            "online_slo_ms": slo_ms,
+            "online_clients": clients,
+            "online_rows_total": rows_total,
+            "online_batch_size": batch_size,
+            "online_feature_dim": feature_dim,
+            "online_hidden_dim": hidden_dim,
+            "online_flush_ms": flush_ms,
+            "online_bucket_sizes": list(
+                serving.resolve_buckets(batch_size, bucket_sizes)),
+            "online_shed_total": shed,
+            "online_coalesce_p50_rows": _hist_quantile_rows(
+                srv._coalesce_size, 0.50),
+            "online_stage_breakdown": (breakdown if flight.enabled()
+                                       else None),
+            **({} if flight.enabled() else {
+                "online_stage_breakdown_reason":
+                    "flight recorder disabled (TFOS_FLIGHT=0)"}),
+            "online_tenant_p99_ms": tenant.quantile_ms(0.99),
+        }
+    finally:
+        if srv is not None:
+            srv.stop()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def _hist_quantile_rows(hist, q: float):
+    """Histogram-bucket quantile of the coalesce-size histogram (rows)."""
+    from tensorflowonspark_tpu.obs import anomaly
+
+    h = hist.export()
+    if not h["count"]:
+        return None
+    v = anomaly.hist_quantile(h["buckets"], q)
+    return None if v is None else round(v, 1)
+
+
+def _stamp_online(result: dict, deadline: _Deadline) -> None:
+    """Stamp the online-serving microbench into the headline result.
+
+    Host-side like the feed/serving/recovery microbenches, so it runs on
+    accelerator-degraded rounds too.  The schema is total from r11:
+    failure or an exhausted wall budget stamps an explicit null +
+    ``online_reason`` (``tools/bench_gate.py --require-online-from``)."""
+    from tensorflowonspark_tpu import obs
+
+    if deadline.remaining() < 90:
+        result["online_rows_per_sec"] = None
+        result["online_reason"] = ("wall budget exhausted before online "
+                                   "serving microbench")
+        return
+    with obs.span("bench.serving_online") as sp:
+        try:
+            result.update(measure_serving_online())
+            sp.set(ok=True,
+                   rows_per_sec=result.get("online_rows_per_sec"),
+                   speedup=result.get("online_speedup"))
+        except Exception as e:
+            result["online_rows_per_sec"] = None
+            result["online_reason"] = (
+                f"online serving microbench failed: {e!r}"[:200])
+            sp.set(ok=False, error=str(e)[:200])
+
+
 def _recovery_train_fun(args, ctx):
     """Elastic map_fun for the recovery microbench: Trainer + periodic
     async checkpoints + regroup cooperation (the REAL elastic path —
@@ -1428,6 +1666,15 @@ def main() -> None:
         print(json.dumps(result))
         return
 
+    if args.serving_online:
+        # host-side online-tier measurement: no accelerator, no probe
+        result = {"metric": "online_rows_per_sec", "unit": "rows/sec"}
+        _stamp_online(result, deadline)
+        result["value"] = result.get("online_rows_per_sec")
+        _write_trace_artifact(result)
+        print(json.dumps(result))
+        return
+
     if args.recovery:
         # host-side elastic-recovery measurement: no accelerator, no probe
         result = {"metric": "recovery_seconds", "unit": "seconds"}
@@ -1517,6 +1764,7 @@ def main() -> None:
     result["secondary"] = _bench_one("wide_deep", args, deadline, health)
     _stamp_feed_transport(result, deadline)
     _stamp_serving(result, deadline)
+    _stamp_online(result, deadline)
     _stamp_recovery(result, deadline)
     if not probe.get("ok"):
         result["probe"] = probe

@@ -36,6 +36,11 @@ Public surface mirrors the reference package:
   ``TFCluster.dump_trace`` merges to one Chrome-trace file) and a
   counters/gauges/histograms registry with Prometheus exposition
   (``TFCluster.metrics_prometheus``).
+- :mod:`tensorflowonspark_tpu.online` — continuous-batching online
+  serving tier (beyond the reference): coalesced request queue over the
+  serving bucket ladder, multi-tenant routing, byte-bounded admission
+  control with explicit 429-style shedding, per-tenant SLO metrics, and
+  a stdlib HTTP front end (``POST /v1/predict``).
 """
 
 __version__ = "0.1.0"

@@ -39,6 +39,12 @@ And the pipeline flight recorder (ISSUE 6 tentpole):
   wall-time-reconciled stage breakdown.  ``TFOS_FLIGHT=0`` disables,
   ``TFOS_FLIGHT_SAMPLE=N`` thins the histogram traffic.
 
+The flight recorder also attributes the continuous-batching online
+serving tier (plane ``"online"``:
+``wait``/``coalesce``/``pad``/``compute``/``reply``), and the online
+tier's counters and per-tenant latency histograms live in the same
+registry (:mod:`tensorflowonspark_tpu.online`).
+
 Instrumented out of the box: cluster lifecycle (``TFCluster`` /
 ``TFSparkNode`` bootstrap, reserve, probe, shutdown), the trainer
 (``trainer.Trainer`` init + step counters, optional ``jax.profiler`` step

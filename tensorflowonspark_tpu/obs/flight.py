@@ -29,6 +29,13 @@ enough to leave on:
     (``TFSparkNode._TrainFn``): ``encode`` (columnarize + shm write) and
     ``backpressure`` (blocked in the manager queue ``put`` — the
     byte-bound back-pressure signal).
+  - ``"online"`` — the continuous-batching online serving tier
+    (``tensorflowonspark_tpu.online.OnlineServer``): ``coalesce``/``pad``
+    on the coalescer thread (always overlapped — it is its own thread at
+    any prefetch depth), ``wait``/``compute``/``reply`` on the compute
+    thread —
+    ``wait`` is blocked-on-the-coalescer (no requests / deadline not
+    reached), ``reply`` is the per-row scatter back to waiting callers.
 
 - **verdicts** (:func:`classify`): each committed record is classified
   from its stage shares into ``feed_starved`` / ``device_bound`` /
@@ -71,11 +78,13 @@ STAGE_VERDICT = {
     "encode": "ingest_bound",
     "ingest": "ingest_bound",
     "collate": "ingest_bound",
+    "coalesce": "ingest_bound",
     "pad": "ingest_bound",
     "stage": "ingest_bound",
     "shard": "ingest_bound",
     "compute": "device_bound",
     "emit": "emit_bound",
+    "reply": "emit_bound",
 }
 
 #: every verdict :func:`classify` can return

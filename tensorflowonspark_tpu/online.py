@@ -1,0 +1,938 @@
+"""Continuous-batching online serving tier: coalesced requests, multi-tenant
+routing, admission control, per-tenant SLO metrics.
+
+Every serving path before this one (``TFModel.transform``, ``infer_embed``,
+the C-ABI/JNI export) assumes a single caller pushing large pre-formed
+partitions.  Production inference traffic is the opposite shape: many
+concurrent callers, each with one row or a handful — the architectural
+split the TF paper makes (arXiv:1605.08695 §4: one shared serving runtime
+multiplexing many clients over one set of compiled computations, not one
+pipeline per caller).  This module is that tier, driver-less and
+single-process (scale out = run more of them behind any TCP balancer):
+
+- **Coalesced request queue** (:class:`OnlineServer`): concurrent callers
+  :meth:`~OnlineServer.submit` small batches; a coalescer thread drains
+  them into the serving bucket ladder (``serving.resolve_buckets`` /
+  ``choose_bucket`` / ``pad_columns`` — the PR 5 data plane, one compiled
+  shape per bucket) under a latency SLO: a batch flushes when the oldest
+  request's deadline (``flush_ms``) arrives, when a full bucket's worth
+  of rows is pending, or — the continuous-batching discipline — the
+  moment the engine goes idle (holding a request while nothing computes
+  buys no bigger batch, only latency; under load the requests arriving
+  during the in-flight batch coalesce on their own, so batch size adapts
+  to arrival rate ÷ service rate).  One jitted forward runs per
+  coalesced batch; per-row results scatter back to each waiting caller.
+  Assembly (coalesce + pad + ``serving.stager()`` device staging) runs on
+  the coalescer thread while the previous batch computes — the same
+  double-buffering as the partition serving plane, over a bounded staged
+  queue (``TFOS_SERVING_PREFETCH`` deep).
+- **Multi-tenant routing**: each tenant names a model (export dir +
+  forward); tenants resolve through the bounded per-process
+  ``pipeline._MODEL_CACHE`` (same keys, same per-path eviction), and
+  tenants sharing one model + bucket geometry coalesce into the SAME
+  batches — requests are drained round-robin across tenants so one
+  tenant's backlog cannot monopolize a batch, and rows scatter back to
+  their own callers regardless of batch mix.
+- **Admission control / load shedding**: each tenant's pending queue is
+  byte-bounded (the ``TFManager._ByteBoundedQueue`` accounting convention:
+  payload ``nbytes`` held from enqueue to drain; one oversize request is
+  admitted when the queue is byte-empty).  A request that would exceed the
+  bound is shed with an explicit :class:`Rejected` (HTTP 429 semantics,
+  ``Retry-After`` hint) — never a silent drop, never a wedged caller.
+- **Observability**: ``online_requests_total`` / ``online_rows_total`` /
+  ``online_shed_total`` counters, an ``online_coalesce_size`` histogram,
+  and per-tenant latency histograms (``online_request_seconds_<tenant>``,
+  p50/p99 derivable from the buckets) in the ``obs`` registry — on any
+  ``/metrics`` exposition; a ``FlightRecorder`` plane ``"online"``
+  (``wait``/``coalesce``/``pad``/``compute``/``reply``) with bottleneck
+  verdicts on ``/pipeline``; server + per-tenant state on ``/healthz``.
+- **Warm on load** (ROADMAP item 4 slice): a tenant with known input
+  shapes (a self-describing export's signature, or ``warmup_example=``)
+  pre-compiles every bucket shape at :meth:`~OnlineServer.add_tenant`
+  time, counted through ``serving.note_compile`` so the invariant
+  *compiles == jit keys* holds — the first real request never pays XLA.
+
+The HTTP front end (:class:`OnlineHTTPServer`) follows the
+``obs/httpd.py`` pattern: stdlib ``ThreadingHTTPServer``, no framework —
+``POST /v1/predict`` plus ``GET /metrics`` / ``/healthz`` / ``/pipeline``.
+
+Proof: ``bench.py --serving-online`` drives N closed-loop clients through
+the real coalescer → bucketed forward → scatter path and stamps
+``online_rows_per_sec`` (sustained at a fixed p99 SLO, outputs checked
+equal against uncoalesced execution); ``tools/bench_gate.py`` requires it
+from round 11.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import queue as _queue_mod
+import re
+import threading
+import time
+from typing import Any, Callable, Mapping, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+#: request-latency histogram bounds: SLO-grade resolution (the registry
+#: default bottoms out at 1 ms — too coarse for sub-10ms online targets)
+LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, float("inf"))
+#: coalesced-batch row-count histogram bounds (powers of two — bucket
+#: ladders are built from them)
+COALESCE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048,
+                    4096, float("inf"))
+
+#: default per-tenant pending byte bound, MB (``max_pending_mb`` overrides
+#: per tenant) — the ``_ByteBoundedQueue`` convention: back-pressure on the
+#: unbounded term, not a hard memory cap
+DEFAULT_MAX_PENDING_MB = 64.0
+#: default flush deadline, ms: the latency the coalescer may spend waiting
+#: for batch-mates (the queueing half of the SLO; compute rides on top)
+DEFAULT_FLUSH_MS = 10.0
+
+_STOP = object()
+
+
+class Rejected(RuntimeError):
+    """Request shed by admission control — HTTP 429 semantics.
+
+    The tenant's pending queue is over its byte bound; the caller should
+    back off ``retry_after_s`` and retry.  Shedding is *loud by design*:
+    every shed increments ``online_shed_total`` (and the tenant's own
+    counter) and the caller always gets this exception — there is no path
+    on which a request is silently dropped or left waiting forever.
+    """
+
+    def __init__(self, message: str, retry_after_s: float = 0.05):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+def _sanitize(tenant: str) -> str:
+    """Tenant name → metric-name-safe suffix."""
+    return re.sub(r"[^a-zA-Z0-9_]", "_", str(tenant))
+
+
+def _canon(a: np.ndarray) -> np.ndarray:
+    """JSON-sourced arrays → the canonical jax dtypes (f64→f32, i64→i32)
+    so a request parsed from HTTP JSON hits the same jit signature as a
+    warmed / numpy-native one.  Tenants with known input specs cast to
+    the spec dtype instead and never reach this."""
+    if a.dtype == np.float64:
+        return a.astype(np.float32)
+    if a.dtype == np.int64:
+        return a.astype(np.int32)
+    return a
+
+
+class _Request:
+    """One caller's in-flight request: columns in, sliced results out."""
+
+    __slots__ = ("tenant", "cols", "rows", "nbytes", "enqueued", "deadline",
+                 "event", "result", "error")
+
+    def __init__(self, tenant: "_Tenant", cols: dict, rows: int,
+                 nbytes: int, deadline: float):
+        self.tenant = tenant
+        self.cols = cols
+        self.rows = rows
+        self.nbytes = nbytes
+        self.enqueued = time.perf_counter()
+        self.deadline = deadline
+        self.event = threading.Event()
+        self.result: dict | None = None
+        self.error: BaseException | None = None
+
+    def fail(self, err: BaseException) -> None:
+        self.error = err
+        self.event.set()
+
+
+class _Tenant:
+    """Per-tenant routing + admission state (pending queue lives here so
+    one tenant's backlog is *visible* and boundable independently)."""
+
+    def __init__(self, name: str, group: "_ModelGroup", in_map: dict,
+                 flush_s: float, max_pending_bytes: int):
+        from tensorflowonspark_tpu import obs
+
+        self.name = name
+        self.group = group
+        self.in_map = dict(in_map)
+        self.flush_s = float(flush_s)
+        self.max_pending_bytes = int(max_pending_bytes)
+        self.pending: collections.deque[_Request] = collections.deque()
+        self.pending_rows = 0
+        self.pending_bytes = 0
+        safe = _sanitize(name)
+        # instrument handles cached here: submit/reply are the hot path
+        # and must not pay a registry lookup per request (flight-recorder
+        # rule)
+        self.requests_total = obs.counter(
+            f"online_requests_{safe}_total",
+            f"online requests admitted for tenant {name}")
+        self.shed_total = obs.counter(
+            f"online_shed_{safe}_total",
+            f"online requests shed (admission control) for tenant {name}")
+        self.latency = obs.histogram(
+            f"online_request_seconds_{safe}",
+            f"submit→reply latency for tenant {name} (p50/p99 from the "
+            "buckets)", buckets=LATENCY_BUCKETS)
+
+    def quantile_ms(self, q: float) -> float | None:
+        from tensorflowonspark_tpu.obs import anomaly
+
+        h = self.latency.export()
+        if not h["count"]:
+            return None
+        v = anomaly.hist_quantile(h["buckets"], q)
+        return None if v is None else round(v * 1000, 3)
+
+
+class _ModelGroup:
+    """One loaded forward + bucket geometry; the unit of coalescing.
+
+    Tenants whose (model-cache key, bucket ladder, input mapping) agree
+    share a group, so their requests ride the same coalesced batches —
+    that is what makes the tier multi-tenant rather than N independent
+    servers in one process.
+    """
+
+    def __init__(self, key: tuple, fn: Callable, params: Any,
+                 cache_key: Any, buckets: tuple[int, ...], out_map,
+                 specs: dict | None):
+        self.key = key
+        self.fn = fn
+        self.params = params
+        self.cache_key = cache_key
+        self.buckets = tuple(buckets)
+        self.batch_cap = int(buckets[-1])
+        self.out_map = out_map
+        self.specs = specs
+        self.tenants: list[_Tenant] = []
+        self.rr = 0  # round-robin drain start index
+
+    def pending_rows(self) -> int:
+        return sum(t.pending_rows for t in self.tenants)
+
+    def oldest_deadline(self) -> float | None:
+        heads = [t.pending[0].deadline for t in self.tenants if t.pending]
+        return min(heads) if heads else None
+
+
+class OnlineServer:
+    """Driver-less continuous-batching inference server (see module doc).
+
+    Lifecycle: :meth:`add_tenant` (loads + optionally warms the model) →
+    :meth:`start` → concurrent :meth:`submit` from any threads →
+    :meth:`stop` (fails every still-pending request loudly; nothing is
+    dropped silently and no caller is left waiting).
+    """
+
+    def __init__(self):
+        from tensorflowonspark_tpu import obs, serving
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._tenants: dict[str, _Tenant] = {}
+        self._groups: dict[tuple, _ModelGroup] = {}
+        depth = serving.prefetch_depth()
+        self._depth = depth if depth > 0 else 0
+        # staged coalesced batches: bounded so the coalescer backpressures
+        # into the pending queues (and from there into admission control)
+        # when the forward falls behind
+        self._staged: _queue_mod.Queue = _queue_mod.Queue(
+            maxsize=max(1, self._depth))
+        self._coalescer: threading.Thread | None = None
+        self._computer: threading.Thread | None = None
+        self._started = False
+        self._stopped = False
+        # batches staged or computing right now: while 0 the engine is
+        # IDLE and the coalescer flushes any pending work immediately —
+        # the continuous-batching discipline (holding a request while the
+        # engine idles buys no bigger batch, only latency; under load the
+        # requests that arrive during the in-flight batch coalesce on
+        # their own).  ``flush_ms`` therefore only delays requests while
+        # a batch is already in flight.
+        self._inflight = 0
+        self._requests_total = obs.counter(
+            "online_requests_total", "online requests admitted")
+        self._rows_total = obs.counter(
+            "online_rows_total", "rows admitted to the online tier")
+        self._shed_total = obs.counter(
+            "online_shed_total",
+            "online requests shed by admission control (every one of "
+            "these was an explicit 429-style rejection)")
+        self._errors_total = obs.counter(
+            "online_errors_total",
+            "coalesced batches whose forward raised (every waiting "
+            "caller got the error)")
+        self._coalesce_size = obs.histogram(
+            "online_coalesce_size",
+            "real rows per coalesced forward batch (pre-padding)",
+            buckets=COALESCE_BUCKETS)
+        self._pending_rows_g = obs.gauge(
+            "online_pending_rows", "rows waiting in online pending queues")
+        self._pending_bytes_g = obs.gauge(
+            "online_pending_bytes",
+            "payload bytes waiting in online pending queues "
+            "(admission-control accounting)")
+
+    # -- configuration -------------------------------------------------------
+
+    def add_tenant(self, name: str, *, export_dir: str,
+                   model_name: str | None = None,
+                   predict_fn: Callable | None = None,
+                   batch_size: int = 128,
+                   bucket_sizes: Sequence[int] | None = None,
+                   input_mapping: Mapping[str, str] | None = None,
+                   output_mapping: Mapping[str, str] | None = None,
+                   flush_ms: float = DEFAULT_FLUSH_MS,
+                   max_pending_mb: float = DEFAULT_MAX_PENDING_MB,
+                   warmup: bool | None = None,
+                   warmup_example: Mapping[str, Any] | None = None
+                   ) -> "_Tenant":
+        """Route ``name`` to a model; load (and by default warm) it now.
+
+        The model resolves exactly like ``TFModel.transform``'s executor
+        side — through the bounded ``pipeline._MODEL_CACHE`` (per-path
+        eviction on re-export preserved), precedence ``predict_fn`` >
+        serialized forward > ``model_name``.  Tenants that resolve to the
+        same loaded forward with the same bucket ladder and input mapping
+        COALESCE TOGETHER.
+
+        ``flush_ms`` is the queueing half of the tenant's latency SLO:
+        how long the coalescer may hold its oldest request waiting for
+        batch-mates.  ``max_pending_mb`` bounds the tenant's pending
+        payload bytes (admission control).  ``warmup``: ``True`` forces
+        (raises when input shapes are unknowable), ``None`` warms when
+        shapes are known (``warmup_example`` or a self-describing
+        export's signature), ``False`` skips.
+        """
+        from tensorflowonspark_tpu import pipeline, saved_model, serving
+
+        if self._stopped:
+            raise RuntimeError("OnlineServer is stopped")
+        if name in self._tenants:
+            raise ValueError(f"tenant {name!r} already registered")
+        in_map = dict(input_mapping or {})
+        if not in_map and warmup_example:
+            in_map = {k: k for k in warmup_example}
+        if not in_map:
+            raise ValueError(
+                "add_tenant needs input_mapping (request field → model "
+                "input name) or a warmup_example to derive it from")
+        runner = pipeline._RunModel(
+            export_dir=export_dir, model_name=model_name,
+            predict_fn=predict_fn, batch_size=batch_size,
+            input_mapping=in_map, output_mapping=output_mapping,
+            columns=list(in_map), backend="sparkapi",
+            bucket_sizes=list(bucket_sizes) if bucket_sizes else None)
+        fn, params = runner._load()
+        buckets = serving.resolve_buckets(batch_size, bucket_sizes)
+
+        specs = None
+        if warmup_example is not None:
+            specs = serving.input_specs(example=warmup_example)
+        else:
+            try:
+                specs = serving.input_specs(
+                    signature=saved_model.read_signature(export_dir))
+            except (FileNotFoundError, ValueError):
+                specs = None
+        if specs is not None:
+            missing = [f for f in in_map.values() if f not in specs]
+            if missing:
+                raise ValueError(
+                    f"tenant {name!r}: input specs lack model input(s) "
+                    f"{missing}")
+
+        if warmup is True and specs is None:
+            raise ValueError(
+                f"tenant {name!r}: warmup requested but input shapes are "
+                "unknowable — pass warmup_example= or serve a "
+                "self-describing export")
+
+        # output_mapping is part of the coalescing identity too: the
+        # compute thread names the WHOLE batch's outputs via the group's
+        # out_map, so a tenant with a different mapping must get its own
+        # batches (not silently inherit the first registrant's names)
+        group_key = (runner._cache_key, buckets,
+                     tuple(sorted(in_map.items())),
+                     tuple(sorted((output_mapping or {}).items())))
+        # registration mutates the structures the coalescer iterates
+        # (_groups, group.tenants): everything under the one lock.  It
+        # happens LAST — after every validation and after warmup — so a
+        # failed add_tenant leaves no half-configured, routable tenant
+        # behind (and the name stays free for a corrected retry).
+        if warmup is not False and specs is not None:
+            serving.warm_buckets(fn, params,
+                                 {f: specs[f] for f in in_map.values()},
+                                 buckets, runner._cache_key)
+        with self._cond:
+            if name in self._tenants:  # racing registration of one name
+                raise ValueError(f"tenant {name!r} already registered")
+            group = self._groups.get(group_key)
+            if group is None:
+                group = _ModelGroup(group_key, fn, params,
+                                    runner._cache_key, buckets,
+                                    output_mapping, specs)
+                self._groups[group_key] = group
+            elif specs is not None and group.specs is None:
+                group.specs = specs
+            tenant = _Tenant(name, group, in_map, flush_ms / 1000.0,
+                             int(max_pending_mb * (1 << 20)))
+            self._tenants[name] = tenant
+            group.tenants.append(tenant)
+        logger.info(
+            "online tenant %r → %s (buckets=%s, flush=%.1fms, "
+            "pending bound=%d bytes, warmed=%s)", name, export_dir,
+            list(buckets), flush_ms, tenant.max_pending_bytes,
+            warmup is not False and specs is not None)
+        return tenant
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "OnlineServer":
+        if self._started:
+            return self
+        self._started = True
+        self._coalescer = threading.Thread(
+            target=self._coalesce_loop, name="tfos-online-coalescer",
+            daemon=True)
+        self._computer = threading.Thread(
+            target=self._compute_loop, name="tfos-online-compute",
+            daemon=True)
+        self._coalescer.start()
+        self._computer.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Stop serving.  Every request still in flight is failed with an
+        explicit error — a caller blocked in :meth:`submit` always wakes."""
+        with self._cond:
+            if self._stopped:
+                return
+            self._stopped = True
+            self._cond.notify_all()
+        if self._coalescer is not None:
+            self._coalescer.join(timeout=timeout)
+        # drain staged-but-uncomputed batches and fail their callers; the
+        # compute thread may be racing these gets — both sides only ever
+        # FAIL or ANSWER a request, never drop it
+        err = RuntimeError("online server stopped")
+        while True:
+            try:
+                item = self._staged.get_nowait()
+            except _queue_mod.Empty:
+                break
+            if item is not _STOP:
+                for req in item[1]:
+                    req.fail(err)
+        try:
+            self._staged.put_nowait(_STOP)
+        except _queue_mod.Full:  # pragma: no cover - queue just drained
+            pass
+        if self._computer is not None:
+            self._computer.join(timeout=timeout)
+        with self._cond:
+            for tenant in self._tenants.values():
+                while tenant.pending:
+                    req = tenant.pending.popleft()
+                    tenant.pending_rows -= req.rows
+                    tenant.pending_bytes -= req.nbytes
+                    self._pending_rows_g.dec(req.rows)
+                    self._pending_bytes_g.dec(req.nbytes)
+                    req.fail(err)
+
+    # -- request path --------------------------------------------------------
+
+    def submit(self, tenant: str, inputs: Mapping[str, Any],
+               timeout: float = 30.0) -> dict[str, np.ndarray]:
+        """Score ``inputs`` for ``tenant``; blocks until the coalesced
+        forward replies.  ``inputs``: request field → array with a shared
+        leading batch axis (a single row is shape ``(1, ...)``).  Returns
+        output column → array of this request's rows.
+
+        Raises :class:`Rejected` when the tenant's pending queue is over
+        its byte bound (shed — retry after backoff), ``KeyError`` for an
+        unknown tenant, ``ValueError`` for malformed inputs,
+        ``TimeoutError`` when no reply arrives in ``timeout`` seconds.
+        """
+        ts = self._tenants.get(tenant)
+        if ts is None:
+            raise KeyError(f"unknown tenant {tenant!r} "
+                           f"(have {sorted(self._tenants)})")
+        cols, rows, nbytes = self._validate(ts, inputs)
+        deadline = time.perf_counter() + ts.flush_s
+        req = _Request(ts, cols, rows, nbytes, deadline)
+        with self._cond:
+            if not self._started or self._stopped:
+                raise RuntimeError("OnlineServer is not serving "
+                                   "(start() it / already stopped)")
+            # the _ByteBoundedQueue convention: bytes held from enqueue to
+            # drain; a single oversize request is admitted when the queue
+            # is byte-empty (otherwise it could never be served at all)
+            if ts.pending_bytes > 0 and \
+                    ts.pending_bytes + nbytes > ts.max_pending_bytes:
+                ts.shed_total.inc()
+                self._shed_total.inc()
+                raise Rejected(
+                    f"tenant {tenant!r} pending queue over its byte bound "
+                    f"({ts.pending_bytes + nbytes} > "
+                    f"{ts.max_pending_bytes}); request shed — back off "
+                    "and retry", retry_after_s=max(ts.flush_s, 0.01))
+            ts.pending.append(req)
+            ts.pending_rows += rows
+            ts.pending_bytes += nbytes
+            ts.requests_total.inc()
+            self._requests_total.inc()
+            self._rows_total.inc(rows)
+            self._pending_rows_g.inc(rows)
+            self._pending_bytes_g.inc(nbytes)
+            self._cond.notify()
+        if not req.event.wait(timeout):
+            raise TimeoutError(
+                f"no reply for tenant {tenant!r} within {timeout}s "
+                "(server overloaded or stopped?)")
+        if req.error is not None:
+            raise RuntimeError(
+                f"online forward failed for tenant {tenant!r}: "
+                f"{req.error!r}") from req.error
+        return req.result
+
+    def _validate(self, ts: _Tenant, inputs: Mapping[str, Any]
+                  ) -> tuple[dict, int, int]:
+        """Map request fields → model-input columns; reject malformed
+        requests HERE so a bad request can never poison the coalesced
+        batch its well-formed neighbors ride in."""
+        from tensorflowonspark_tpu import serving
+
+        unknown = set(inputs) - set(ts.in_map)
+        if unknown:
+            raise ValueError(
+                f"unknown request field(s) {sorted(unknown)}; tenant "
+                f"{ts.name!r} accepts {sorted(ts.in_map)}")
+        specs = ts.group.specs
+        cols: dict[str, np.ndarray] = {}
+        for field, feat in ts.in_map.items():
+            if field not in inputs:
+                raise ValueError(f"request missing field {field!r}")
+            a = np.asarray(inputs[field])
+            spec = specs.get(feat) if specs else None
+            if spec is not None:
+                a = np.asarray(a, dtype=spec[1])
+                if tuple(a.shape[1:]) != tuple(spec[0]):
+                    raise ValueError(
+                        f"field {field!r} rows have shape "
+                        f"{tuple(a.shape[1:])}, expected {tuple(spec[0])}")
+            else:
+                a = _canon(a)
+            cols[feat] = a
+        rows = serving.batch_rows(cols)
+        if rows <= 0:
+            raise ValueError(
+                "request inputs must share a leading batch axis (a single "
+                "row is shape (1, ...))")
+        if rows > ts.group.batch_cap:
+            raise ValueError(
+                f"request carries {rows} rows > the tenant's largest "
+                f"bucket {ts.group.batch_cap}; split it client-side")
+        nbytes = sum(int(a.nbytes) for a in cols.values())
+        return cols, rows, nbytes
+
+    # -- coalescer (assembly thread) -----------------------------------------
+
+    def _next_flush(self, now: float
+                    ) -> tuple[_ModelGroup | None, float | None]:
+        """Under the lock: the group most overdue to flush, or the wait
+        until the nearest deadline (None = nothing pending)."""
+        ready: _ModelGroup | None = None
+        ready_deadline = None
+        nearest: float | None = None
+        idle = self._inflight == 0
+        for group in self._groups.values():
+            oldest = group.oldest_deadline()
+            if oldest is None:
+                continue
+            if idle or group.pending_rows() >= group.batch_cap \
+                    or oldest <= now:
+                if ready is None or oldest < ready_deadline:
+                    ready, ready_deadline = group, oldest
+            elif nearest is None or oldest < nearest:
+                nearest = oldest
+        if ready is not None:
+            return ready, None
+        return None, (None if nearest is None else max(0.0, nearest - now))
+
+    def _drain(self, group: _ModelGroup) -> tuple[list[_Request], int]:
+        """Under the lock: pop up to one bucket of rows, round-robin
+        across the group's tenants (requests stay whole — scatter slices
+        must map 1:1 back to callers).  Rotation means a deep backlog on
+        one tenant cannot starve another's freshly-arrived request."""
+        cap = group.batch_cap
+        members = group.tenants
+        out: list[_Request] = []
+        rows = 0
+        start = group.rr
+        progressed = True
+        while progressed and rows < cap:
+            progressed = False
+            for i in range(len(members)):
+                ts = members[(start + i) % len(members)]
+                if ts.pending and rows + ts.pending[0].rows <= cap:
+                    req = ts.pending.popleft()
+                    ts.pending_rows -= req.rows
+                    ts.pending_bytes -= req.nbytes
+                    self._pending_rows_g.dec(req.rows)
+                    self._pending_bytes_g.dec(req.nbytes)
+                    out.append(req)
+                    rows += req.rows
+                    progressed = True
+                    if rows >= cap:
+                        break
+        group.rr = (group.rr + 1) % max(1, len(members))
+        return out, rows
+
+    def _coalesce_loop(self) -> None:
+        from tensorflowonspark_tpu import serving
+        from tensorflowonspark_tpu.obs import flight
+
+        rec = flight.recorder("online")
+        stage = serving.stager()
+        perf = time.perf_counter
+        while True:
+            with self._cond:
+                while True:
+                    if self._stopped:
+                        return
+                    group, wait_s = self._next_flush(perf())
+                    if group is not None:
+                        reqs, n = self._drain(group)
+                        self._inflight += 1
+                        break
+                    self._cond.wait(timeout=wait_s)
+            if not reqs:  # pragma: no cover - defensive (ready ⇒ pending)
+                continue
+            try:
+                t0 = perf()
+                cols = self._concat(reqs)
+                t1 = perf()
+                bucket = serving.choose_bucket(n, group.buckets)
+                if bucket > n:
+                    cols = serving.pad_columns(cols, bucket)
+                serving.note_rows(n, bucket)
+                staged = stage(cols)
+            except Exception as e:
+                # e.g. a spec-less tenant's requests with mismatched row
+                # shapes meeting in one np.concatenate: fail THIS batch's
+                # callers loudly and keep serving — an unguarded assembly
+                # error would kill the coalescer thread and wedge every
+                # future caller of every tenant
+                self._errors_total.inc()
+                logger.warning(
+                    "online coalesce failed (%d reqs, %d rows): %r",
+                    len(reqs), n, e)
+                for req in reqs:
+                    req.fail(e)
+                self._note_idle()
+                continue
+            # always overlapped: unlike _RunModel's depth-0 inline mode,
+            # the coalescer is a separate thread even at prefetch 0, so
+            # counting these as additive would double the stage sum
+            # against the compute thread's wait
+            rec.add(overlapped=True, coalesce=t1 - t0,
+                    pad=perf() - t1)
+            self._coalesce_size.observe(n)
+            item = (group, reqs, n, bucket, staged)
+            while True:
+                try:
+                    self._staged.put(item, timeout=0.2)
+                    break
+                except _queue_mod.Full:
+                    if self._stopped:
+                        err = RuntimeError("online server stopped")
+                        for req in reqs:
+                            req.fail(err)
+                        self._note_idle()
+                        return
+
+    @staticmethod
+    def _concat(reqs: list[_Request]) -> dict[str, np.ndarray]:
+        if len(reqs) == 1:
+            return dict(reqs[0].cols)
+        feats = reqs[0].cols.keys()
+        return {f: np.concatenate([r.cols[f] for r in reqs])
+                for f in feats}
+
+    # -- compute + scatter (reply thread) ------------------------------------
+
+    def _compute_loop(self) -> None:
+        from tensorflowonspark_tpu import pipeline, serving
+        from tensorflowonspark_tpu.obs import flight
+
+        rec = flight.recorder("online")
+        perf = time.perf_counter
+        while True:
+            t0 = perf()
+            item = self._staged.get()
+            if item is _STOP:
+                return
+            wait = perf() - t0
+            group, reqs, n, bucket, batch = item
+            t1 = perf()
+            try:
+                serving.note_compile(group.cache_key, batch)
+                outputs = group.fn(group.params, batch)
+                named = pipeline._name_outputs(outputs, group.out_map)
+                arrays: dict[str, np.ndarray] = {}
+                for cname, arr in named.items():
+                    a = np.asarray(arr)  # forces the async dispatch
+                    if a.ndim == 0 or a.shape[0] != bucket:
+                        raise ValueError(
+                            f"online output {cname!r} has shape "
+                            f"{np.shape(a)} but the batch fed {bucket} "
+                            "rows — outputs must be per-example to "
+                            "scatter back to callers")
+                    arrays[cname] = a
+            except Exception as e:
+                self._errors_total.inc()
+                logger.warning("online forward failed (%d reqs, %d rows): "
+                               "%r", len(reqs), n, e)
+                for req in reqs:
+                    req.fail(e)
+                rec.add(wait=wait, compute=perf() - t1)
+                rec.commit()
+                self._note_idle()
+                continue
+            t2 = perf()
+            # scatter: request k owns rows [off, off+k.rows) of the batch,
+            # in drain order — tenant mix is irrelevant to correctness
+            off = 0
+            for req in reqs:
+                req.result = {c: a[off:off + req.rows]
+                              for c, a in arrays.items()}
+                off += req.rows
+                req.event.set()
+                req.tenant.latency.observe(perf() - req.enqueued)
+            rec.add(wait=wait, compute=t2 - t1, reply=perf() - t2)
+            rec.commit()
+            self._note_idle()
+
+    def _note_idle(self) -> None:
+        """One staged batch fully answered: wake the coalescer — an idle
+        engine flushes pending work immediately (see ``_inflight``)."""
+        with self._cond:
+            self._inflight -= 1
+            self._cond.notify()
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        if self._stopped:
+            return "stopped"
+        return "serving" if self._started else "created"
+
+    def stats(self) -> dict[str, Any]:
+        """JSON-able server + per-tenant state (the ``/healthz`` body)."""
+        tenants = {}
+        with self._lock:
+            snap = list(self._tenants.values())
+        for ts in snap:
+            tenants[ts.name] = {
+                "pending_rows": ts.pending_rows,
+                "pending_bytes": ts.pending_bytes,
+                "max_pending_bytes": ts.max_pending_bytes,
+                "flush_ms": round(ts.flush_s * 1000, 3),
+                "requests_total": int(ts.requests_total.value),
+                "shed_total": int(ts.shed_total.value),
+                "latency_p50_ms": ts.quantile_ms(0.50),
+                "latency_p99_ms": ts.quantile_ms(0.99),
+            }
+        return {
+            "state": self.state,
+            "tenants": tenants,
+            "models_loaded": len(self._groups),
+            "staged_batches": self._staged.qsize(),
+            "requests_total": int(self._requests_total.value),
+            "rows_total": int(self._rows_total.value),
+            "shed_total": int(self._shed_total.value),
+            "errors_total": int(self._errors_total.value),
+        }
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end (obs/httpd.py pattern: stdlib, no framework)
+# ---------------------------------------------------------------------------
+
+
+class OnlineHTTPServer:
+    """Thin stdlib HTTP front end over an :class:`OnlineServer`.
+
+    - ``POST /v1/predict`` — body ``{"tenant": str, "inputs": {field:
+      nested lists}, "timeout_s": float?}`` → ``{"outputs": {col:
+      lists}, "rows": n}``.  Admission shed → **429** with a
+      ``Retry-After`` header; unknown tenant → 404; malformed → 400;
+      reply timeout → 504.
+    - ``GET /metrics`` — Prometheus text of this process's registry
+      (the online counters/histograms ride the same exposition as every
+      other instrument).
+    - ``GET /healthz`` — :meth:`OnlineServer.stats` JSON; 200 while
+      serving, 503 otherwise.
+    - ``GET /pipeline`` — this process's flight-recorder planes (the
+      ``"online"`` plane's stage totals + verdicts) plus the stats doc.
+
+    A handler that raises becomes a 500; the endpoint must never take the
+    serving process down (the ``obs/httpd.py`` contract).
+    """
+
+    def __init__(self, server: OnlineServer, host: str = "127.0.0.1",
+                 port: int = 0):
+        self._online = server
+        self._host = host
+        self._port = port
+        self._httpd = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> tuple[str, int]:
+        import json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from tensorflowonspark_tpu import obs
+        from tensorflowonspark_tpu.obs import httpd as _httpd
+        from tensorflowonspark_tpu.obs import flight
+
+        online = self._online
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                try:
+                    if path == "/metrics":
+                        self._reply(200, _httpd.PROMETHEUS_CONTENT_TYPE,
+                                    obs.get_registry().to_prometheus())
+                    elif path == "/healthz":
+                        doc = online.stats()
+                        self._reply(
+                            200 if doc["state"] == "serving" else 503,
+                            "application/json", json.dumps(doc))
+                    elif path == "/pipeline":
+                        doc = {"planes": flight.local_report(),
+                               "server": online.stats()}
+                        self._reply(200, "application/json",
+                                    json.dumps(doc))
+                    else:
+                        self._reply(404, "application/json", json.dumps(
+                            {"error": "not found",
+                             "routes": ["/v1/predict (POST)", "/metrics",
+                                        "/healthz", "/pipeline"]}))
+                except Exception as e:  # must never kill the server
+                    logger.warning("online http GET %s failed: %s", path, e)
+                    self._reply(500, "text/plain; charset=utf-8",
+                                f"handler error: {e}")
+
+            def do_POST(self) -> None:  # noqa: N802 (stdlib naming)
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path != "/v1/predict":
+                    self._reply(404, "application/json",
+                                json.dumps({"error": "not found"}))
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    tenant = body.get("tenant")
+                    inputs = body.get("inputs")
+                    if not tenant or not isinstance(inputs, dict):
+                        raise ValueError(
+                            "body must carry 'tenant' and 'inputs'")
+                    # explicit timeout_s of 0 means fail-fast, not the
+                    # default — a falsy-or would silently make it 30s
+                    timeout = min(float(body["timeout_s"])
+                                  if "timeout_s" in body else 30.0,
+                                  300.0)
+                    t0 = time.perf_counter()
+                    out = online.submit(tenant, inputs, timeout=timeout)
+                    doc = {"outputs": {c: np.asarray(a).tolist()
+                                       for c, a in out.items()},
+                           "rows": int(next(iter(out.values())).shape[0])
+                           if out else 0,
+                           "latency_ms": round(
+                               (time.perf_counter() - t0) * 1000, 3)}
+                    self._reply(200, "application/json", json.dumps(doc))
+                except Rejected as e:
+                    import math
+
+                    # header per RFC 9110: integer delta-seconds (a
+                    # fractional value is unparseable to spec-compliant
+                    # retry middleware); the body keeps the precise float
+                    self._reply(429, "application/json", json.dumps(
+                        {"error": str(e),
+                         "retry_after_s": e.retry_after_s}),
+                        extra_headers={"Retry-After": str(max(
+                            1, math.ceil(e.retry_after_s)))})
+                except KeyError as e:
+                    self._reply(404, "application/json",
+                                json.dumps({"error": str(e)}))
+                except (ValueError, TypeError) as e:
+                    self._reply(400, "application/json",
+                                json.dumps({"error": str(e)}))
+                except TimeoutError as e:
+                    self._reply(504, "application/json",
+                                json.dumps({"error": str(e)}))
+                except Exception as e:  # must never kill the server
+                    logger.warning("online http POST failed: %s", e)
+                    self._reply(500, "application/json",
+                                json.dumps({"error": f"handler error: "
+                                                     f"{e}"}))
+
+            def _reply(self, status: int, ctype: str, body,
+                       extra_headers: dict | None = None) -> None:
+                if isinstance(body, str):
+                    body = body.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt: str, *args: Any) -> None:
+                logger.debug("online http: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port),
+                                          _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tfos-online-http",
+            daemon=True)
+        self._thread.start()
+        return self.address
+
+    @property
+    def address(self) -> tuple[str, int]:
+        if self._httpd is None:
+            raise RuntimeError("server not started")
+        return self._httpd.server_address[:2]
+
+    @property
+    def port(self) -> int:
+        return self.address[1]
+
+    def url(self, path: str = "/") -> str:
+        host, port = self.address
+        return f"http://{host}:{port}{path}"
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -293,6 +293,63 @@ class TFModel(TFParams, HasBatchSize, HasInputMapping, HasOutputMapping,
     def transform(self, df):
         return self._transform(df)
 
+    def warmup(self, buckets: Sequence[int] | None = None,
+               example: dict | None = None) -> list[int]:
+        """Pre-compile the serving forward for every bucket shape.
+
+        Without this the first partition (or the first online request) on
+        a process pays the full XLA compile per bucket — at fleet scale
+        cold-start dominates (ROADMAP item 4).  ``warmup`` loads the model
+        through the same ``_MODEL_CACHE`` path ``transform`` uses and runs
+        one all-zeros forward per bucket of the ladder
+        (``serving.resolve_buckets(batch_size, buckets or bucket_sizes)``),
+        so the jit executable cache already holds every shape the data
+        plane will request.  Row shapes/dtypes come from ``example`` (a
+        dict of model-input name → ONE example row) or, for
+        self-describing exports, from the artifact's own signature.
+
+        Warm compiles are counted through ``serving.note_compile`` — the
+        invariant *``serving_compiles_total`` == distinct jit keys* holds,
+        warmup just moves them off the first request's critical path.
+        Returns the list of bucket sizes warmed.
+        """
+        from tensorflowonspark_tpu import saved_model, serving, sql_compat
+
+        export_dir = self.getOrDefault("export_dir") or self.getOrDefault(
+            "model_dir")
+        if not export_dir:
+            raise ValueError("TFModel needs export_dir or model_dir")
+        if example is not None:
+            specs = serving.input_specs(example=example)
+        else:
+            try:
+                specs = serving.input_specs(
+                    signature=saved_model.read_signature(export_dir))
+            except FileNotFoundError:
+                raise ValueError(
+                    "warmup needs input shapes: pass example= (model "
+                    "input name → one example row) or serve a "
+                    "self-describing export whose signature records "
+                    "them") from None
+        bucket_sizes = (list(buckets) if buckets
+                        else self.getOrDefault("bucket_sizes"))
+        ladder = serving.resolve_buckets(self.getOrDefault("batch_size"),
+                                         bucket_sizes)
+        run_model = _RunModel(
+            export_dir=export_dir,
+            model_name=self.getOrDefault("model_name"),
+            predict_fn=self.predict_fn,
+            batch_size=self.getOrDefault("batch_size"),
+            input_mapping=self.getOrDefault("input_mapping"),
+            output_mapping=self.getOrDefault("output_mapping"),
+            columns=list(specs), backend=sql_compat.SPARKAPI,
+            bucket_sizes=bucket_sizes)
+        fn, params = run_model._load()
+        serving.warm_buckets(fn, params, specs, ladder,
+                             run_model._cache_key)
+        logger.info("warmed %s for buckets %s", export_dir, list(ladder))
+        return list(ladder)
+
     def _transform(self, df):
         from tensorflowonspark_tpu import sql_compat
 

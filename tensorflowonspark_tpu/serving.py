@@ -158,6 +158,89 @@ def batch_rows(batch: Mapping[str, Any]) -> int:
 
 
 # ---------------------------------------------------------------------------
+# Warmup shapes
+# ---------------------------------------------------------------------------
+
+
+def input_specs(example: Mapping[str, Any] | None = None,
+                signature: Mapping[str, Any] | None = None
+                ) -> dict[str, tuple[tuple, Any]]:
+    """Per-input row templates: ``{input_name: (row_shape, dtype)}``.
+
+    The shape source for :func:`zero_batch` — what a warmup path needs to
+    build a representative batch at any bucket size.  From ``example`` (a
+    dict of input name → ONE example row, no batch axis) the template is
+    the row's own shape/dtype; from a self-describing export's
+    ``signature`` (``saved_model.read_signature``) it is each input
+    entry's shape minus the leading batch dim.  Exactly one source must
+    be given.
+    """
+    if (example is None) == (signature is None):
+        raise ValueError("input_specs needs exactly one of example= / "
+                         "signature=")
+    specs: dict[str, tuple[tuple, Any]] = {}
+    if example is not None:
+        for name, row in example.items():
+            a = np.asarray(row)
+            specs[str(name)] = (tuple(a.shape), a.dtype)
+        return specs
+    for entry in signature.get("inputs", []):
+        shape = entry.get("shape") or []
+        if any(d is None for d in shape[1:]):
+            raise ValueError(
+                f"input {entry.get('name')!r} has a polymorphic non-batch "
+                f"dim {shape}: warmup needs concrete row shapes — pass "
+                "example= instead")
+        tail = tuple(int(d) for d in shape[1:])
+        specs[str(entry["name"])] = (tail, np.dtype(entry["dtype"]))
+    if not specs:
+        raise ValueError("signature carries no inputs")
+    return specs
+
+
+def zero_batch(specs: Mapping[str, tuple[tuple, Any]], rows: int) -> dict:
+    """An all-zeros batch of ``rows`` rows shaped by :func:`input_specs` —
+    the shape/dtype signature is what jit keys on, so a zero batch warms
+    exactly the compile a real batch of the same geometry would pay."""
+    return {name: np.zeros((int(rows), *tail), dtype)
+            for name, (tail, dtype) in specs.items()}
+
+
+def warm_buckets(fn, params, specs: Mapping[str, tuple[tuple, Any]],
+                 buckets: Sequence[int], cache_key: Any) -> None:
+    """Pre-compile ``fn`` for every bucket shape — the ONE warm loop,
+    shared by ``TFModel.warmup`` and the online tier's warm-on-load.
+
+    Each warm compile is counted through :func:`note_compile` under
+    ``cache_key`` (the model-cache key the data plane will use), so the
+    invariant *``serving_compiles_total`` == distinct jit keys* holds —
+    warmup only moves the compiles off the first request's critical path.
+    Every warm forward is FORCED (leaves materialized): jax dispatch is
+    async, and an unforced warm would leave the compile racing the first
+    real batch."""
+    from tensorflowonspark_tpu import obs
+
+    with obs.span("serving.warmup", buckets=list(buckets)):
+        for b in buckets:
+            batch = zero_batch(specs, b)
+            note_compile(cache_key, batch)
+            out = fn(params, batch)
+            for leaf in _tree_leaves(out):
+                np.asarray(leaf)
+
+
+def _tree_leaves(tree):
+    if isinstance(tree, Mapping):
+        for v in tree.values():
+            yield from _tree_leaves(v)
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            yield from _tree_leaves(v)
+    else:
+        yield tree
+
+
+# ---------------------------------------------------------------------------
 # Compile accounting
 # ---------------------------------------------------------------------------
 

@@ -20,6 +20,7 @@ import errno
 import logging
 import os
 import socket
+import sys
 
 logger = logging.getLogger(__name__)
 
@@ -43,6 +44,24 @@ def ensure_jax_platform() -> None:
     global _jax_platform_applied
     if _jax_platform_applied:
         return
+    # Shard-invariant randomness: the legacy threefry lowering is NOT
+    # invariant under GSPMD partitioning — ``jax.random`` inside a jit
+    # whose outputs carry shardings draws DIFFERENT values per mesh
+    # layout, so the trainer's sharded init materialized different
+    # parameters on a dp-only mesh than on an ep/tp one (the root cause
+    # of the three BERT-MoE mesh-equivalence test failures).  The
+    # partitionable implementation is the designed fix: same values for
+    # the same key regardless of how the computation is sharded.
+    # setdefault so an operator can still opt out.
+    os.environ.setdefault("JAX_THREEFRY_PARTITIONABLE", "true")
+    if "jax" in sys.modules:
+        # jax read the env at import time; if someone imported it before
+        # calling us, apply the flag through the live config instead
+        import jax
+
+        if os.environ["JAX_THREEFRY_PARTITIONABLE"].strip().lower() in (
+                "1", "true", "yes"):
+            jax.config.update("jax_threefry_partitionable", True)
     platform = os.environ.get(JAX_PLATFORM_ENV)
     ndev = os.environ.get(HOST_DEVICE_COUNT_ENV)
     if not platform and not ndev:

@@ -639,14 +639,14 @@ def test_recovery_regression_is_lower_is_better(tmp_path):
     paths = [
         _write(tmp_path, "BENCH_r10.json", _r10()),
         _write(tmp_path, "BENCH_r11.json",
-               _r10(**_recovery_fields(seconds=12.0))),
+               _r11(**_recovery_fields(seconds=12.0))),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "pass", verdict["reasons"]
     paths = [
         _write(tmp_path, "BENCH_r10.json", _r10()),
         _write(tmp_path, "BENCH_r11.json",
-               _r10(**_recovery_fields(seconds=30.0))),
+               _r11(**_recovery_fields(seconds=30.0))),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "fail"
@@ -659,7 +659,7 @@ def test_recovery_not_compared_across_configs(tmp_path):
     paths = [
         _write(tmp_path, "BENCH_r10.json", _r10()),
         _write(tmp_path, "BENCH_r11.json",
-               _r10(**_recovery_fields(seconds=30.0,
+               _r11(**_recovery_fields(seconds=30.0,
                                        recovery_ckpt_every_steps=16))),
     ]
     verdict = bench_gate.gate(paths)
@@ -673,9 +673,157 @@ def test_recovery_judged_even_on_degraded_newest(tmp_path):
     paths = [
         _write(tmp_path, "BENCH_r10.json", _r10()),
         _write(tmp_path, "BENCH_r11.json",
-               _r10(**_recovery_fields(seconds=40.0),
+               _r11(**_recovery_fields(seconds=40.0),
                     degraded="accelerator unavailable: probe timeout")),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "fail"
     assert any("recovery slowed" in r for r in verdict["reasons"])
+
+
+# -- online serving tier (ISSUE 9) -------------------------------------------
+
+
+def _online_fields(rps=11000.0, p99=5.2, **extra):
+    fields = {"online_rows_per_sec": rps,
+              "online_rows_per_sec_uncoalesced": rps / 2.5,
+              "online_speedup": 2.5,
+              "online_p50_ms": 2.8, "online_p99_ms": p99,
+              "online_p99_ms_uncoalesced": 21.5,
+              "online_slo_ms": 500.0, "online_flush_ms": 4.0,
+              "online_clients": 32, "online_rows_total": 3200,
+              "online_batch_size": 64, "online_feature_dim": 256,
+              "online_hidden_dim": 1024,
+              "online_bucket_sizes": [16, 32, 64],
+              "online_shed_total": 0,
+              "online_stage_breakdown": _flight_bd(
+                  verdict="device_bound",
+                  stages_s={"wait": 3.0, "compute": 6.0, "reply": 1.0})}
+    fields.update(extra)
+    return fields
+
+
+def _r11(**extra):
+    """A round-11-complete primary half: all microbenches + online."""
+    half = _r10(**_online_fields())
+    half.update(extra)
+    return half
+
+
+def test_online_field_required_on_primary_from_round_11(tmp_path):
+    # round 10: grandfathered — no online number owed
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r10.json", _r10())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # round 11+: the primary must carry it (or explicit null + reason)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r11.json", _r10())])
+    assert verdict["verdict"] == "fail"
+    assert any("online_rows_per_sec" in r for r in verdict["reasons"])
+    # complete round 11 passes
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r11.json", _r11())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # explicit null + reason satisfies (e.g. wall budget exhausted)
+    half = _r10(online_rows_per_sec=None,
+                online_reason="wall budget exhausted before online "
+                              "serving microbench")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r11.json", half)])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # bare null does not
+    half = _r10(online_rows_per_sec=None)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r11.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("online_reason" in r for r in verdict["reasons"])
+
+
+def test_online_value_without_config_identity_fails(tmp_path):
+    half = _r10(online_rows_per_sec=11000.0,
+                online_p99_ms=5.2, online_slo_ms=500.0,
+                online_stage_breakdown=_flight_bd(verdict="device_bound"))
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r11.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("config identity" in r for r in verdict["reasons"])
+
+
+def test_online_p99_over_slo_fails(tmp_path):
+    """A throughput claimed at an SLO the run missed is not a
+    measurement: p99 above online_slo_ms fails the artifact."""
+    half = _r11(**_online_fields(p99=700.0))
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r11.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("SLO" in r for r in verdict["reasons"])
+    # a value without its measured p99 is equally unjudgeable
+    missing = _r11()
+    del missing["online_p99_ms"]
+    verdict = bench_gate.gate(
+        [_write(tmp_path, "BENCH_r11.json", missing)])
+    assert verdict["verdict"] == "fail"
+    assert any("online_p99_ms" in r for r in verdict["reasons"])
+
+
+def test_online_regression_within_same_config(tmp_path):
+    paths = [
+        _write(tmp_path, "BENCH_r11.json", _r11()),
+        _write(tmp_path, "BENCH_r12.json",
+               _r11(**_online_fields(rps=10500.0))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    paths = [
+        _write(tmp_path, "BENCH_r11.json", _r11()),
+        _write(tmp_path, "BENCH_r12.json",
+               _r11(**_online_fields(rps=5000.0))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("online tier regressed" in r for r in verdict["reasons"])
+
+
+def test_online_not_compared_across_slo_or_geometry(tmp_path):
+    """rows/sec at a looser SLO (or different client count) is a
+    different experiment — never regression-compared."""
+    paths = [
+        _write(tmp_path, "BENCH_r11.json", _r11()),
+        _write(tmp_path, "BENCH_r12.json",
+               _r11(**_online_fields(rps=5000.0, online_slo_ms=100.0))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    paths = [
+        _write(tmp_path, "BENCH_r11.json", _r11()),
+        _write(tmp_path, "BENCH_r12.json",
+               _r11(**_online_fields(rps=5000.0, online_clients=8))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+
+
+def test_online_judged_even_on_degraded_newest(tmp_path):
+    """Host-side like the other microbenches: a degraded accelerator
+    half still measured the real online tier, so its number stays
+    gated."""
+    paths = [
+        _write(tmp_path, "BENCH_r11.json", _r11()),
+        _write(tmp_path, "BENCH_r12.json",
+               _r11(**_online_fields(rps=5000.0),
+                    degraded="accelerator unavailable: probe timeout")),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("online tier regressed" in r for r in verdict["reasons"])
+
+
+def test_online_breakdown_held_to_reconciliation(tmp_path):
+    """The online flight breakdown rides the same reconciliation bar as
+    the feed/serving ones: a stage sum that strays >15% from wall fails;
+    null + reason (recorder opted out) is exempt."""
+    bad = _r11(online_stage_breakdown=_flight_bd(
+        frac=0.5, verdict="device_bound"))
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r11.json", bad)])
+    assert verdict["verdict"] == "fail"
+    assert any("does not reconcile" in r for r in verdict["reasons"])
+    opted_out = _r11(online_stage_breakdown=None,
+                     online_stage_breakdown_reason="flight recorder "
+                                                   "disabled "
+                                                   "(TFOS_FLIGHT=0)")
+    verdict = bench_gate.gate(
+        [_write(tmp_path, "BENCH_r11.json", opted_out)])
+    assert verdict["verdict"] == "pass", verdict["reasons"]

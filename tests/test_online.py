@@ -1,0 +1,518 @@
+"""Continuous-batching online serving tier (``tensorflowonspark_tpu.online``):
+coalescer edge cases (deadline flush, full-bucket flush, shed-under-pressure,
+per-tenant isolation, mixed-tenant scatter), warm-on-load compile accounting,
+and the stdlib HTTP front end."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import compat, obs, online
+from tensorflowonspark_tpu.obs import flight
+
+
+W = np.arange(20, dtype=np.float32).reshape(4, 5) / 10.0
+
+
+def _predict(p, b):
+    return {"score": b["features"] @ p["w"]}
+
+
+@pytest.fixture()
+def export_dir(tmp_path):
+    d = str(tmp_path / "export")
+    compat.export_saved_model({"params": {"w": W}}, d)
+    return d
+
+
+def _server(export_dir, tenants=("a",), batch_size=8, bucket_sizes=(2, 8),
+            flush_ms=10.0, predict_fn=_predict, warmup=None, **kw):
+    srv = online.OnlineServer()
+    for name in tenants:
+        srv.add_tenant(
+            name, export_dir=export_dir, predict_fn=predict_fn,
+            batch_size=batch_size, bucket_sizes=list(bucket_sizes),
+            flush_ms=flush_ms, warmup=warmup,
+            warmup_example={"features": np.zeros(4, np.float32)}, **kw)
+    return srv.start()
+
+
+def _rows(n, seed=0):
+    return np.random.RandomState(seed).rand(n, 4).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# coalescer edge cases
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_flush_single_request(export_dir):
+    """ONE queued request must come back promptly (deadline/idle flush) —
+    a coalescer that waits for a full bucket would hang a lone caller."""
+    srv = _server(export_dir, flush_ms=20.0)
+    try:
+        x = _rows(1)
+        t0 = time.perf_counter()
+        out = srv.submit("a", {"features": x}, timeout=10.0)
+        dt = time.perf_counter() - t0
+        np.testing.assert_allclose(out["score"], x @ W, rtol=1e-6)
+        assert dt < 5.0  # promptly, not a 10s timeout or a hang
+    finally:
+        srv.stop()
+
+
+def test_full_bucket_flushes_before_deadline(export_dir):
+    """A full bucket's worth of pending rows flushes immediately — the
+    flush deadline is a latency bound, not a fixed batching cadence."""
+    srv = _server(export_dir, batch_size=4, bucket_sizes=(4,),
+                  flush_ms=5000.0)  # deadline effectively never
+    try:
+        results = {}
+
+        def go(i):
+            results[i] = srv.submit("a", {"features": _rows(1, seed=i)},
+                                    timeout=30.0)
+
+        threads = [threading.Thread(target=go, args=(i,)) for i in range(4)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert len(results) == 4
+        assert time.perf_counter() - t0 < 4.0  # not the 5s deadline
+    finally:
+        srv.stop()
+
+
+def test_scatter_correct_when_batch_mixes_tenants(export_dir):
+    """Two tenants sharing one model coalesce into the SAME forward batch;
+    each caller must get exactly its own rows back."""
+    srv = _server(export_dir, tenants=("a", "b"), flush_ms=100.0)
+    try:
+        batches_before = flight.recorder("online").batches
+        xa, xb = _rows(2, seed=1), _rows(3, seed=2)
+        results = {}
+
+        def go(tenant, x):
+            results[tenant] = srv.submit(tenant, {"features": x},
+                                         timeout=30.0)
+
+        ta = threading.Thread(target=go, args=("a", xa))
+        tb = threading.Thread(target=go, args=("b", xb))
+        ta.start(), tb.start()
+        ta.join(30.0), tb.join(30.0)
+        np.testing.assert_allclose(results["a"]["score"], xa @ W,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(results["b"]["score"], xb @ W,
+                                   rtol=1e-6)
+        # they really rode together: at most 2 batches for the 2 requests,
+        # and the tier recorded every row
+        assert flight.recorder("online").batches - batches_before <= 2
+        stats = srv.stats()
+        assert stats["tenants"]["a"]["requests_total"] >= 1
+        assert stats["tenants"]["b"]["requests_total"] >= 1
+    finally:
+        srv.stop()
+
+
+def test_shed_under_pressure_returns_rejection_not_hang(export_dir):
+    """Admission control: when a tenant's pending bytes exceed its bound
+    the submit raises Rejected PROMPTLY (429 semantics) — no silent drop,
+    no wedged caller — and the shed counters say so."""
+    gate = threading.Event()
+
+    def slow_predict(p, b):
+        gate.wait(timeout=30.0)
+        return _predict(p, b)
+
+    srv = _server(export_dir, predict_fn=slow_predict, flush_ms=1.0,
+                  warmup=False,  # warm would stall on the gated forward
+                  max_pending_mb=4 * 16 / (1 << 20))  # ~4 single rows
+    try:
+        shed_before = obs.counter("online_shed_total").value
+        # first request is drained into a (stalled) forward; the next few
+        # sit pending until the byte bound trips
+        threads = []
+        results = []
+
+        def go():
+            try:
+                results.append(
+                    srv.submit("a", {"features": _rows(1)}, timeout=60.0))
+            except online.Rejected:
+                results.append("shed")
+
+        saw_shed = False
+        t0 = time.perf_counter()
+        for _ in range(12):
+            t = threading.Thread(target=go, daemon=True)
+            t.start()
+            threads.append(t)
+            time.sleep(0.02)
+        try:
+            srv.submit("a", {"features": _rows(1)}, timeout=0.5)
+        except online.Rejected as e:
+            saw_shed = True
+            assert e.retry_after_s > 0
+        assert time.perf_counter() - t0 < 20.0
+        gate.set()
+        for t in threads:
+            t.join(timeout=30.0)
+        shed = obs.counter("online_shed_total").value - shed_before
+        assert saw_shed or "shed" in results
+        assert shed >= 1
+        # nothing dropped silently: every caller either got an answer or
+        # an explicit rejection
+        assert len(results) == 12
+        for r in results:
+            assert r == "shed" or "score" in r
+    finally:
+        gate.set()
+        srv.stop()
+
+
+def test_per_tenant_isolation_backlog_cannot_starve_neighbor(export_dir):
+    """Tenant A floods its queue; tenant B's lone request must still ride
+    the next batches (round-robin drain) instead of waiting for A's
+    backlog to clear — and A's shed does not touch B's admission."""
+    busy = threading.Event()
+
+    def slowish_predict(p, b):
+        time.sleep(0.01)
+        busy.set()
+        return _predict(p, b)
+
+    srv = _server(export_dir, tenants=("a", "b"), batch_size=4,
+                  bucket_sizes=(4,), flush_ms=2.0, warmup=False,
+                  predict_fn=slowish_predict)
+    try:
+        stop_flood = threading.Event()
+        flooded = []
+
+        def flood():
+            while not stop_flood.is_set():
+                try:
+                    flooded.append(
+                        srv.submit("a", {"features": _rows(1)},
+                                   timeout=30.0))
+                except online.Rejected:
+                    time.sleep(0.002)
+
+        floods = [threading.Thread(target=flood, daemon=True)
+                  for _ in range(6)]
+        for t in floods:
+            t.start()
+        busy.wait(timeout=10.0)  # the backlog exists
+        t0 = time.perf_counter()
+        out = srv.submit("b", {"features": _rows(2, seed=7)}, timeout=30.0)
+        b_latency = time.perf_counter() - t0
+        stop_flood.set()
+        for t in floods:
+            t.join(timeout=30.0)
+        np.testing.assert_allclose(out["score"], _rows(2, seed=7) @ W,
+                                   rtol=1e-6)
+        # B's request rode within a few batch cycles (each ~12ms of
+        # forward), not behind A's entire backlog
+        assert b_latency < 5.0
+        assert len(flooded) > 0
+    finally:
+        srv.stop()
+
+
+def test_oversize_request_and_unknown_tenant_rejected(export_dir):
+    srv = _server(export_dir, batch_size=8)
+    try:
+        with pytest.raises(KeyError):
+            srv.submit("nope", {"features": _rows(1)})
+        with pytest.raises(ValueError, match="split it client-side"):
+            srv.submit("a", {"features": _rows(9)})
+        with pytest.raises(ValueError, match="unknown request field"):
+            srv.submit("a", {"features": _rows(1), "bogus": [1]})
+        with pytest.raises(ValueError, match="rows have shape"):
+            srv.submit("a", {"features": np.zeros((1, 7), np.float32)})
+    finally:
+        srv.stop()
+
+
+def test_specless_shape_mismatch_fails_batch_not_server(export_dir):
+    """Two spec-less requests with incompatible row shapes meeting in one
+    coalesced batch: BOTH callers get the error, and the server keeps
+    serving afterwards — an assembly error must never kill the coalescer
+    thread (that would wedge every future caller of every tenant)."""
+    srv = online.OnlineServer()
+    srv.add_tenant("a", export_dir=export_dir, predict_fn=_predict,
+                   batch_size=8, bucket_sizes=[8], flush_ms=100.0,
+                   input_mapping={"features": "features"}, warmup=False)
+    srv.start()
+    try:
+        outcomes = {}
+
+        def go(i, width):
+            try:
+                outcomes[i] = srv.submit(
+                    "a", {"features": np.zeros((1, width), np.float32)},
+                    timeout=15.0)
+            except RuntimeError as e:
+                outcomes[i] = e
+
+        threads = [threading.Thread(target=go, args=(0, 4)),
+                   threading.Thread(target=go, args=(1, 7))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert len(outcomes) == 2
+        errors = [v for v in outcomes.values()
+                  if isinstance(v, RuntimeError)]
+        # at least the mismatched partner fails (both, when coalesced)
+        assert errors, outcomes
+        # and the server survived: a well-formed request still works
+        x = _rows(1)
+        out = srv.submit("a", {"features": x}, timeout=15.0)
+        np.testing.assert_allclose(out["score"], x @ W, rtol=1e-6)
+    finally:
+        srv.stop()
+
+
+def test_different_output_mapping_gets_its_own_batches(export_dir):
+    """output_mapping is part of the coalescing identity: a tenant with a
+    different mapping must not inherit the first registrant's output
+    names by riding its group."""
+    srv = _server(export_dir, tenants=("a",))
+    srv.add_tenant("renamed", export_dir=export_dir, predict_fn=_predict,
+                   batch_size=8, bucket_sizes=[2, 8], flush_ms=10.0,
+                   input_mapping={"features": "features"},
+                   output_mapping={"score": "prob"},
+                   warmup_example={"features": np.zeros(4, np.float32)})
+    try:
+        x = _rows(1)
+        out_a = srv.submit("a", {"features": x}, timeout=15.0)
+        out_r = srv.submit("renamed", {"features": x}, timeout=15.0)
+        assert "score" in out_a
+        assert "prob" in out_r and "score" not in out_r
+        np.testing.assert_allclose(out_r["prob"], x @ W, rtol=1e-6)
+        assert srv.stats()["models_loaded"] == 2  # two groups, one model
+    finally:
+        srv.stop()
+
+
+def test_stop_fails_pending_requests_loudly(export_dir):
+    """stop() must wake every waiting caller with an error — a stopped
+    server with silently wedged callers is the failure mode the tier
+    exists to prevent."""
+    gate = threading.Event()
+
+    def stalled_predict(p, b):
+        gate.wait(timeout=30.0)
+        return _predict(p, b)
+
+    srv = _server(export_dir, predict_fn=stalled_predict, flush_ms=1.0,
+                  warmup=False)
+    try:
+        errors = []
+
+        def go():
+            try:
+                srv.submit("a", {"features": _rows(1)}, timeout=30.0)
+                errors.append(None)
+            except RuntimeError as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=go, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # let them queue / stage
+    finally:
+        gate.set()
+        srv.stop()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert len(errors) == 4
+    assert srv.state == "stopped"
+    with pytest.raises(RuntimeError, match="not serving"):
+        srv.submit("a", {"features": _rows(1)})
+
+
+def test_forward_error_propagates_to_every_caller(export_dir):
+    def broken_predict(p, b):
+        raise ValueError("kaboom")
+
+    srv = _server(export_dir, predict_fn=broken_predict, flush_ms=1.0,
+                  warmup=False)
+    try:
+        with pytest.raises(RuntimeError, match="kaboom"):
+            srv.submit("a", {"features": _rows(1)}, timeout=10.0)
+        assert obs.counter("online_errors_total").value >= 1
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# warm on load + compile accounting
+# ---------------------------------------------------------------------------
+
+
+def test_warm_on_load_precompiles_every_bucket(export_dir):
+    """Warm-on-load records one compile per bucket through note_compile
+    (compiles == jit keys invariant), and the first real request adds NO
+    new signature — it never pays the compile."""
+    compiles = obs.counter("serving_compiles_total")
+    c0 = compiles.value
+    srv = _server(export_dir, bucket_sizes=(2, 8), warmup=True)
+    try:
+        assert compiles.value - c0 == 2  # == len(buckets)
+        out = srv.submit("a", {"features": _rows(1)}, timeout=10.0)
+        np.testing.assert_allclose(out["score"], _rows(1) @ W, rtol=1e-6)
+        assert compiles.value - c0 == 2  # the request hit a warmed shape
+    finally:
+        srv.stop()
+
+
+def test_warmup_true_without_shapes_raises(tmp_path, export_dir):
+    srv = online.OnlineServer()
+    with pytest.raises(ValueError, match="warmup"):
+        srv.add_tenant("a", export_dir=export_dir, predict_fn=_predict,
+                       input_mapping={"features": "features"},
+                       warmup=True)
+
+
+def test_online_flight_plane_records_stages(export_dir):
+    srv = _server(export_dir, flush_ms=1.0)
+    rec = flight.recorder("online")
+    before = rec.batches
+    try:
+        for i in range(3):
+            srv.submit("a", {"features": _rows(1, seed=i)}, timeout=10.0)
+    finally:
+        srv.stop()
+    assert rec.batches > before
+    snap = rec.snapshot()
+    assert "wait" in snap["stages_s"] and "compute" in snap["stages_s"]
+    assert "reply" in snap["stages_s"]
+    # coalesce/pad ran on the coalescer thread, overlapped
+    assert "coalesce" in snap["overlapped_stages_s"]
+    # the new stages classify (not silently ignored as unknown)
+    assert flight.classify({"reply": 1.0}) == "emit_bound"
+    assert flight.classify({"coalesce": 1.0}) == "ingest_bound"
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end
+# ---------------------------------------------------------------------------
+
+
+def _post(url, doc, timeout=30.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def test_http_predict_metrics_healthz_pipeline(export_dir):
+    srv = _server(export_dir, flush_ms=2.0)
+    http = online.OnlineHTTPServer(srv)
+    http.start()
+    try:
+        x = _rows(2, seed=3)
+        status, doc = _post(http.url("/v1/predict"),
+                            {"tenant": "a", "inputs":
+                             {"features": x.tolist()}})
+        assert status == 200
+        assert doc["rows"] == 2
+        np.testing.assert_allclose(np.asarray(doc["outputs"]["score"]),
+                                   x @ W, rtol=1e-5)
+        assert doc["latency_ms"] > 0
+
+        # unknown tenant → 404; malformed → 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(http.url("/v1/predict"),
+                  {"tenant": "nope", "inputs": {"features": x.tolist()}})
+        assert ei.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(http.url("/v1/predict"), {"tenant": "a"})
+        assert ei.value.code == 400
+
+        with urllib.request.urlopen(http.url("/metrics"), timeout=10) as r:
+            text = r.read().decode()
+        assert "online_requests_total" in text
+        assert "online_request_seconds_a" in text
+        from tensorflowonspark_tpu.obs import httpd
+        assert httpd.validate_prometheus_text(text) == []
+
+        with urllib.request.urlopen(http.url("/healthz"), timeout=10) as r:
+            health = json.loads(r.read().decode())
+        assert r.status == 200
+        assert health["state"] == "serving"
+        assert "a" in health["tenants"]
+        assert health["tenants"]["a"]["latency_p99_ms"] is not None
+
+        with urllib.request.urlopen(http.url("/pipeline"),
+                                    timeout=10) as r:
+            pipe = json.loads(r.read().decode())
+        assert "online" in pipe["planes"]
+        assert pipe["planes"]["online"]["verdict"] in flight.VERDICTS
+    finally:
+        http.stop()
+        srv.stop()
+
+
+def test_http_shed_maps_to_429_with_retry_after(export_dir):
+    gate = threading.Event()
+
+    def slow_predict(p, b):
+        gate.wait(timeout=30.0)
+        return _predict(p, b)
+
+    srv = _server(export_dir, predict_fn=slow_predict, flush_ms=1.0,
+                  warmup=False, max_pending_mb=3 * 16 / (1 << 20))
+    http = online.OnlineHTTPServer(srv)
+    http.start()
+    try:
+        x = _rows(1)
+
+        def fire():
+            try:
+                _post(http.url("/v1/predict"),
+                      {"tenant": "a",
+                       "inputs": {"features": x.tolist()}})
+            except urllib.error.HTTPError:
+                pass
+
+        threads = [threading.Thread(target=fire, daemon=True)
+                   for _ in range(10)]
+        for t in threads:
+            t.start()
+            time.sleep(0.02)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(http.url("/v1/predict"),
+                  {"tenant": "a", "inputs": {"features": x.tolist()},
+                   "timeout_s": 1.0})
+        assert ei.value.code == 429
+        assert float(ei.value.headers["Retry-After"]) > 0
+        body = json.loads(ei.value.read().decode())
+        assert "shed" in body["error"]
+    finally:
+        gate.set()
+        http.stop()
+        srv.stop()
+
+
+def test_healthz_503_after_stop(export_dir):
+    srv = _server(export_dir)
+    http = online.OnlineHTTPServer(srv)
+    http.start()
+    try:
+        srv.stop()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(http.url("/healthz"), timeout=10)
+        assert ei.value.code == 503
+    finally:
+        http.stop()

@@ -366,3 +366,47 @@ def test_single_node_env_probes_serving_health(monkeypatch):
     pipeline.single_node_env()
     assert pipeline._SERVING_PROBED
     pipeline.single_node_env()  # no re-probe, returns immediately
+
+
+def test_tfmodel_warmup_precompiles_every_bucket(tmp_path):
+    """TFModel.warmup: one compile per bucket of the ladder, counted in
+    serving_compiles_total (compiles == jit keys invariant holds), and a
+    post-warmup transform pass over ragged partitions adds NO new
+    signature — the first real batch never pays the XLA compile."""
+    import jax
+
+    from tensorflowonspark_tpu import obs
+
+    export_dir, w = _export_linear(tmp_path)
+    fn = jax.jit(_linear_predict)
+    model = (TFModel(predict_fn=fn)
+             .setExportDir(export_dir)
+             .setBatchSize(8)
+             .setInputMapping({"x": "x"})
+             .setBucketSizes([4, 8]))
+    compiles = obs.counter("serving_compiles_total")
+    c0 = compiles.value
+    warmed = model.warmup(example={"x": np.zeros(6, np.float32)})
+    assert warmed == [4, 8]
+    assert compiles.value - c0 == 2  # == len(buckets), nothing else
+
+    # the warmed executables are what the data plane hits: scoring ragged
+    # partitions through the same model-cache entry adds no signature
+    rm = _serving_runner(export_dir, batch_size=8, bucket_sizes=[4, 8])
+    rows, feats = _feature_rows(11)
+    out = list(rm(iter(rows)))
+    assert len(out) == 11
+    np.testing.assert_allclose(
+        np.asarray([r["score"] for r in out]), feats @ w, rtol=1e-5,
+        atol=1e-6)
+    assert compiles.value - c0 == 2
+
+
+def test_tfmodel_warmup_needs_shapes(tmp_path):
+    """A weights-only export records no input shapes: warmup without an
+    example must fail loudly with guidance, not warm nothing silently."""
+    export_dir, _ = _export_linear(tmp_path)
+    model = (TFModel(predict_fn=_linear_predict)
+             .setExportDir(export_dir).setBatchSize(8))
+    with pytest.raises(ValueError, match="example"):
+        model.warmup()

@@ -352,3 +352,35 @@ def test_prefetch_depth_env(monkeypatch):
     assert serving.prefetch_depth() == 0
     monkeypatch.setenv("TFOS_SERVING_PREFETCH", "junk")
     assert serving.prefetch_depth() == 2
+
+
+# ---------------------------------------------------------------------------
+# Warmup shape helpers
+# ---------------------------------------------------------------------------
+
+
+def test_input_specs_from_example_and_signature():
+    specs = serving.input_specs(
+        example={"features": np.zeros(4, np.float32), "id": np.int32(0)})
+    assert specs["features"] == ((4,), np.dtype(np.float32))
+    assert specs["id"] == ((), np.dtype(np.int32))
+    specs = serving.input_specs(signature={"inputs": [
+        {"name": "features", "shape": [None, 6], "dtype": "float32"}]})
+    assert specs["features"] == ((6,), np.dtype(np.float32))
+    batch = serving.zero_batch(specs, 8)
+    assert batch["features"].shape == (8, 6)
+    assert batch["features"].dtype == np.float32
+
+
+def test_input_specs_polymorphic_nonbatch_dim_is_value_error():
+    """A symbolic NON-batch dim (variable seq len) must raise the
+    actionable ValueError — not TypeError from int(None) — so callers'
+    except-ValueError fallbacks (online add_tenant) degrade gracefully."""
+    with pytest.raises(ValueError, match="polymorphic non-batch"):
+        serving.input_specs(signature={"inputs": [
+            {"name": "tokens", "shape": [None, None, 64],
+             "dtype": "float32"}]})
+    with pytest.raises(ValueError, match="exactly one"):
+        serving.input_specs()
+    with pytest.raises(ValueError, match="no inputs"):
+        serving.input_specs(signature={"inputs": []})

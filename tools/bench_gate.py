@@ -45,7 +45,14 @@ membership) the primary half must carry ``recovery_seconds`` (SIGKILL →
 first post-restore step; explicit ``null`` + ``recovery_reason`` allowed);
 recovery is a latency, so a healthy number is regression-judged LOWER-is-
 better against the best (minimum) prior run with the same cluster /
-checkpoint-cadence / kill config.
+checkpoint-cadence / kill config.  From round ``--require-online-from``
+(default 11, the round that introduced the continuous-batching online
+serving tier) the primary half must carry ``online_rows_per_sec`` with its
+p99-bound config identity — a closed-loop throughput is only meaningful AT
+its measured p99, so a numeric value must ship ``online_p99_ms`` within
+``online_slo_ms`` (or explicit ``null`` + ``online_reason``); healthy
+numbers are only compared across runs with the same client count, model
+geometry, bucket ladder and SLO.
 
 Usage::
 
@@ -85,6 +92,9 @@ DEFAULT_REQUIRE_FLIGHT_FROM = 9
 #: first round whose primary half must carry the elastic recovery-time
 #: microbench (``recovery_seconds``, introduced with elastic membership)
 DEFAULT_REQUIRE_RECOVERY_FROM = 10
+#: first round whose primary half must carry the online-serving microbench
+#: (``online_rows_per_sec``, introduced with the continuous-batching tier)
+DEFAULT_REQUIRE_ONLINE_FROM = 11
 #: |stage_sum / wall - 1| beyond this fails the artifact: a breakdown that
 #: does not add up is decoration, not attribution
 DEFAULT_FLIGHT_TOLERANCE = 0.15
@@ -101,6 +111,16 @@ _RECOVERY_KEY = "recovery_seconds"
 _RECOVERY_IDENT_KEYS = ("recovery_num_executors",
                         "recovery_ckpt_every_steps",
                         "recovery_kill_at_step", "recovery_batch_size")
+_ONLINE_KEY = "online_rows_per_sec"
+#: the online microbench's config identity: closed-loop rows/sec is only
+#: comparable at the same client count / request volume / model geometry /
+#: bucket ladder AND the same p99 SLO — a number sustained at a looser
+#: SLO is a different experiment (that is the whole point of quoting
+#: throughput AT an SLO)
+_ONLINE_IDENT_KEYS = ("online_clients", "online_rows_total",
+                      "online_batch_size", "online_feature_dim",
+                      "online_hidden_dim", "online_slo_ms",
+                      "online_flush_ms", "online_bucket_sizes")
 #: the serving microbench's config identity: runs are only regression-
 #: compared within the same ingest representation AND bucket geometry —
 #: rows/sec across different bucket sets (or arrow- vs row-shaped
@@ -111,7 +131,8 @@ _SERVE_IDENT_KEYS = ("serve_ingest", "serve_rows_total", "serve_batch_size",
 #: healthy metric value must carry its stage decomposition; a null metric
 #: (already explained by its reason field) owes none
 _FLIGHT_BREAKDOWNS = ((_FEED_KEY, "feed_stage_breakdown"),
-                      (_SERVE_KEY, "serve_stage_breakdown"))
+                      (_SERVE_KEY, "serve_stage_breakdown"),
+                      (_ONLINE_KEY, "online_stage_breakdown"))
 
 
 def validate_breakdown(half: dict[str, Any], metric_key: str,
@@ -214,7 +235,8 @@ def validate_half(half: dict[str, Any], *,
                   require_roofline: bool,
                   require_feed: bool = False,
                   require_serving: bool = False,
-                  require_recovery: bool = False) -> list[str]:
+                  require_recovery: bool = False,
+                  require_online: bool = False) -> list[str]:
     """Schema problems of one measured result (a wrapper's half)."""
     problems = []
     for key in _REQUIRED_HALF_KEYS:
@@ -290,6 +312,40 @@ def validate_half(half: dict[str, Any], *,
                     f"{_RECOVERY_KEY!r} without its config identity "
                     f"({', '.join(missing)}) — recovery times are only "
                     "comparable within one cluster/cadence/kill config")
+    # online-serving microbench (continuous-batching tier): host-side like
+    # the others — required on primary from r11 even on degraded rounds;
+    # null + 'online_reason' always satisfies.  A numeric value must carry
+    # its p99-bound config identity AND prove the SLO was met — a rows/sec
+    # sustained at an SLO the run missed is not a measurement
+    if require_online or _ONLINE_KEY in half:
+        if _ONLINE_KEY not in half:
+            problems.append(
+                f"missing {_ONLINE_KEY!r} (online-serving microbench is "
+                "part of the schema from r11: measure it or stamp an "
+                "explicit null + 'online_reason')")
+        elif half[_ONLINE_KEY] is None and "online_reason" not in half:
+            problems.append(
+                f"{_ONLINE_KEY!r} is null without an 'online_reason'")
+        elif isinstance(half.get(_ONLINE_KEY), (int, float)):
+            missing = [k for k in _ONLINE_IDENT_KEYS if k not in half]
+            if missing:
+                problems.append(
+                    f"{_ONLINE_KEY!r} without its config identity "
+                    f"({', '.join(missing)}) — closed-loop rows/sec is "
+                    "only comparable within one client/geometry/SLO "
+                    "config")
+            p99 = half.get("online_p99_ms")
+            slo = half.get("online_slo_ms")
+            if not isinstance(p99, (int, float)):
+                problems.append(
+                    f"{_ONLINE_KEY!r} without its measured "
+                    "'online_p99_ms' — the number is only meaningful AT "
+                    "its p99")
+            elif isinstance(slo, (int, float)) and p99 > slo:
+                problems.append(
+                    f"online_p99_ms {p99} exceeds online_slo_ms {slo}: a "
+                    "throughput claimed at an SLO it missed is not a "
+                    "measurement")
     return problems
 
 
@@ -347,6 +403,16 @@ def _comparable_prior_serving(artifacts: list[dict], newest: dict,
                                       _SERVE_KEY, _SERVE_IDENT_KEYS)
 
 
+def _comparable_prior_online(artifacts: list[dict], newest: dict,
+                             half: dict) -> tuple[float, str] | None:
+    """Best prior ``online_rows_per_sec`` under the same client count,
+    model geometry, bucket ladder and p99 SLO (``_ONLINE_IDENT_KEYS``).
+    Host-side like the other microbenches: degraded-accelerator priors
+    still count."""
+    return _comparable_prior_hostside(artifacts, newest, half,
+                                      _ONLINE_KEY, _ONLINE_IDENT_KEYS)
+
+
 def _comparable_prior_recovery(artifacts: list[dict], newest: dict,
                                half: dict) -> tuple[float, str] | None:
     """Best (i.e. LOWEST — recovery is a latency) prior
@@ -390,7 +456,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
          require_serving_from: int = DEFAULT_REQUIRE_SERVING_FROM,
          require_flight_from: int = DEFAULT_REQUIRE_FLIGHT_FROM,
          flight_tolerance: float = DEFAULT_FLIGHT_TOLERANCE,
-         require_recovery_from: int = DEFAULT_REQUIRE_RECOVERY_FROM
+         require_recovery_from: int = DEFAULT_REQUIRE_RECOVERY_FROM,
+         require_online_from: int = DEFAULT_REQUIRE_ONLINE_FROM
          ) -> dict[str, Any]:
     """Run the gate over a trajectory; returns the verdict document."""
     checks: list[dict[str, Any]] = []
@@ -432,10 +499,13 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                           and art["n"] >= require_serving_from)
             require_rc = (label == "primary"
                           and art["n"] >= require_recovery_from)
+            require_on = (label == "primary"
+                          and art["n"] >= require_online_from)
             for problem in validate_half(half, require_roofline=require_rf,
                                          require_feed=require_fd,
                                          require_serving=require_sv,
-                                         require_recovery=require_rc):
+                                         require_recovery=require_rc,
+                                         require_online=require_on):
                 check(f"schema:{name}:{label}",
                       "fail" if is_newest else "warn", problem)
             # flight breakdowns ride the primary half with the microbench
@@ -495,6 +565,27 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                           f"{sval} is {round(sval / sprior[0], 4)}× best "
                           f"prior {sprior[0]} ({sprior[1]}) — the serving "
                           f"data plane regressed below {threshold}")
+            # online-serving microbench: host-side, judged before the
+            # degraded skip like the feed/serving ones
+            if isinstance(half.get(_ONLINE_KEY), (int, float)):
+                oprior = _comparable_prior_online(artifacts, newest, half)
+                oname = f"regression:{_ONLINE_KEY}"
+                oval = float(half[_ONLINE_KEY])
+                if oprior is None:
+                    check(oname, "pass",
+                          "no comparable prior online measurement (same "
+                          "clients + geometry + SLO) — nothing to "
+                          "regress against")
+                elif oval >= threshold * oprior[0]:
+                    check(oname, "pass",
+                          f"{oval} vs best prior {oprior[0]} "
+                          f"({oprior[1]}): ratio "
+                          f"{round(oval / oprior[0], 4)} ≥ {threshold}")
+                else:
+                    check(oname, "fail",
+                          f"{oval} is {round(oval / oprior[0], 4)}× best "
+                          f"prior {oprior[0]} ({oprior[1]}) — the online "
+                          f"tier regressed below {threshold}")
             # recovery microbench: host-side, judged before the degraded
             # skip too.  LOWER is better (it is a latency): the newest run
             # fails when it exceeds the best comparable prior by more than
@@ -599,6 +690,8 @@ def main(argv: list[str] | None = None) -> int:
                    default=DEFAULT_FLIGHT_TOLERANCE)
     p.add_argument("--require-recovery-from", type=int,
                    default=DEFAULT_REQUIRE_RECOVERY_FROM)
+    p.add_argument("--require-online-from", type=int,
+                   default=DEFAULT_REQUIRE_ONLINE_FROM)
     args = p.parse_args(argv)
     paths = args.paths or discover(args.repo)
     if not paths:
@@ -612,7 +705,8 @@ def main(argv: list[str] | None = None) -> int:
                require_serving_from=args.require_serving_from,
                require_flight_from=args.require_flight_from,
                flight_tolerance=args.flight_tolerance,
-               require_recovery_from=args.require_recovery_from)
+               require_recovery_from=args.require_recovery_from,
+               require_online_from=args.require_online_from)
     print(json.dumps(doc))
     return 1 if doc["verdict"] == "fail" else 0
 
