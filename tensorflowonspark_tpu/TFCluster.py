@@ -397,6 +397,10 @@ class TFCluster:
 
         tracer = obs.get_tracer()
         by_node: dict[str, list[dict]] = {tracer.node: tracer.snapshot()}
+        # retained request traces (tail-sampled span trees: SLO breaches,
+        # sheds, errors + the uniform sample) merge into the same
+        # timeline — their spans carry trace ids into the Chrome args
+        by_node[tracer.node].extend(obs.get_trace_store().events())
         authkey = bytes.fromhex(self.cluster_meta["authkey_hex"])
         for meta in self.cluster_info:
             name = f"{meta['job_name']}:{meta['task_index']}"
@@ -505,8 +509,13 @@ class TFCluster:
         report["stall_events"] = []
         if scan_traces:
             try:
+                events_by_node = self._trace_events_by_node()
                 report["stall_events"] = anomaly.stall_events(
-                    self._trace_events_by_node())
+                    events_by_node)
+                # step-scoped trace ids: a straggler/stall finding cites
+                # the exact step windows it judged (trainer.step spans),
+                # addressable by id in the merged Chrome trace
+                anomaly.cite_step_traces(report, events_by_node)
             except Exception as e:
                 logger.warning("stall-event collection failed: %s", e)
         for s in report["stragglers"]:
@@ -716,7 +725,10 @@ class TFCluster:
         :meth:`dump_trace` content, served live),
         ``/pipeline`` → JSON from :meth:`pipeline_report` (per-node stage
         time attribution + bottleneck verdicts + live queue/shm
-        residency).  The returned server exposes ``.port`` /
+        residency),
+        ``/debug/requests`` → the driver process's retained request
+        traces (tail-sampled span trees, slowest-first).
+        The returned server exposes ``.port`` /
         ``.url(path)`` / ``.stop()``; it is stopped automatically by
         :meth:`shutdown`.
         """
@@ -746,6 +758,12 @@ class TFCluster:
             return (200, "application/json",
                     _json.dumps(self.pipeline_report()))
 
+        def _debug_requests():
+            # the driver's own retained request traces (tail-sampled) —
+            # same body shape as the online tier's /debug/requests
+            return (200, "application/json",
+                    _json.dumps(obs.get_trace_store().to_doc()))
+
         if self._obs_server is not None:
             # re-serving (e.g. to move ports) must not leak the previous
             # listener thread + socket until process exit
@@ -756,11 +774,12 @@ class TFCluster:
             self._obs_server = None
         server = httpd.ObservabilityServer(
             {"/metrics": _metrics, "/healthz": _healthz, "/trace": _trace,
-             "/pipeline": _pipeline},
+             "/pipeline": _pipeline, "/debug/requests": _debug_requests},
             host=host, port=port)
         addr = server.start()
         logger.info("observability endpoint serving on http://%s:%s "
-                    "(/metrics /healthz /trace /pipeline)", *addr)
+                    "(/metrics /healthz /trace /pipeline /debug/requests)",
+                    *addr)
         self._obs_server = server
         return server
 

@@ -61,6 +61,7 @@ from tensorflowonspark_tpu.obs import (  # noqa: F401
     flight,
     httpd,
     roofline,
+    trace,
 )
 from tensorflowonspark_tpu.obs.registry import (  # noqa: F401
     Counter,
@@ -73,24 +74,37 @@ from tensorflowonspark_tpu.obs.registry import (  # noqa: F401
     histogram,
     merge_snapshots,
     merged_to_prometheus,
+    snapshot_to_openmetrics,
     snapshot_to_prometheus,
 )
 from tensorflowonspark_tpu.obs.trace import (  # noqa: F401
     TRACE_KV_PREFIX,
+    RequestTrace,
+    TraceContext,
+    TraceStore,
     Tracer,
     collect_blackboard,
     configure,
     event,
     flush,
+    format_traceparent,
+    get_trace_store,
     get_tracer,
+    parse_traceparent,
     span,
+    trace_context,
+    with_context,
 )
 
 __all__ = [
-    "anomaly", "chrome", "flight", "httpd", "roofline",
+    "anomaly", "chrome", "flight", "httpd", "roofline", "trace",
     "Counter", "Gauge", "Histogram", "Registry",
     "counter", "gauge", "histogram", "get_registry",
     "merge_snapshots", "merged_to_prometheus", "snapshot_to_prometheus",
+    "snapshot_to_openmetrics",
     "TRACE_KV_PREFIX", "Tracer", "collect_blackboard", "configure",
     "event", "flush", "get_tracer", "span",
+    "TraceContext", "RequestTrace", "TraceStore", "get_trace_store",
+    "parse_traceparent", "format_traceparent", "trace_context",
+    "with_context",
 ]

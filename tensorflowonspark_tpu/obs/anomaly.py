@@ -47,6 +47,9 @@ STEP_HISTOGRAM = "trainer_step_seconds"
 LAST_STEP_GAUGE = "trainer_last_step_unix_ts"
 #: trace event name the StepWatchdog emits before hard-exiting
 STALL_EVENT = "health.step_stall"
+#: span name the trainer records per completed step, carrying the
+#: step-scoped trace id findings cite
+STEP_SPAN = "trainer.step"
 
 
 def hist_quantile(buckets: list, q: float) -> float | None:
@@ -164,6 +167,42 @@ def detect(agg: dict[str, Any], *, factor: float = 1.75,
                                 "last_step_ts": ts})
     return {"stragglers": stragglers, "stalled": stalled,
             "quantiles": quantiles, "num_nodes": len(quantiles)}
+
+
+def recent_step_traces(events_by_node: dict[str, list[dict]],
+                       limit: int = 3) -> dict[str, list[str]]:
+    """Per-node step-scoped trace ids, newest first.
+
+    The trainer records each completed step's window as a
+    ``trainer.step`` span under its own trace id (shipped with the rest
+    of the ring buffer); the last few per node are the *citable* evidence
+    a straggler/stall finding attaches — the exact step windows that were
+    judged, addressable in the merged Chrome trace by id.
+    """
+    out: dict[str, list[str]] = {}
+    for node, events in sorted((events_by_node or {}).items()):
+        ids = [ev.get("trace_id") for ev in events
+               if ev.get("name") == STEP_SPAN and ev.get("trace_id")]
+        if ids:
+            out[node] = ids[-limit:][::-1]
+    return out
+
+
+def cite_step_traces(report: dict[str, Any],
+                     events_by_node: dict[str, list[dict]],
+                     limit: int = 3) -> dict[str, Any]:
+    """Attach ``step_trace_ids`` to each straggler/stalled finding whose
+    node shipped ``trainer.step`` spans — the finding then names not just
+    *who* is slow but *which step windows* to pull up.  Mutates and
+    returns ``report``; nodes without shipped step spans are untouched
+    (absence of evidence is not an error)."""
+    ids = recent_step_traces(events_by_node, limit=limit)
+    for kind in ("stragglers", "stalled"):
+        for finding in report.get(kind) or []:
+            tids = ids.get(finding.get("node"))
+            if tids:
+                finding["step_trace_ids"] = tids
+    return report
 
 
 def stall_events(events_by_node: dict[str, list[dict]]) -> list[dict]:

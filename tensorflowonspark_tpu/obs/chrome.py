@@ -61,7 +61,13 @@ def merge(events_by_node: dict[str, list[dict[str, Any]]]) -> dict[str, Any]:
                 row["s"] = "t"  # thread-scoped instant
             attrs = ev.get("attrs")
             if attrs:
-                row["args"] = attrs
+                row["args"] = dict(attrs)
+            # trace identity rides into the Chrome args so a finding's
+            # cited trace_id is searchable in the viewer (copied, never
+            # mutating the source event)
+            for field in ("trace_id", "span_id", "parent_span_id"):
+                if ev.get(field):
+                    row.setdefault("args", {})[field] = ev[field]
             rows.append(row)
     rows.sort(key=lambda r: (r["ts"], r["pid"], r["tid"], r["name"]))
     return {"traceEvents": out + rows, "displayTimeUnit": "ms"}

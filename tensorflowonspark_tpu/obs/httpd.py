@@ -33,6 +33,10 @@ logger = logging.getLogger(__name__)
 
 #: content type for Prometheus text exposition format
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+#: content type for the OpenMetrics flavor (exemplar-capable) — served
+#: when the scraper's Accept header asks for it
+OPENMETRICS_CONTENT_TYPE = ("application/openmetrics-text; version=1.0.0; "
+                            "charset=utf-8")
 
 Route = Callable[[], tuple[int, str, Any]]
 
@@ -115,7 +119,23 @@ class ObservabilityServer:
             self._thread = None
 
 
-def validate_prometheus_text(text: str) -> list[str]:
+def _split_exemplar(line: str) -> tuple[str, str | None]:
+    """Split a sample line from its optional exemplar annotation.
+
+    The separator is `` # {`` OUTSIDE any quoted label value — a sample
+    like ``m{path="/a # b"} 1`` (or a value containing `` # {``) must
+    not be mis-split into a bogus exemplar.
+    """
+    i = line.find(" # {")
+    while i >= 0:
+        if line.count('"', 0, i) % 2 == 0:  # even quotes = outside values
+            return line[:i].rstrip(), line[i + 3:]
+        i = line.find(" # {", i + 1)
+    return line, None
+
+
+def validate_prometheus_text(text: str, *,
+                             openmetrics: bool = False) -> list[str]:
     """Schema-check Prometheus text exposition; returns problems.
 
     The ``tools/check_trace.py``-style gate for the ``/metrics`` route:
@@ -123,6 +143,12 @@ def validate_prometheus_text(text: str) -> list[str]:
     ``# TYPE`` names a known type, no metric family gets two TYPE lines
     (the text-format violation scrapers reject), and every sample's family
     was declared.  Empty exposition is valid (no instruments yet).
+
+    Exemplar annotations (`` # {trace_id="..."} value [ts]``) are
+    accepted on ``_bucket`` sample lines in either mode and validated for
+    syntax; ``openmetrics=True`` additionally requires the terminal
+    ``# EOF`` line (and nothing after it) — use
+    :func:`validate_openmetrics_text` for that entry point.
     """
     import re
 
@@ -130,11 +156,20 @@ def validate_prometheus_text(text: str) -> list[str]:
     typed: dict[str, str] = {}
     sample_re = re.compile(
         r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+    exemplar_re = re.compile(
+        r"^\{([a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""
+        r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*)?\}"
+        r"\s+(\S+)(\s+\S+)?$")
+    saw_eof = False
     for i, line in enumerate(text.splitlines()):
         line = line.rstrip()
         if not line:
             continue
         where = f"line {i + 1}"
+        if saw_eof:
+            problems.append(f"{where}: content after the '# EOF' "
+                            "terminator")
+            break
         if line.startswith("#"):
             parts = line.split()
             if len(parts) >= 2 and parts[1] == "TYPE":
@@ -149,7 +184,10 @@ def validate_prometheus_text(text: str) -> list[str]:
                         f"{where}: duplicate TYPE for {name} "
                         "(one family, one TYPE line)")
                 typed[name] = parts[3]
+            elif line == "# EOF":
+                saw_eof = True
             continue
+        line, exemplar = _split_exemplar(line)
         m = sample_re.match(line)
         if not m:
             problems.append(f"{where}: unparseable sample {line!r}")
@@ -170,4 +208,29 @@ def validate_prometheus_text(text: str) -> list[str]:
         if base not in typed:
             problems.append(f"{where}: sample {name!r} has no TYPE "
                             "declaration")
+        if exemplar is not None:
+            if not name.endswith("_bucket"):
+                problems.append(
+                    f"{where}: exemplar on a non-bucket sample {name!r}")
+            em = exemplar_re.match(exemplar)
+            if not em:
+                problems.append(
+                    f"{where}: malformed exemplar {exemplar!r}")
+            else:
+                try:
+                    float(em.group(2))
+                except ValueError:
+                    problems.append(
+                        f"{where}: non-numeric exemplar value "
+                        f"{em.group(2)!r}")
+    if openmetrics and not saw_eof:
+        problems.append("missing the terminal '# EOF' line (OpenMetrics "
+                        "requires it)")
     return problems
+
+
+def validate_openmetrics_text(text: str) -> list[str]:
+    """Schema-check the OpenMetrics flavor: everything
+    :func:`validate_prometheus_text` checks, plus exemplar syntax and the
+    mandatory terminal ``# EOF``."""
+    return validate_prometheus_text(text, openmetrics=True)

@@ -41,11 +41,37 @@ single-process (scale out = run more of them behind any TCP balancer):
   ``Retry-After`` hint) — never a silent drop, never a wedged caller.
 - **Observability**: ``online_requests_total`` / ``online_rows_total`` /
   ``online_shed_total`` counters, an ``online_coalesce_size`` histogram,
-  and per-tenant latency histograms (``online_request_seconds_<tenant>``,
-  p50/p99 derivable from the buckets) in the ``obs`` registry — on any
-  ``/metrics`` exposition; a ``FlightRecorder`` plane ``"online"``
+  and per-tenant latency histograms — first-class Prometheus labels
+  (``online_request_seconds{tenant="..."}``; the round-11 name-mangled
+  ``online_request_seconds_<tenant>`` series still dual-published for one
+  round, then gone) in the ``obs`` registry — on any ``/metrics``
+  exposition; a ``FlightRecorder`` plane ``"online"``
   (``wait``/``coalesce``/``pad``/``compute``/``reply``) with bottleneck
-  verdicts on ``/pipeline``; server + per-tenant state on ``/healthz``.
+  verdicts on ``/pipeline``; server + per-tenant state (including the
+  last-window shed *rate*, not just the lifetime counter) on
+  ``/healthz``.
+- **Request-scoped tracing** (ISSUE 10 tentpole): every request carries a
+  span tree — ``admission`` (validate + byte-bound decision), ``queue``
+  (enqueue → drain), ``coalesce`` (batch id, bucket, flush trigger,
+  pad-waste share, batch-mate trace ids — batch-level causality: a victim
+  request's trace names the batch that delayed it and who filled it),
+  ``forward`` and ``reply`` — stitched across the coalescer/compute
+  thread hops by explicit :class:`~tensorflowonspark_tpu.obs.trace
+  .TraceContext` propagation (a ``traceparent`` header on ``POST
+  /v1/predict`` joins the caller's distributed trace).  Tail-based
+  sampling: complete trees are retained only for SLO breaches, sheds,
+  errors and timeouts, plus a small uniform sample
+  (``TFOS_TRACE_SAMPLE``); everything else is dropped at commit.
+  Retained traces serve on ``GET /debug/requests`` (slowest-first) and
+  their trace ids ride the tenant latency histogram as OpenMetrics
+  exemplars — the p99 a dashboard alerts on links straight to a retained
+  trace.  Capture itself is budgeted: requests carrying an inbound
+  context always arm, sheds/invalid requests are always captured on
+  their cold paths, and the uniform population arms at
+  ``TFOS_TRACE_ARM`` (default 0.05 — arming every request is measurably
+  expensive on a GIL-bound server; set 1.0 for full capture).
+  ``TFOS_TRACE_REQUESTS=0`` opts out entirely (the bench A/B measures
+  the default configuration's cost as ``trace_overhead_frac``).
 - **Warm on load** (ROADMAP item 4 slice): a tenant with known input
   shapes (a self-describing export's signature, or ``warmup_example=``)
   pre-compiles every bucket shape at :meth:`~OnlineServer.add_tenant`
@@ -66,7 +92,9 @@ from round 11.
 from __future__ import annotations
 
 import collections
+import itertools
 import logging
+import os
 import queue as _queue_mod
 import re
 import threading
@@ -75,7 +103,45 @@ from typing import Any, Callable, Mapping, Sequence
 
 import numpy as np
 
+from tensorflowonspark_tpu.obs import trace as _trace
+
 logger = logging.getLogger(__name__)
+
+# hot-path bindings: under a loaded closed loop every Python function
+# call on the per-request path costs µs (measured — call overhead plus
+# cache pressure dominate the tracing A/B), so the submit/compute loops
+# inline these instead of calling through the trace module
+_env_get = os.environ.get
+_rng_random = _trace._ID_RNG.random
+_TRACER = _trace.get_tracer()
+
+# lazy trace identity: the hot path stamps only an atomic sequence
+# number (`next` on a count() is one C call); the 32-hex trace id
+# derives DETERMINISTICALLY from (process nonce, seq) at first use —
+# materialization, batch-mate listing, failure paths — so two racing
+# derivations compute the same id and the common dropped request never
+# pays id minting at all.  Inbound-traceparent requests carry their
+# caller's id instead and skip derivation.
+_TRACE_SEQ = itertools.count(1)
+_TRACE_NONCE = os.urandom(16)
+
+
+def _trace_id_of(req: "_Request") -> str:
+    tid = req.trace_id
+    if tid is None:
+        import hashlib
+
+        tid = hashlib.blake2b(
+            req.trace_seq.to_bytes(8, "little"), digest_size=16,
+            key=_TRACE_NONCE).hexdigest()
+        req.trace_id = tid  # racing derivations agree: benign
+    return tid
+
+
+#: settles the (rare) finish races — compute-thread reply vs caller
+#: timeout vs stop/fail.  One module lock instead of a per-request
+#: token object: claims happen only on retained/failed paths.
+_CLAIM_LOCK = threading.Lock()
 
 #: request-latency histogram bounds: SLO-grade resolution (the registry
 #: default bottoms out at 1 ms — too coarse for sub-10ms online targets)
@@ -93,6 +159,18 @@ DEFAULT_MAX_PENDING_MB = 64.0
 #: default flush deadline, ms: the latency the coalescer may spend waiting
 #: for batch-mates (the queueing half of the SLO; compute rides on top)
 DEFAULT_FLUSH_MS = 10.0
+#: default per-tenant SLO when ``add_tenant(slo_ms=...)`` is not given:
+#: this multiple of the tenant's flush deadline (queueing budget × this
+#: headroom for compute + scatter).  The SLO drives tail-based trace
+#: retention: a request over it keeps its complete span tree.
+DEFAULT_SLO_FLUSH_FACTOR = 10.0
+#: tumbling-interval length of the per-tenant shed-rate window surfaced
+#: on ``/healthz`` (the window covers the current + previous interval,
+#: so 30s intervals report over the last 30-60s)
+SHED_WINDOW_INTERVAL_S = 30.0
+#: batch-mate trace ids listed per coalesce span before truncation (the
+#: full member count always rides ``batch_requests``)
+_MAX_BATCH_MATES = 16
 
 _STOP = object()
 
@@ -130,26 +208,137 @@ def _canon(a: np.ndarray) -> np.ndarray:
 
 
 class _Request:
-    """One caller's in-flight request: columns in, sliced results out."""
+    """One caller's in-flight request: columns in, sliced results out.
+
+    Trace state is RAW FIELDS, not a span tree: ``trace_id`` (shared with
+    batch-mates and echoed to ``traceparent`` callers, None when
+    ``TFOS_TRACE_REQUESTS=0``), the inbound context, the admission
+    window, and the shared :class:`_BatchTrace` the request rode.  The
+    :class:`~tensorflowonspark_tpu.obs.trace.RequestTrace` tree
+    materializes RETROACTIVELY (:func:`_build_trace`) only for the
+    retained minority — an A/B measured eager per-request span objects at
+    10-20% of closed-loop throughput on this class of box; raw slot
+    writes are what the hot path can afford.  :meth:`claim_trace`
+    settles the finish race (compute-thread reply vs caller-side
+    timeout): exactly one side claims and commits.
+    """
 
     __slots__ = ("tenant", "cols", "rows", "nbytes", "enqueued", "deadline",
-                 "event", "result", "error")
+                 "event", "result", "error", "trace_id", "inbound",
+                 "t0_perf", "trace_seq", "trace_claimed", "admission_dur",
+                 "admission_attrs", "batch")
 
     def __init__(self, tenant: "_Tenant", cols: dict, rows: int,
-                 nbytes: int, deadline: float):
+                 nbytes: int, deadline: float,
+                 enqueued: float | None = None):
         self.tenant = tenant
         self.cols = cols
         self.rows = rows
         self.nbytes = nbytes
-        self.enqueued = time.perf_counter()
+        self.enqueued = (time.perf_counter() if enqueued is None
+                         else enqueued)
         self.deadline = deadline
         self.event = threading.Event()
         self.result: dict | None = None
         self.error: BaseException | None = None
+        self.trace_id: str | None = None
+        self.inbound = None
+        #: non-zero ⇔ the request is traced (the hot-path marker)
+        self.t0_perf = 0.0
+        self.trace_seq = 0
+        self.trace_claimed = False
+        self.admission_dur: float | None = None
+        self.admission_attrs: dict | None = None
+        self.batch: "_BatchTrace | None" = None
+
+    def claim_trace(self) -> bool:
+        """Claim the (rare) right to finish+commit this request's trace —
+        arbitration between a compute-thread reply, a caller-side
+        timeout, and stop/fail, under one module lock (claims only
+        happen on retained/failed paths, never per request)."""
+        if not self.t0_perf:
+            return False
+        with _CLAIM_LOCK:
+            if self.trace_claimed:
+                return False
+            self.trace_claimed = True
+            return True
 
     def fail(self, err: BaseException) -> None:
         self.error = err
+        if self.claim_trace():
+            status = "shed" if isinstance(err, Rejected) else "error"
+            rt = _build_trace(self)
+            rt.finish(status=status,
+                      error=f"{type(err).__name__}: {err}"[:300])
+            # failures are always tail-retained: they are exactly the
+            # requests an operator will come asking about
+            _trace.get_trace_store().commit(rt, retain=status)
         self.event.set()
+
+
+def _build_trace(req: _Request) -> "_trace.RequestTrace":
+    """Materialize a request's span tree from its raw fields — called
+    only on the retained path (tail signal or sample win), never per
+    request on the hot path.  The wall-clock anchor derives from the
+    perf timestamps (one time.time here instead of one per request)."""
+    t0_wall = time.time() - (time.perf_counter() - req.t0_perf)
+    rt = _trace.RequestTrace(
+        "online.request", ctx=req.inbound, trace_id=_trace_id_of(req),
+        started=(t0_wall, req.t0_perf), tenant=req.tenant.name)
+    if req.admission_dur is not None:
+        rt.add("admission", req.admission_dur,
+               end_wall=t0_wall + req.admission_dur,
+               **(req.admission_attrs
+                  or {"outcome": "admitted", "rows": req.rows,
+                      "request_bytes": req.nbytes}))
+    bt = req.batch
+    if bt is not None:
+        rt.add_lazy(lambda bt=bt, tid=req.trace_id,
+                    enq=req.enqueued: bt.spans_for(tid, enq))
+    return rt
+
+
+class _ShedWindow:
+    """Tumbling two-interval offered/shed window — the ``/healthz``
+    shed-*rate* view (admission pressure NOW, not the lifetime counter).
+
+    Constant memory: the current and previous ``interval_s`` buckets;
+    :meth:`snapshot` reports over both, so the window covers the last
+    1-2 intervals.  Callers hold the server lock, so no lock here.
+    """
+
+    __slots__ = ("interval_s", "_idx", "_cur", "_prev")
+
+    def __init__(self, interval_s: float = SHED_WINDOW_INTERVAL_S):
+        self.interval_s = float(interval_s)
+        self._idx = 0
+        self._cur = [0, 0]  # offered, shed
+        self._prev = [0, 0]
+
+    def _roll(self, now: float) -> None:
+        idx = int(now / self.interval_s)
+        if idx != self._idx:
+            self._prev = self._cur if idx == self._idx + 1 else [0, 0]
+            self._cur = [0, 0]
+            self._idx = idx
+
+    def note(self, shed: bool, now: float | None = None) -> None:
+        self._roll(time.time() if now is None else now)
+        self._cur[0] += 1
+        if shed:
+            self._cur[1] += 1
+
+    def snapshot(self, now: float | None = None) -> dict[str, Any]:
+        now = time.time() if now is None else now
+        self._roll(now)
+        offered = self._prev[0] + self._cur[0]
+        shed = self._prev[1] + self._cur[1]
+        covered = self.interval_s + (now % self.interval_s)
+        return {"window_s": round(covered, 1),
+                "offered": offered,
+                "shed": shed,
+                "shed_rate": round(shed / offered, 4) if offered else 0.0}
 
 
 class _Tenant:
@@ -157,7 +346,8 @@ class _Tenant:
     one tenant's backlog is *visible* and boundable independently)."""
 
     def __init__(self, name: str, group: "_ModelGroup", in_map: dict,
-                 flush_s: float, max_pending_bytes: int):
+                 flush_s: float, max_pending_bytes: int,
+                 slo_s: float | None = None):
         from tensorflowonspark_tpu import obs
 
         self.name = name
@@ -165,23 +355,86 @@ class _Tenant:
         self.in_map = dict(in_map)
         self.flush_s = float(flush_s)
         self.max_pending_bytes = int(max_pending_bytes)
+        #: latency over this retains the request's complete span tree
+        #: (tail-based sampling) — the per-tenant SLO
+        self.slo_s = (float(slo_s) if slo_s is not None
+                      else self.flush_s * DEFAULT_SLO_FLUSH_FACTOR)
         self.pending: collections.deque[_Request] = collections.deque()
         self.pending_rows = 0
         self.pending_bytes = 0
+        self.shed_window = _ShedWindow()
         safe = _sanitize(name)
         # instrument handles cached here: submit/reply are the hot path
         # and must not pay a registry lookup per request (flight-recorder
-        # rule)
+        # rule).  The tenant is a first-class Prometheus LABEL; the
+        # round-11 name-mangled series are dual-published for one round
+        # so existing scrapes keep parsing, then they go away.
+        # labeled families are DISJOINT from the unlabeled server-wide
+        # grand totals (online_requests_total / online_shed_total): mixing
+        # a labelless series into a labeled family would double-count
+        # every request under sum() — the aggregation alerting uses
+        tenant_label = {"tenant": name}
         self.requests_total = obs.counter(
-            f"online_requests_{safe}_total",
-            f"online requests admitted for tenant {name}")
+            "online_tenant_requests_total",
+            "online requests admitted, per tenant", labels=tenant_label)
         self.shed_total = obs.counter(
-            f"online_shed_{safe}_total",
-            f"online requests shed (admission control) for tenant {name}")
+            "online_tenant_shed_total",
+            "online requests shed by admission control, per tenant",
+            labels=tenant_label)
         self.latency = obs.histogram(
+            "online_request_seconds",
+            "submit→reply latency (p50/p99 from the buckets; slow "
+            "observations carry retained-trace exemplars)",
+            buckets=LATENCY_BUCKETS, labels=tenant_label)
+        self._legacy_requests_total = obs.counter(
+            f"online_requests_{safe}_total",
+            f"DEPRECATED name-mangled alias of "
+            f"online_tenant_requests_total{{tenant=\"{name}\"}} — one round")
+        self._legacy_shed_total = obs.counter(
+            f"online_shed_{safe}_total",
+            f"DEPRECATED name-mangled alias of "
+            f"online_tenant_shed_total{{tenant=\"{name}\"}} — one round")
+        self._legacy_latency = obs.histogram(
             f"online_request_seconds_{safe}",
-            f"submit→reply latency for tenant {name} (p50/p99 from the "
-            "buckets)", buckets=LATENCY_BUCKETS)
+            f"DEPRECATED name-mangled alias of "
+            f"online_request_seconds{{tenant=\"{name}\"}} — one round",
+            buckets=LATENCY_BUCKETS)
+
+    def note_admitted(self) -> None:
+        self.requests_total.inc()
+        self._legacy_requests_total.inc()
+        self.shed_window.note(shed=False)
+
+    def note_shed(self) -> None:
+        self.shed_total.inc()
+        self._legacy_shed_total.inc()
+        self.shed_window.note(shed=True)
+
+    def observe_latency(self, seconds: float,
+                        trace_id: str | None = None) -> None:
+        """Record one reply latency; a retained trace's id rides the
+        labeled histogram as the bucket's exemplar (the legacy series
+        never carries exemplars — it is on its way out)."""
+        self.latency.observe(
+            seconds,
+            exemplar={"trace_id": trace_id} if trace_id else None)
+        self._legacy_latency.observe(seconds)
+
+    def evict_metrics(self) -> None:
+        """Drop this tenant's labeled series — AND its one-round legacy
+        name-mangled aliases — with the tenant (bounded cardinality: a
+        removed tenant frees every slot it pinned)."""
+        from tensorflowonspark_tpu import obs
+
+        reg = obs.get_registry()
+        label = {"tenant": self.name}
+        reg.remove("online_tenant_requests_total", label)
+        reg.remove("online_tenant_shed_total", label)
+        reg.remove("online_request_seconds", label)
+        safe = _sanitize(self.name)
+        reg.remove(f"online_requests_{safe}_total")
+        reg.remove(f"online_shed_{safe}_total")
+        reg.remove(f"online_request_seconds_{safe}")
 
     def quantile_ms(self, q: float) -> float | None:
         from tensorflowonspark_tpu.obs import anomaly
@@ -191,6 +444,78 @@ class _Tenant:
             return None
         v = anomaly.hist_quantile(h["buckets"], q)
         return None if v is None else round(v * 1000, 3)
+
+
+class _BatchTrace:
+    """ONE record per coalesced batch, shared by every member request's
+    trace — the batch-level half of request tracing at batch-level cost.
+
+    The coalescer fills the drain/assembly fields and registers one
+    O(1) closure per member (``RequestTrace.add_lazy``); the compute
+    thread fills the forward/reply windows.  Only a RETAINED trace ever
+    expands the record into its ``queue``/``coalesce``/``forward``/
+    ``reply`` spans (mates = the member ids minus its own) — the hot
+    path never pays per-request×per-span dict work, which an A/B
+    measured at ~20% of closed-loop throughput when done eagerly.
+    Fields a failed batch never filled simply produce no span.
+    """
+
+    __slots__ = ("batch_id", "bucket", "rows", "flush", "pad_waste",
+                 "members", "n_requests", "drained_wall",
+                 "drained_perf", "assembled_wall", "assembled_perf",
+                 "coalescer_tid", "forward_dur", "forward_end_wall",
+                 "compute_tid", "reply_dur", "reply_end_wall")
+
+    def __init__(self, batch_id: int):
+        self.batch_id = batch_id
+        self.bucket = self.rows = self.n_requests = 0
+        self.flush = ""
+        self.pad_waste = 0.0
+        #: the batch's requests (aliased, not copied) — member trace ids
+        #: and tenant names derive lazily at expansion
+        self.members: list = []
+        self.drained_wall = self.drained_perf = 0.0
+        self.assembled_wall = self.assembled_perf = 0.0
+        self.coalescer_tid = self.compute_tid = 0
+        self.forward_dur: float | None = None
+        self.forward_end_wall = 0.0
+        self.reply_dur: float | None = None
+        self.reply_end_wall = 0.0
+
+    def spans_for(self, trace_id: str, enqueued_perf: float) -> list:
+        """Expand into one member's span tuples (``add_lazy`` contract:
+        ``(name, end_wall, dur_s, tid, parent_span_id, attrs)``)."""
+        out = []
+        if self.drained_perf:
+            out.append(("queue", self.drained_wall,
+                        max(0.0, self.drained_perf - enqueued_perf),
+                        self.coalescer_tid, None,
+                        {"batch_id": self.batch_id}))
+        if self.assembled_perf:
+            mates = [m for m in (_trace_id_of(r) for r in self.members
+                                 if r.t0_perf) if m != trace_id]
+            truncated = len(mates) > _MAX_BATCH_MATES
+            out.append((
+                "coalesce", self.assembled_wall,
+                max(0.0, self.assembled_perf - self.drained_perf),
+                self.coalescer_tid, None,
+                {"batch_id": self.batch_id, "bucket": self.bucket,
+                 "rows": self.rows, "flush": self.flush,
+                 "pad_waste": self.pad_waste,
+                 "batch_requests": self.n_requests,
+                 "batch_mates": mates[:_MAX_BATCH_MATES],
+                 **({"batch_mates_total": len(mates)} if truncated
+                    else {}),
+                 "tenants": sorted({r.tenant.name for r in self.members})}))
+        if self.forward_dur is not None:
+            out.append(("forward", self.forward_end_wall, self.forward_dur,
+                        self.compute_tid, None,
+                        {"batch_id": self.batch_id, "bucket": self.bucket}))
+        if self.reply_dur is not None:
+            out.append(("reply", self.reply_end_wall, self.reply_dur,
+                        self.compute_tid, None,
+                        {"batch_id": self.batch_id}))
+        return out
 
 
 class _ModelGroup:
@@ -259,6 +584,9 @@ class OnlineServer:
         # their own).  ``flush_ms`` therefore only delays requests while
         # a batch is already in flight.
         self._inflight = 0
+        #: monotonically increasing coalesced-batch id — what a request's
+        #: trace cites to name the batch it rode (batch-level causality)
+        self._batch_seq = 0
         self._requests_total = obs.counter(
             "online_requests_total", "online requests admitted")
         self._rows_total = obs.counter(
@@ -293,6 +621,7 @@ class OnlineServer:
                    output_mapping: Mapping[str, str] | None = None,
                    flush_ms: float = DEFAULT_FLUSH_MS,
                    max_pending_mb: float = DEFAULT_MAX_PENDING_MB,
+                   slo_ms: float | None = None,
                    warmup: bool | None = None,
                    warmup_example: Mapping[str, Any] | None = None
                    ) -> "_Tenant":
@@ -308,10 +637,14 @@ class OnlineServer:
         ``flush_ms`` is the queueing half of the tenant's latency SLO:
         how long the coalescer may hold its oldest request waiting for
         batch-mates.  ``max_pending_mb`` bounds the tenant's pending
-        payload bytes (admission control).  ``warmup``: ``True`` forces
-        (raises when input shapes are unknowable), ``None`` warms when
-        shapes are known (``warmup_example`` or a self-describing
-        export's signature), ``False`` skips.
+        payload bytes (admission control).  ``slo_ms`` is the tenant's
+        end-to-end latency SLO (default ``flush_ms`` ×
+        ``DEFAULT_SLO_FLUSH_FACTOR``): a request over it keeps its
+        complete span tree in the trace store (tail-based sampling).
+        ``warmup``: ``True`` forces (raises when input shapes are
+        unknowable), ``None`` warms when shapes are known
+        (``warmup_example`` or a self-describing export's signature),
+        ``False`` skips.
         """
         from tensorflowonspark_tpu import pipeline, saved_model, serving
 
@@ -385,15 +718,48 @@ class OnlineServer:
             elif specs is not None and group.specs is None:
                 group.specs = specs
             tenant = _Tenant(name, group, in_map, flush_ms / 1000.0,
-                             int(max_pending_mb * (1 << 20)))
+                             int(max_pending_mb * (1 << 20)),
+                             slo_s=(slo_ms / 1000.0
+                                    if slo_ms is not None else None))
             self._tenants[name] = tenant
             group.tenants.append(tenant)
         logger.info(
             "online tenant %r → %s (buckets=%s, flush=%.1fms, "
-            "pending bound=%d bytes, warmed=%s)", name, export_dir,
-            list(buckets), flush_ms, tenant.max_pending_bytes,
+            "slo=%.1fms, pending bound=%d bytes, warmed=%s)", name,
+            export_dir, list(buckets), flush_ms, tenant.slo_s * 1000,
+            tenant.max_pending_bytes,
             warmup is not False and specs is not None)
         return tenant
+
+    def remove_tenant(self, name: str) -> None:
+        """Deregister a tenant: unroute it, fail its pending requests
+        loudly, and evict its labeled metric series (bounded label
+        cardinality — a dead tenant must not pin registry slots).  Its
+        model-cache entry stays (other tenants / future re-adds share
+        it)."""
+        err = RuntimeError(f"tenant {name!r} removed")
+        with self._cond:
+            tenant = self._tenants.pop(name, None)
+            if tenant is None:
+                raise KeyError(f"unknown tenant {name!r}")
+            group = tenant.group
+            if tenant in group.tenants:
+                group.tenants.remove(tenant)
+            if not group.tenants:
+                self._groups.pop(group.key, None)
+            failed = []
+            while tenant.pending:
+                req = tenant.pending.popleft()
+                tenant.pending_rows -= req.rows
+                tenant.pending_bytes -= req.nbytes
+                self._pending_rows_g.dec(req.rows)
+                self._pending_bytes_g.dec(req.nbytes)
+                failed.append(req)
+        for req in failed:
+            req.fail(err)
+        tenant.evict_metrics()
+        logger.info("online tenant %r removed (%d pending failed)", name,
+                    len(failed))
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -452,11 +818,21 @@ class OnlineServer:
     # -- request path --------------------------------------------------------
 
     def submit(self, tenant: str, inputs: Mapping[str, Any],
-               timeout: float = 30.0) -> dict[str, np.ndarray]:
+               timeout: float = 30.0,
+               trace_ctx: "_trace.TraceContext | None" = None
+               ) -> dict[str, np.ndarray]:
         """Score ``inputs`` for ``tenant``; blocks until the coalesced
         forward replies.  ``inputs``: request field → array with a shared
         leading batch axis (a single row is shape ``(1, ...)``).  Returns
         output column → array of this request's rows.
+
+        ``trace_ctx`` is the inbound trace context (e.g. a parsed W3C
+        ``traceparent``): the request's span tree joins that trace and
+        capture is GUARANTEED (explicit propagation always arms).
+        Without it the request arms at ``TFOS_TRACE_ARM`` — armed
+        requests additionally join the caller's ambient
+        ``obs.trace_context()`` when one is installed; callers who need
+        certain capture pass ``trace_ctx=obs.trace_context()``.
 
         Raises :class:`Rejected` when the tenant's pending queue is over
         its byte bound (shed — retry after backoff), ``KeyError`` for an
@@ -467,9 +843,65 @@ class OnlineServer:
         if ts is None:
             raise KeyError(f"unknown tenant {tenant!r} "
                            f"(have {sorted(self._tenants)})")
-        cols, rows, nbytes = self._validate(ts, inputs)
-        deadline = time.perf_counter() + ts.flush_s
-        req = _Request(ts, cols, rows, nbytes, deadline)
+        # inlined _trace.requests_enabled(): memoized on the raw env
+        # string, no function call on the cached path
+        raw = _env_get("TFOS_TRACE_REQUESTS", "1")
+        cached = _trace._REQ_ENABLED_CACHE
+        tracing = (cached[1] if raw == cached[0]
+                   else _trace.requests_enabled())
+        inbound = None
+        armed = False
+        if tracing:
+            if trace_ctx is not None:
+                # explicit propagation always captures
+                inbound, armed = trace_ctx, True
+            else:
+                rawa = _env_get("TFOS_TRACE_ARM", "")
+                ca = _trace._ARM_CACHE
+                arm = ca[1] if rawa == ca[0] else _trace.arm_rate()
+                armed = arm >= 1.0 or (arm > 0.0
+                                       and _rng_random() < arm)
+                if armed:
+                    # inlined _trace.trace_context(): innermost open span
+                    # on this thread, else the ambient context.  Consulted
+                    # only for armed requests — implicit in-process
+                    # propagation joins at the arm rate; pass
+                    # trace_ctx=obs.trace_context() to guarantee capture
+                    local = _TRACER._local
+                    stack = getattr(local, "stack", None)
+                    if stack:
+                        _, span_id, trace_id = stack[-1]
+                        inbound = _trace.TraceContext(trace_id, span_id)
+                    else:
+                        inbound = getattr(local, "ctx", None)
+        a0 = time.perf_counter()
+        try:
+            cols, rows, nbytes = self._validate(ts, inputs)
+        except Exception as e:
+            if tracing:  # invalid requests: always captured (cold path)
+                rt = _trace.RequestTrace(
+                    "online.request", ctx=inbound,
+                    started=(time.time(), a0), tenant=tenant)
+                rt.add("admission", time.perf_counter() - a0,
+                       outcome="invalid")
+                rt.finish(status="error",
+                          error=f"{type(e).__name__}: {e}"[:300])
+                _trace.get_trace_store().commit(rt, retain="error")
+            raise
+        now = time.perf_counter()
+        req = _Request(ts, cols, rows, nbytes, now + ts.flush_s,
+                       enqueued=now)
+        if armed:
+            # raw fields only — the trace id itself, the wall anchor,
+            # admission attrs and every span dict derive at
+            # materialization, which only the retained minority reaches
+            if inbound is not None:
+                req.inbound = inbound
+                req.trace_id = inbound.trace_id
+            req.trace_seq = next(_TRACE_SEQ)
+            req.t0_perf = a0
+            req.admission_dur = now - a0
+        shed_exc = None
         with self._cond:
             if not self._started or self._stopped:
                 raise RuntimeError("OnlineServer is not serving "
@@ -479,23 +911,49 @@ class OnlineServer:
             # is byte-empty (otherwise it could never be served at all)
             if ts.pending_bytes > 0 and \
                     ts.pending_bytes + nbytes > ts.max_pending_bytes:
-                ts.shed_total.inc()
+                ts.note_shed()
                 self._shed_total.inc()
-                raise Rejected(
+                pending_bytes = ts.pending_bytes
+                shed_exc = Rejected(
                     f"tenant {tenant!r} pending queue over its byte bound "
-                    f"({ts.pending_bytes + nbytes} > "
+                    f"({pending_bytes + nbytes} > "
                     f"{ts.max_pending_bytes}); request shed — back off "
                     "and retry", retry_after_s=max(ts.flush_s, 0.01))
-            ts.pending.append(req)
-            ts.pending_rows += rows
-            ts.pending_bytes += nbytes
-            ts.requests_total.inc()
-            self._requests_total.inc()
-            self._rows_total.inc(rows)
-            self._pending_rows_g.inc(rows)
-            self._pending_bytes_g.inc(nbytes)
-            self._cond.notify()
+            else:
+                ts.pending.append(req)
+                ts.pending_rows += rows
+                ts.pending_bytes += nbytes
+                ts.note_admitted()
+                self._requests_total.inc()
+                self._rows_total.inc(rows)
+                self._pending_rows_g.inc(rows)
+                self._pending_bytes_g.inc(nbytes)
+                self._cond.notify()
+        if shed_exc is not None:
+            if tracing:
+                # sheds are ALWAYS captured, armed or not (this cold path
+                # can afford to arm retroactively).  "How long it sat
+                # shed-adjacent": the admission window from entry to the
+                # byte-bound decision (the admitted case's window ends at
+                # validation; this one includes the lock wait that
+                # preceded the shed verdict)
+                if not req.t0_perf:
+                    req.inbound = inbound
+                    req.trace_seq = next(_TRACE_SEQ)
+                    req.t0_perf = a0
+                req.admission_dur = time.perf_counter() - a0
+                req.admission_attrs = {
+                    "outcome": "shed", "pending_bytes": pending_bytes,
+                    "max_pending_bytes": ts.max_pending_bytes}
+            req.fail(shed_exc)  # materializes + retains the trace: "shed"
+            raise shed_exc
         if not req.event.wait(timeout):
+            # the finish race with a late compute-thread reply is settled
+            # by the claim: exactly one side materializes + commits
+            if req.claim_trace():
+                rt = _build_trace(req)
+                rt.finish(status="timeout", timeout_s=timeout)
+                _trace.get_trace_store().commit(rt, retain="timeout")
             raise TimeoutError(
                 f"no reply for tenant {tenant!r} within {timeout}s "
                 "(server overloaded or stopped?)")
@@ -548,26 +1006,37 @@ class OnlineServer:
     # -- coalescer (assembly thread) -----------------------------------------
 
     def _next_flush(self, now: float
-                    ) -> tuple[_ModelGroup | None, float | None]:
-        """Under the lock: the group most overdue to flush, or the wait
-        until the nearest deadline (None = nothing pending)."""
+                    ) -> tuple[_ModelGroup | None, float | None, str]:
+        """Under the lock: the group most overdue to flush (with WHY it
+        flushes — ``deadline`` / ``full_bucket`` / ``engine_idle``, the
+        causality a request trace cites), or the wait until the nearest
+        deadline (None = nothing pending)."""
         ready: _ModelGroup | None = None
         ready_deadline = None
+        ready_trigger = ""
         nearest: float | None = None
         idle = self._inflight == 0
         for group in self._groups.values():
             oldest = group.oldest_deadline()
             if oldest is None:
                 continue
-            if idle or group.pending_rows() >= group.batch_cap \
-                    or oldest <= now:
-                if ready is None or oldest < ready_deadline:
-                    ready, ready_deadline = group, oldest
-            elif nearest is None or oldest < nearest:
-                nearest = oldest
+            if oldest <= now:
+                trigger = "deadline"
+            elif group.pending_rows() >= group.batch_cap:
+                trigger = "full_bucket"
+            elif idle:
+                trigger = "engine_idle"
+            else:
+                if nearest is None or oldest < nearest:
+                    nearest = oldest
+                continue
+            if ready is None or oldest < ready_deadline:
+                ready, ready_deadline = group, oldest
+                ready_trigger = trigger
         if ready is not None:
-            return ready, None
-        return None, (None if nearest is None else max(0.0, nearest - now))
+            return ready, None, ready_trigger
+        return (None,
+                None if nearest is None else max(0.0, nearest - now), "")
 
     def _drain(self, group: _ModelGroup) -> tuple[list[_Request], int]:
         """Under the lock: pop up to one bucket of rows, round-robin
@@ -610,14 +1079,25 @@ class OnlineServer:
                 while True:
                     if self._stopped:
                         return
-                    group, wait_s = self._next_flush(perf())
+                    group, wait_s, trigger = self._next_flush(perf())
                     if group is not None:
                         reqs, n = self._drain(group)
-                        self._inflight += 1
+                        self._batch_seq += 1
+                        batch_id = self._batch_seq
                         break
                     self._cond.wait(timeout=wait_s)
+                if reqs:
+                    self._inflight += 1
             if not reqs:  # pragma: no cover - defensive (ready ⇒ pending)
                 continue
+            # one shared batch record per batch; each traced member just
+            # points at it — span expansion happens only on retention
+            bt = _BatchTrace(batch_id)
+            bt.drained_wall, bt.drained_perf = time.time(), perf()
+            bt.coalescer_tid = threading.get_ident() & 0xFFFFFFFF
+            traced = [r for r in reqs if r.t0_perf]
+            for req in traced:
+                req.batch = bt
             try:
                 t0 = perf()
                 cols = self._concat(reqs)
@@ -648,7 +1128,18 @@ class OnlineServer:
             rec.add(overlapped=True, coalesce=t1 - t0,
                     pad=perf() - t1)
             self._coalesce_size.observe(n)
-            item = (group, reqs, n, bucket, staged)
+            if traced:
+                bt.bucket, bt.rows, bt.flush = bucket, n, trigger
+                bt.pad_waste = (round((bucket - n) / bucket, 4)
+                                if bucket else 0.0)
+                bt.n_requests = len(reqs)
+                bt.members = reqs  # aliased; ids/tenants derive lazily
+                bt.assembled_wall = time.time()
+                # assembled_perf is the GATE spans_for() checks: set LAST,
+                # after every field it guards, so a racing timeout-path
+                # materialization can never see a half-filled record
+                bt.assembled_perf = perf()
+            item = (group, reqs, n, bucket, staged, bt)
             while True:
                 try:
                     self._staged.put(item, timeout=0.2)
@@ -676,6 +1167,7 @@ class OnlineServer:
         from tensorflowonspark_tpu.obs import flight
 
         rec = flight.recorder("online")
+        store = _trace.get_trace_store()
         perf = time.perf_counter
         while True:
             t0 = perf()
@@ -683,10 +1175,10 @@ class OnlineServer:
             if item is _STOP:
                 return
             wait = perf() - t0
-            group, reqs, n, bucket, batch = item
+            group, reqs, n, bucket, batch, bt = item
             t1 = perf()
             try:
-                serving.note_compile(group.cache_key, batch)
+                fresh = serving.note_compile(group.cache_key, batch)
                 outputs = group.fn(group.params, batch)
                 named = pipeline._name_outputs(outputs, group.out_map)
                 arrays: dict[str, np.ndarray] = {}
@@ -710,15 +1202,62 @@ class OnlineServer:
                 self._note_idle()
                 continue
             t2 = perf()
+            if fresh:
+                # a new shape signature met the forward here: that call's
+                # wall IS the compile cost the persistent-cache work
+                # (ROADMAP item 4) wants measured
+                serving.observe_compile_seconds(t2 - t1)
+            bt.forward_dur = t2 - t1
+            bt.forward_end_wall = time.time()
+            bt.compute_tid = threading.get_ident() & 0xFFFFFFFF
             # scatter: request k owns rows [off, off+k.rows) of the batch,
-            # in drain order — tenant mix is irrelevant to correctness
+            # in drain order — tenant mix is irrelevant to correctness.
+            # Every caller is woken FIRST; per-request trace bookkeeping
+            # follows, off the callers' critical path
             off = 0
+            latencies = []
             for req in reqs:
                 req.result = {c: a[off:off + req.rows]
                               for c, a in arrays.items()}
                 off += req.rows
                 req.event.set()
-                req.tenant.latency.observe(perf() - req.enqueued)
+                latencies.append(perf() - req.enqueued)
+            t3 = perf()
+            bt.reply_dur = t3 - t2
+            bt.reply_end_wall = time.time()
+            dropped = 0
+            sample = _trace.sample_rate()  # hoisted: one env read per batch
+            for req, latency in zip(reqs, latencies):
+                if not req.t0_perf:
+                    req.tenant.observe_latency(latency)
+                    continue
+                # tail retention: an SLO breach keeps the complete tree,
+                # everything else gets one uniform-sample roll; only a
+                # KEPT trace pays materialization.  The trace token
+                # settles the race with a caller-side timeout.
+                reason = ("slo_breach" if latency > req.tenant.slo_s
+                          else "sampled" if sample >= 1.0
+                          or (sample > 0.0 and _rng_random() < sample)
+                          else None)
+                kept = None
+                if reason is not None and req.claim_trace():
+                    rt = _build_trace(req)
+                    rt.finish(status="ok",
+                              latency_ms=round(latency * 1000, 3),
+                              rows=req.rows)
+                    kept = store.commit(rt, retain=reason)
+                elif reason is None and req.claim_trace():
+                    # drop decided UNDER the claim: a caller-side timeout
+                    # that won the claim already committed this trace, and
+                    # counting it dropped too would double the store's
+                    # committed/dropped accounting (an unlocked flag read
+                    # here would race that exact interleaving)
+                    dropped += 1
+                # exemplar only for a RETAINED trace: a dashboard click
+                # through an exemplar must land on a trace that exists
+                req.tenant.observe_latency(
+                    latency, trace_id=req.trace_id if kept else None)
+            store.note_dropped(dropped)
             rec.add(wait=wait, compute=t2 - t1, reply=perf() - t2)
             rec.commit()
             self._note_idle()
@@ -739,18 +1278,27 @@ class OnlineServer:
         return "serving" if self._started else "created"
 
     def stats(self) -> dict[str, Any]:
-        """JSON-able server + per-tenant state (the ``/healthz`` body)."""
+        """JSON-able server + per-tenant state (the ``/healthz`` body).
+
+        ``shed_window`` is the last-window shed *rate* (shed / offered
+        over the tumbling window) — admission pressure visible without
+        Prometheus rate() math over the lifetime counters.
+        """
         tenants = {}
         with self._lock:
-            snap = list(self._tenants.values())
-        for ts in snap:
+            # window snapshots roll under the same lock note() runs under
+            snap = [(ts, ts.shed_window.snapshot())
+                    for ts in self._tenants.values()]
+        for ts, window in snap:
             tenants[ts.name] = {
                 "pending_rows": ts.pending_rows,
                 "pending_bytes": ts.pending_bytes,
                 "max_pending_bytes": ts.max_pending_bytes,
                 "flush_ms": round(ts.flush_s * 1000, 3),
+                "slo_ms": round(ts.slo_s * 1000, 3),
                 "requests_total": int(ts.requests_total.value),
                 "shed_total": int(ts.shed_total.value),
+                "shed_window": window,
                 "latency_p50_ms": ts.quantile_ms(0.50),
                 "latency_p99_ms": ts.quantile_ms(0.99),
             }
@@ -778,14 +1326,21 @@ class OnlineHTTPServer:
       nested lists}, "timeout_s": float?}`` → ``{"outputs": {col:
       lists}, "rows": n}``.  Admission shed → **429** with a
       ``Retry-After`` header; unknown tenant → 404; malformed → 400;
-      reply timeout → 504.
+      reply timeout → 504.  A W3C ``traceparent`` request header joins
+      the caller's distributed trace (the reply then echoes that trace
+      id as ``trace_id``, the key to look up on ``/debug/requests``).
     - ``GET /metrics`` — Prometheus text of this process's registry
       (the online counters/histograms ride the same exposition as every
-      other instrument).
+      other instrument); ``Accept: application/openmetrics-text`` gets
+      the OpenMetrics flavor with trace-id exemplars on the latency
+      histogram buckets.
     - ``GET /healthz`` — :meth:`OnlineServer.stats` JSON; 200 while
       serving, 503 otherwise.
     - ``GET /pipeline`` — this process's flight-recorder planes (the
       ``"online"`` plane's stage totals + verdicts) plus the stats doc.
+    - ``GET /debug/requests`` — the retained request traces
+      (slowest-first JSON: SLO breaches, sheds, errors, the uniform
+      sample), straight from the process trace store.
 
     A handler that raises becomes a 500; the endpoint must never take the
     serving process down (the ``obs/httpd.py`` contract).
@@ -814,8 +1369,15 @@ class OnlineHTTPServer:
                 path = self.path.split("?", 1)[0].rstrip("/") or "/"
                 try:
                     if path == "/metrics":
-                        self._reply(200, _httpd.PROMETHEUS_CONTENT_TYPE,
-                                    obs.get_registry().to_prometheus())
+                        accept = self.headers.get("Accept", "") or ""
+                        if "application/openmetrics-text" in accept:
+                            self._reply(
+                                200, _httpd.OPENMETRICS_CONTENT_TYPE,
+                                obs.get_registry().to_openmetrics())
+                        else:
+                            self._reply(
+                                200, _httpd.PROMETHEUS_CONTENT_TYPE,
+                                obs.get_registry().to_prometheus())
                     elif path == "/healthz":
                         doc = online.stats()
                         self._reply(
@@ -826,11 +1388,16 @@ class OnlineHTTPServer:
                                "server": online.stats()}
                         self._reply(200, "application/json",
                                     json.dumps(doc))
+                    elif path == "/debug/requests":
+                        self._reply(
+                            200, "application/json",
+                            json.dumps(_trace.get_trace_store().to_doc()))
                     else:
                         self._reply(404, "application/json", json.dumps(
                             {"error": "not found",
                              "routes": ["/v1/predict (POST)", "/metrics",
-                                        "/healthz", "/pipeline"]}))
+                                        "/healthz", "/pipeline",
+                                        "/debug/requests"]}))
                 except Exception as e:  # must never kill the server
                     logger.warning("online http GET %s failed: %s", path, e)
                     self._reply(500, "text/plain; charset=utf-8",
@@ -855,14 +1422,22 @@ class OnlineHTTPServer:
                     timeout = min(float(body["timeout_s"])
                                   if "timeout_s" in body else 30.0,
                                   300.0)
+                    # W3C trace-context propagation: the request's span
+                    # tree joins the caller's distributed trace (lenient:
+                    # a malformed header starts a fresh trace, never 400s)
+                    ctx = _trace.parse_traceparent(
+                        self.headers.get("traceparent"))
                     t0 = time.perf_counter()
-                    out = online.submit(tenant, inputs, timeout=timeout)
+                    out = online.submit(tenant, inputs, timeout=timeout,
+                                        trace_ctx=ctx)
                     doc = {"outputs": {c: np.asarray(a).tolist()
                                        for c, a in out.items()},
                            "rows": int(next(iter(out.values())).shape[0])
                            if out else 0,
                            "latency_ms": round(
                                (time.perf_counter() - t0) * 1000, 3)}
+                    if ctx is not None:
+                        doc["trace_id"] = ctx.trace_id
                     self._reply(200, "application/json", json.dumps(doc))
                 except Rejected as e:
                     import math

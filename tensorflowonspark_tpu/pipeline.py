@@ -642,6 +642,17 @@ class _RunModel:
                             pad=t2 - t1, stage=_perf() - t2)
                 yield n, bucket, staged
 
+        # partition-scoped trace identity: one context per mapPartitions
+        # call, stamped on the serve.partition span so a slow partition in
+        # the merged trace is a citable id, not just a timeline blob (the
+        # schema-sampling probe scores one row and gets none)
+        if self.sample_rows:
+            part_ctx = None
+        else:
+            from tensorflowonspark_tpu.obs import trace as trace_lib
+
+            part_ctx = trace_lib.TraceContext.new()
+
         def scored_batches():
             # emit lags the forward by one batch: jax dispatch is async, so
             # batch N+1's forward computes (GIL-free, on the accelerator /
@@ -662,8 +673,13 @@ class _RunModel:
                 except StopIteration:
                     break
                 t1 = _perf()
-                serving.note_compile(self._cache_key, batch)
+                fresh = serving.note_compile(self._cache_key, batch)
                 outputs = fn(params, batch)
+                t2 = _perf()
+                if fresh:
+                    # first call of a new shape signature: this dispatch
+                    # wall carries the trace+XLA compile
+                    serving.observe_compile_seconds(t2 - t1)
                 if rec is not None:
                     if depth > 0:
                         rec.add(wait=t1 - t0)
@@ -672,7 +688,7 @@ class _RunModel:
                     # ingest/pad/stage stages; counting it as wait too
                     # would double the stage sum and fail the gate's
                     # reconciliation on a healthy synchronous run
-                    rec.add(compute=_perf() - t1)
+                    rec.add(compute=t2 - t1)
                 if pending is not None:
                     t2 = _perf()
                     yield serving.emit_rows(
@@ -697,9 +713,32 @@ class _RunModel:
                     # mid-stream batch already has; totals stay exact.
                     rec.add(emit=_perf() - t2)
 
+        def traced_partition():
+            # one serve.partition span per mapPartitions call, carrying
+            # the partition's trace id — the serving twin of the
+            # trainer's step-scoped ids (batch-level context linkage)
+            import time as _time
+
+            from tensorflowonspark_tpu import obs
+
+            t0_wall, t0 = _time.time(), _perf()
+            rows = batches = 0
+            for out_rows in scored_batches():
+                rows += len(out_rows)
+                batches += 1
+                yield out_rows
+            obs.get_tracer().record(
+                "serve.partition", "X", t0_wall * 1e6,
+                (_perf() - t0) * 1e6,
+                {"rows": rows, "batches": batches,
+                 "export_dir": self.export_dir},
+                trace_id=part_ctx.trace_id, span_id=part_ctx.span_id)
+
         # one generator-frame resume per BATCH; the per-row hops through
         # the emitted lists stay C-level inside chain.from_iterable
-        return itertools.chain.from_iterable(scored_batches())
+        if part_ctx is None:
+            return itertools.chain.from_iterable(scored_batches())
+        return itertools.chain.from_iterable(traced_partition())
 
     def _call_legacy(self, iterator, fn, params, in_map, out_map):
         """The pre-bucketing row loop, kept verbatim as the measured

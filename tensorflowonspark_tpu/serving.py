@@ -220,13 +220,20 @@ def warm_buckets(fn, params, specs: Mapping[str, tuple[tuple, Any]],
     real batch."""
     from tensorflowonspark_tpu import obs
 
+    import time as _time
+
     with obs.span("serving.warmup", buckets=list(buckets)):
         for b in buckets:
             batch = zero_batch(specs, b)
-            note_compile(cache_key, batch)
+            fresh = note_compile(cache_key, batch)
+            t0 = _time.perf_counter()
             out = fn(params, batch)
             for leaf in _tree_leaves(out):
                 np.asarray(leaf)
+            if fresh:
+                # forced forward: this wall is the real compile cost the
+                # warmup moved off the first request's critical path
+                observe_compile_seconds(_time.perf_counter() - t0)
 
 
 def _tree_leaves(tree):
@@ -245,30 +252,77 @@ def _tree_leaves(tree):
 # ---------------------------------------------------------------------------
 
 
+#: compile wall-time histogram bounds: XLA compiles run 10ms (trivial
+#: MLP) to minutes (big models) — the registry default tops out too low
+_COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0,
+                    120.0, float("inf"))
+#: cached (compiles_total, misses, hits, compile_seconds) — note_compile
+#: runs per serving batch and must not pay registry lookups there (same
+#: rule as the flight recorder's instrument cache)
+_COMPILE_INSTRUMENTS = None
+
+
+def _compile_instruments():
+    global _COMPILE_INSTRUMENTS
+    if _COMPILE_INSTRUMENTS is None:
+        from tensorflowonspark_tpu import obs
+
+        _COMPILE_INSTRUMENTS = (
+            obs.counter(
+                "serving_compiles_total",
+                "distinct input-shape signatures handed to a serving "
+                "forward (jit compilation keys)"),
+            obs.counter(
+                "serving_compile_cache_misses_total",
+                "shape signatures NEW to their forward — each one is a "
+                "fresh XLA compile (== serving_compiles_total today; the "
+                "persistent compile cache will split disk hits out of "
+                "these)"),
+            obs.counter(
+                "serving_compile_cache_hits_total",
+                "batches whose shape signature was already compiled for "
+                "the owning forward (jit executable cache hits)"),
+            obs.histogram(
+                "serving_compile_seconds",
+                "wall time of first-call forwards with a new shape "
+                "signature (compile-inclusive: trace + XLA compile + the "
+                "first execution)", buckets=_COMPILE_BUCKETS))
+    return _COMPILE_INSTRUMENTS
+
+
 def note_compile(key: Any, batch: Mapping[str, Any]) -> bool:
     """Record the batch's shape signature; True when it is new for ``key``.
 
     The signature — sorted ``(name, shape, dtype)`` per input — is exactly
     what ``jax.jit`` keys its executable cache on, so for a jitted forward
     "new signature" == "fresh XLA compile".  Every new signature increments
-    the ``serving_compiles_total`` counter, making the bucketing claim
-    ("compiles == buckets, not distinct tail sizes") measurable in tests,
-    in ``bench.py --serving``, and on a live ``/metrics`` endpoint."""
-    from tensorflowonspark_tpu import obs
-
+    ``serving_compiles_total`` (and the hit/miss-shaped pair
+    ``serving_compile_cache_{hits,misses}_total`` — the counter groundwork
+    for the persistent compile cache, ROADMAP item 4), making the
+    bucketing claim ("compiles == buckets, not distinct tail sizes")
+    measurable in tests, in ``bench.py --serving``, and on a live
+    ``/metrics`` endpoint.  Callers that can time the ensuing first-call
+    forward report its wall via :func:`observe_compile_seconds`."""
     sig = tuple(sorted(
         (str(name), tuple(np.shape(v)),
          str(getattr(v, "dtype", type(v).__name__)))
         for name, v in batch.items()))
+    compiles, misses, hits, _ = _compile_instruments()
     seen = _SEEN_SHAPES.setdefault(key, set())
     if sig in seen:
+        hits.inc()
         return False
     seen.add(sig)
-    obs.counter(
-        "serving_compiles_total",
-        "distinct input-shape signatures handed to a serving forward "
-        "(jit compilation keys)").inc()
+    compiles.inc()
+    misses.inc()
     return True
+
+
+def observe_compile_seconds(seconds: float) -> None:
+    """Record one compile's wall time (the first-call forward of a shape
+    signature :func:`note_compile` reported as new) into the
+    ``serving_compile_seconds`` histogram."""
+    _compile_instruments()[3].observe(float(seconds))
 
 
 #: padded-row fraction above which the bucket ladder is called bad

@@ -209,6 +209,11 @@ class Trainer:
         #: only committed ones)
         self.last_checkpoint_step: int | None = None
         self._elastic = None
+        #: trace id of the most recently completed step (step-scoped
+        #: identity: each step's window records as a ``trainer.step`` span
+        #: under its own trace id, so anomaly findings and bench notes can
+        #: cite the exact step they judged)
+        self.last_step_trace_id: str | None = None
         obs.get_tracer().record(
             "trainer.init", "X", _t0_wall * 1e6,
             (time.perf_counter() - _t0) * 1e6,
@@ -288,6 +293,18 @@ class Trainer:
         # (obs.anomaly): a node whose gauge falls behind the freshest
         # peer is wedged — visible from the rollup without any new RPC
         obs.gauge("trainer_last_step_unix_ts").set(time.time())
+        # step-scoped trace id: the step's wall window (previous step →
+        # now: feed wait + shard + dispatch) ships as a trainer.step span
+        # the driver's anomaly findings cite (obs.anomaly.cite_step_traces).
+        # Minted only when a span is actually recorded — an id that exists
+        # in no ring buffer would be a dangling citation (first step: dt=0)
+        if dt > 0:
+            ctx = obs.TraceContext.new()
+            self.last_step_trace_id = ctx.trace_id
+            obs.get_tracer().record(
+                "trainer.step", "X", (time.time() - dt) * 1e6, dt * 1e6,
+                {"step": self._steps_done},
+                trace_id=ctx.trace_id, span_id=ctx.span_id)
         # close the feed-plane flight record (DataFeed wait/ingest + this
         # step's stage/compute) into one classified bottleneck verdict
         self._flight.commit()

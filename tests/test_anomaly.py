@@ -114,3 +114,29 @@ def test_stall_events_extracted_from_shipped_trace():
     assert stalls[0]["node"] == "worker:1"
     assert "stalled for 33s" in stalls[0]["reason"]
     assert stalls[0]["stalled_s"] == 33.1
+
+
+def test_step_trace_ids_cited_on_findings():
+    """Straggler/stall findings cite the node's recent step-scoped trace
+    ids (trainer.step spans) — newest first; nodes without shipped step
+    spans are untouched."""
+    from tensorflowonspark_tpu.obs import anomaly
+
+    def step_ev(tid):
+        return {"name": "trainer.step", "ph": "X", "ts": 1.0, "dur": 1.0,
+                "trace_id": tid, "span_id": "ab" * 8}
+
+    events = {"worker:0": [step_ev("aa" * 16), step_ev("bb" * 16),
+                           step_ev("cc" * 16), step_ev("dd" * 16),
+                           {"name": "other", "ph": "i", "ts": 2.0}],
+              "worker:1": [{"name": "other", "ph": "i", "ts": 2.0}]}
+    ids = anomaly.recent_step_traces(events, limit=3)
+    assert ids == {"worker:0": ["dd" * 16, "cc" * 16, "bb" * 16]}
+    report = {"stragglers": [{"node": "worker:0", "ratio": 2.0},
+                             {"node": "worker:1", "ratio": 1.9}],
+              "stalled": [{"node": "worker:0", "behind_s": 70.0}]}
+    anomaly.cite_step_traces(report, events)
+    assert report["stragglers"][0]["step_trace_ids"][0] == "dd" * 16
+    assert "step_trace_ids" not in report["stragglers"][1]
+    assert report["stalled"][0]["step_trace_ids"] == \
+        report["stragglers"][0]["step_trace_ids"]

@@ -274,6 +274,25 @@ class TestOutageProofing(unittest.TestCase):
         self.assertIn("verdict", bd)
         self.assertGreaterEqual(bd["batches"], 1)
         self.assertGreater(bd["stage_sum_s"], 0.0)
+        # the r12 tracing-overhead A/B rode along: a fraction, not junk
+        self.assertIsInstance(out["trace_overhead_frac"], float)
+        self.assertGreaterEqual(out["trace_overhead_frac"], -1.0)
+        self.assertLessEqual(out["trace_overhead_frac"], 1.0)
+
+    def test_serving_online_trace_overhead_null_when_opted_out(self):
+        sys.path.insert(0, os.path.dirname(BENCH))
+        import bench
+
+        os.environ["TFOS_TRACE_REQUESTS"] = "0"
+        try:
+            out = bench.measure_serving_online(
+                clients=2, reqs_per_client=5, feature_dim=16,
+                hidden_dim=32, out_dim=4, batch_size=4, flush_ms=2.0,
+                slo_ms=10000.0)
+        finally:
+            os.environ.pop("TFOS_TRACE_REQUESTS", None)
+        self.assertIsNone(out["trace_overhead_frac"])
+        self.assertIn("TFOS_TRACE_REQUESTS", out["trace_overhead_reason"])
 
     def test_online_stamp_is_total_on_exhausted_budget(self):
         sys.path.insert(0, os.path.dirname(BENCH))
@@ -283,6 +302,9 @@ class TestOutageProofing(unittest.TestCase):
         bench._stamp_online(result, bench._Deadline(0.0))
         self.assertIsNone(result["online_rows_per_sec"])
         self.assertIn("wall budget", result["online_reason"])
+        # the trace-overhead stamp is total too (r12 schema)
+        self.assertIsNone(result["trace_overhead_frac"])
+        self.assertIn("wall budget", result["trace_overhead_reason"])
 
     def test_serving_stamp_is_total_on_exhausted_budget(self):
         sys.path.insert(0, os.path.dirname(BENCH))
@@ -318,3 +340,20 @@ class TestOutageProofing(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class ServingOnlineDeadlineTest(unittest.TestCase):
+    def test_trace_ab_skipped_on_exhausted_budget_with_reason(self):
+        """The tracing A/B respects the bench wall budget: with no room
+        for the extra passes it stamps null + reason instead of running
+        6 more closed loops (the headline numbers still stand)."""
+        sys.path.insert(0, os.path.dirname(BENCH))
+        import bench
+
+        out = bench.measure_serving_online(
+            clients=2, reqs_per_client=5, feature_dim=16, hidden_dim=32,
+            out_dim=4, batch_size=4, flush_ms=2.0, slo_ms=10000.0,
+            deadline=bench._Deadline(5.0))
+        self.assertGreater(out["online_rows_per_sec"], 0.0)
+        self.assertIsNone(out["trace_overhead_frac"])
+        self.assertIn("wall budget", out["trace_overhead_reason"])

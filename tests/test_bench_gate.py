@@ -710,6 +710,14 @@ def _r11(**extra):
     return half
 
 
+def _r12(**extra):
+    """A round-12-complete primary half: r11 + measured tracing
+    overhead."""
+    half = _r11(trace_overhead_frac=0.012)
+    half.update(extra)
+    return half
+
+
 def test_online_field_required_on_primary_from_round_11(tmp_path):
     # round 10: grandfathered — no online number owed
     verdict = bench_gate.gate([_write(tmp_path, "BENCH_r10.json", _r10())])
@@ -763,14 +771,14 @@ def test_online_regression_within_same_config(tmp_path):
     paths = [
         _write(tmp_path, "BENCH_r11.json", _r11()),
         _write(tmp_path, "BENCH_r12.json",
-               _r11(**_online_fields(rps=10500.0))),
+               _r12(**_online_fields(rps=10500.0))),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "pass", verdict["reasons"]
     paths = [
         _write(tmp_path, "BENCH_r11.json", _r11()),
         _write(tmp_path, "BENCH_r12.json",
-               _r11(**_online_fields(rps=5000.0))),
+               _r12(**_online_fields(rps=5000.0))),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "fail"
@@ -783,14 +791,14 @@ def test_online_not_compared_across_slo_or_geometry(tmp_path):
     paths = [
         _write(tmp_path, "BENCH_r11.json", _r11()),
         _write(tmp_path, "BENCH_r12.json",
-               _r11(**_online_fields(rps=5000.0, online_slo_ms=100.0))),
+               _r12(**_online_fields(rps=5000.0, online_slo_ms=100.0))),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "pass", verdict["reasons"]
     paths = [
         _write(tmp_path, "BENCH_r11.json", _r11()),
         _write(tmp_path, "BENCH_r12.json",
-               _r11(**_online_fields(rps=5000.0, online_clients=8))),
+               _r12(**_online_fields(rps=5000.0, online_clients=8))),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "pass", verdict["reasons"]
@@ -803,7 +811,7 @@ def test_online_judged_even_on_degraded_newest(tmp_path):
     paths = [
         _write(tmp_path, "BENCH_r11.json", _r11()),
         _write(tmp_path, "BENCH_r12.json",
-               _r11(**_online_fields(rps=5000.0),
+               _r12(**_online_fields(rps=5000.0),
                     degraded="accelerator unavailable: probe timeout")),
     ]
     verdict = bench_gate.gate(paths)
@@ -827,3 +835,46 @@ def test_online_breakdown_held_to_reconciliation(tmp_path):
     verdict = bench_gate.gate(
         [_write(tmp_path, "BENCH_r11.json", opted_out)])
     assert verdict["verdict"] == "pass", verdict["reasons"]
+
+
+# -- request-tracing overhead (ISSUE 10) -------------------------------------
+
+
+def test_trace_overhead_required_on_primary_from_round_12(tmp_path):
+    # round 11: grandfathered — no overhead number owed
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r11.json", _r11())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # round 12+: the primary must carry it (or explicit null + reason)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r12.json", _r11())])
+    assert verdict["verdict"] == "fail"
+    assert any("trace_overhead_frac" in r for r in verdict["reasons"])
+    # complete round 12 passes
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r12.json", _r12())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # explicit null + reason satisfies (TFOS_TRACE_REQUESTS=0: no A/B)
+    half = _r11(trace_overhead_frac=None,
+                trace_overhead_reason="request tracing disabled "
+                                      "(TFOS_TRACE_REQUESTS=0)")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r12.json", half)])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # bare null does not
+    half = _r11(trace_overhead_frac=None)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r12.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("trace_overhead_reason" in r for r in verdict["reasons"])
+
+
+def test_trace_overhead_must_be_a_fraction(tmp_path):
+    """The overhead is 1 - traced/untraced throughput: a value outside
+    [-1, 1] is a unit mistake, not a measurement."""
+    verdict = bench_gate.gate(
+        [_write(tmp_path, "BENCH_r12.json",
+                _r12(trace_overhead_frac=3.5))])
+    assert verdict["verdict"] == "fail"
+    assert any("not a fraction" in r for r in verdict["reasons"])
+    # judged whenever present, even before round 12
+    verdict = bench_gate.gate(
+        [_write(tmp_path, "BENCH_r11.json",
+                _r11(trace_overhead_frac=-2.0))])
+    assert verdict["verdict"] == "fail"
+    assert any("not a fraction" in r for r in verdict["reasons"])

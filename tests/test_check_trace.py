@@ -84,3 +84,113 @@ def test_cli_exit_codes(tmp_path):
     assert "bad.json" in fail.stderr
     none = subprocess.run([sys.executable, tool], capture_output=True)
     assert none.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# request-span schema (/debug/requests documents)
+# ---------------------------------------------------------------------------
+
+
+def _req_trace(trace_id=None, upstream=None):
+    """A minimal well-formed retained request trace."""
+    tid = trace_id or "ab" * 16
+    root = "01" * 8
+    child = "02" * 8
+    return {
+        "trace_id": tid, "root_span_id": root,
+        "parent_span_id": upstream, "name": "online.request",
+        "status": "ok", "ts": 1000.0, "duration_ms": 3.2,
+        "retained": "slo_breach",
+        "spans": [
+            {"name": "coalesce", "ph": "X", "ts": 1.0, "dur": 2.0,
+             "trace_id": tid, "span_id": child, "parent_span_id": root,
+             "attrs": {"batch_id": 4, "flush": "deadline",
+                       "batch_mates": ["cd" * 16]}},
+            {"name": "online.request", "ph": "X", "ts": 0.0, "dur": 5.0,
+             "trace_id": tid, "span_id": root,
+             **({"parent_span_id": upstream} if upstream else {})},
+        ],
+    }
+
+
+def test_request_doc_validates_clean():
+    doc = {"retained": [_req_trace(), _req_trace(trace_id="ef" * 16,
+                                                 upstream="99" * 8)]}
+    assert check_trace.validate_requests_doc(doc) == []
+    # a bare list of traces is accepted too (store.recent() shape)
+    assert check_trace.validate_requests_doc(doc["retained"]) == []
+
+
+def test_request_doc_rejects_malformed_ids_and_linkage():
+    bad_tid = _req_trace()
+    bad_tid["trace_id"] = "nothex"
+    assert any("trace_id" in p
+               for p in check_trace.validate_requests_doc([bad_tid]))
+
+    dup = _req_trace()
+    dup["spans"][0]["span_id"] = dup["spans"][1]["span_id"]
+    assert any("duplicate span_id" in p
+               for p in check_trace.validate_requests_doc([dup]))
+
+    dangling = _req_trace()
+    dangling["spans"][0]["parent_span_id"] = "ff" * 8
+    assert any("resolves to no span" in p
+               for p in check_trace.validate_requests_doc([dangling]))
+
+    mate = _req_trace()
+    mate["spans"][0]["attrs"]["batch_mates"] = ["junk"]
+    assert any("batch-mate" in p
+               for p in check_trace.validate_requests_doc([mate]))
+    own = _req_trace()
+    own["spans"][0]["attrs"]["batch_mates"] = [own["trace_id"]]
+    assert any("own id" in p
+               for p in check_trace.validate_requests_doc([own]))
+
+
+def test_request_doc_rejects_cycles_and_multiple_roots():
+    cyc = _req_trace()
+    # root's parent points at the child → cycle, and no root remains
+    cyc["spans"][1]["parent_span_id"] = cyc["spans"][0]["span_id"]
+    problems = check_trace.validate_requests_doc([cyc])
+    assert any("cycle" in p for p in problems)
+    assert any("exactly one root" in p for p in problems)
+
+    two = _req_trace()
+    two["spans"][0].pop("parent_span_id")
+    problems = check_trace.validate_requests_doc([two])
+    assert any("exactly one root" in p for p in problems)
+
+
+def test_chrome_args_trace_ids_format_checked():
+    doc = {"traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+         "args": {"name": "driver"}},
+        {"ph": "X", "name": "s", "ts": 1.0, "dur": 1.0, "pid": 1,
+         "tid": 1, "args": {"trace_id": "not-hex", "span_id": "xy"}},
+    ]}
+    problems = check_trace.validate_doc(doc)
+    assert any("args.trace_id" in p for p in problems)
+    assert any("args.span_id" in p for p in problems)
+
+
+def test_requests_cli_mode(tmp_path):
+    import json as _json
+    import subprocess
+    import sys as _sys
+
+    good = tmp_path / "reqs.json"
+    good.write_text(_json.dumps({"retained": [_req_trace()]}))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tool = os.path.join(repo, "tools", "check_trace.py")
+    proc = subprocess.run(
+        [_sys.executable, tool, "--requests", str(good)],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    bad = tmp_path / "bad.json"
+    doc = {"retained": [_req_trace()]}
+    doc["retained"][0]["trace_id"] = "zz"
+    bad.write_text(_json.dumps(doc))
+    proc = subprocess.run(
+        [_sys.executable, tool, "--requests", str(bad)],
+        capture_output=True, text=True)
+    assert proc.returncode == 1

@@ -338,3 +338,287 @@ def test_trainer_steps_feed_the_default_registry():
     after = obs.get_registry().snapshot()
     assert after["counters"]["trainer_steps_total"] == before + 2
     assert "trainer_step_seconds" in after["histograms"]
+
+# ---------------------------------------------------------------------------
+# trace identity + context propagation + tail-sampled request traces
+# ---------------------------------------------------------------------------
+
+import os  # noqa: E402
+import sys  # noqa: E402
+
+from tensorflowonspark_tpu.obs import trace as trace_lib  # noqa: E402
+
+
+def test_spans_carry_linked_trace_identity():
+    """Nested spans share one trace_id and link parent→child by span ID,
+    not just by name; a sibling root starts a fresh trace; instant events
+    inherit the enclosing span's identity."""
+    tr = Tracer(node="t")
+    with tr.span("outer"):
+        with tr.span("inner"):
+            tr.event("mark")
+    with tr.span("other"):
+        pass
+    evs = {e["name"]: e for e in tr.snapshot()}
+    outer, inner, mark = evs["outer"], evs["inner"], evs["mark"]
+    assert len(outer["trace_id"]) == 32 and len(outer["span_id"]) == 16
+    assert inner["trace_id"] == outer["trace_id"]
+    assert inner["parent_span_id"] == outer["span_id"]
+    assert "parent_span_id" not in outer
+    assert mark["trace_id"] == outer["trace_id"]
+    assert mark["parent_span_id"] == inner["span_id"]
+    # a fresh root = a fresh trace
+    assert evs["other"]["trace_id"] != outer["trace_id"]
+
+
+def test_with_context_carries_trace_across_threads():
+    """The explicit propagation API: a context minted on one thread makes
+    spans on ANOTHER thread children of it — the hop the thread-local
+    span stack cannot make."""
+    tr = Tracer(node="t")
+    handoff = {}
+
+    def submitter():
+        with tr.span("request") as sp:
+            handoff["ctx"] = sp.context()
+
+    submitter()
+    ctx = handoff["ctx"]
+    done = threading.Event()
+
+    def worker():
+        with tr.with_context(ctx):
+            with tr.span("compute"):
+                pass
+        done.set()
+
+    threading.Thread(target=worker).start()
+    assert done.wait(5)
+    evs = {e["name"]: e for e in tr.snapshot()}
+    assert evs["compute"]["trace_id"] == ctx.trace_id
+    assert evs["compute"]["parent_span_id"] == ctx.span_id
+    # the ambient context is restored after the with-block
+    assert tr.current_context() is None
+
+
+def test_traceparent_parse_format_round_trip():
+    ctx = trace_lib.TraceContext.new()
+    parsed = trace_lib.parse_traceparent(trace_lib.format_traceparent(ctx))
+    assert parsed == ctx
+    good = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    assert trace_lib.parse_traceparent(good).trace_id == "ab" * 16
+    # lenient rejection: malformed headers are None, never an exception
+    for bad in (None, "", "garbage", "00-short-cdcdcdcdcdcdcdcd-01",
+                "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",  # bad version
+                "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",  # zero trace
+                "00-" + "ab" * 16 + "-" + "0" * 16 + "-01"):  # zero span
+        assert trace_lib.parse_traceparent(bad) is None
+
+
+def test_request_trace_builds_linked_tree_and_finish_races_once():
+    rt = trace_lib.RequestTrace("online.request", tenant="a")
+    rt.add("admission", 0.001, outcome="admitted")
+    rt.add("queue", 0.002)
+    rt.set(latency_ms=3.5)
+    assert rt.finish(status="ok") is True
+    assert rt.finish(status="timeout") is False  # loser of the race
+    assert rt.add("late", 0.1) is None  # adds after finish are dropped
+    doc = rt.to_doc()
+    assert doc["status"] == "ok"
+    assert doc["duration_ms"] > 0
+    names = [s["name"] for s in doc["spans"]]
+    assert names == ["admission", "queue", "online.request"]
+    root = doc["spans"][-1]
+    assert root["span_id"] == doc["root_span_id"]
+    assert root["attrs"]["latency_ms"] == 3.5
+    for child in doc["spans"][:-1]:
+        assert child["parent_span_id"] == doc["root_span_id"]
+        assert child["trace_id"] == doc["trace_id"]
+
+
+def test_request_trace_joins_inbound_context():
+    up = trace_lib.TraceContext.new()
+    rt = trace_lib.RequestTrace("online.request", ctx=up)
+    rt.finish()
+    doc = rt.to_doc()
+    assert doc["trace_id"] == up.trace_id
+    assert doc["parent_span_id"] == up.span_id
+    assert doc["root_span_id"] != up.span_id
+
+
+def test_trace_store_tail_retention_and_bound(monkeypatch):
+    """Retention: tail reasons always keep; no reason rolls the uniform
+    sample (0 → dropped whole, 1 → kept); the ring stays bounded."""
+    store = trace_lib.TraceStore(capacity=3)
+
+    def commit(retain=None, sample=None):
+        rt = trace_lib.RequestTrace("online.request")
+        rt.finish(status="ok")
+        return store.commit(rt, retain=retain, sample=sample)
+
+    assert commit(retain="slo_breach") == "slo_breach"
+    assert commit(sample=0.0) is None  # dropped at commit, no residue
+    assert commit(sample=1.0) == "sampled"
+    assert store.committed == 3 and store.retained_total == 2
+    for _ in range(5):
+        commit(retain="error")
+    assert len(store.recent(limit=100)) == 3  # ring bound holds
+    doc = store.to_doc()
+    assert doc["committed"] == 8
+    assert doc["dropped_total"] == 1
+    # slowest-first ordering contract
+    durs = [t["duration_ms"] for t in doc["retained"]]
+    assert durs == sorted(durs, reverse=True)
+    # env knob drives the default sample
+    monkeypatch.setenv("TFOS_TRACE_SAMPLE", "0")
+    assert commit() is None
+    monkeypatch.setenv("TFOS_TRACE_SAMPLE", "1")
+    assert commit() == "sampled"
+    monkeypatch.setenv("TFOS_TRACE_REQUESTS", "0")
+    assert trace_lib.requests_enabled() is False
+
+
+def test_trace_store_events_merge_into_chrome_trace(tmp_path):
+    """Retained request spans merge into the Chrome timeline with their
+    trace identity in args (searchable in the viewer), and the result
+    passes the schema gate."""
+    store = trace_lib.TraceStore(capacity=4)
+    rt = trace_lib.RequestTrace("online.request", node="t", tenant="a")
+    rt.add("forward", 0.002, batch_id=7)
+    rt.finish()
+    store.commit(rt, retain="slo_breach")
+    doc = chrome.merge({"t": store.events()})
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert spans
+    for ev in spans:
+        assert ev["args"]["trace_id"] == rt.ctx.trace_id
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    import check_trace
+
+    assert check_trace.validate_doc(doc) == []
+
+
+# ---------------------------------------------------------------------------
+# labeled series + exemplars + OpenMetrics exposition
+# ---------------------------------------------------------------------------
+
+
+def test_labeled_series_share_one_family_type_line():
+    r = reg.Registry()
+    r.counter("req_total").inc(3)
+    r.counter("req_total", labels={"tenant": "a"}).inc()
+    r.counter("req_total", labels={"tenant": "b"}).inc(2)
+    text = reg.snapshot_to_prometheus(r.snapshot())
+    assert text.count("# TYPE tfos_req_total counter") == 1
+    assert 'tfos_req_total 3' in text
+    assert 'tfos_req_total{tenant="a"} 1' in text
+    assert 'tfos_req_total{tenant="b"} 2' in text
+    from tensorflowonspark_tpu.obs import httpd
+    assert httpd.validate_prometheus_text(text) == []
+
+
+def test_labeled_cardinality_bounded_with_overflow_and_remove(monkeypatch):
+    monkeypatch.setenv("TFOS_METRIC_SERIES_MAX", "2")
+    r = reg.Registry()
+    a = r.counter("x_total", labels={"tenant": "a"})
+    b = r.counter("x_total", labels={"tenant": "b"})
+    # over the bound: collapses into the _overflow series, not unbounded
+    c = r.counter("x_total", labels={"tenant": "c"})
+    d = r.counter("x_total", labels={"tenant": "d"})
+    assert c is d
+    assert c.name == 'x_total{tenant="_overflow"}'
+    assert a is r.counter("x_total", labels={"tenant": "a"})  # idempotent
+    # eviction with the owner frees the slot for a new label set
+    assert r.remove("x_total", {"tenant": "a"}) is True
+    assert r.remove("x_total", {"tenant": "a"}) is False
+    e = r.counter("x_total", labels={"tenant": "e"})
+    assert e.name == 'x_total{tenant="e"}'
+    # removing the UNCOUNTED _overflow series must not erode the bound:
+    # repeated overflow create/remove cycles would otherwise let the
+    # family grow past its cap one slot at a time
+    assert r.remove("x_total", {"tenant": "_overflow"}) is True
+    f = r.counter("x_total", labels={"tenant": "f"})
+    assert f.name == 'x_total{tenant="_overflow"}'  # still over the bound
+    del b
+
+
+def test_histogram_exemplar_exposition_and_byte_identical_without():
+    """Classic exposition never changes (exemplars or not); the
+    OpenMetrics flavor annotates the owning bucket line and terminates
+    with # EOF; both validators accept their own format."""
+    from tensorflowonspark_tpu.obs import httpd
+
+    def build(with_exemplar):
+        r = reg.Registry()
+        h = r.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+        h.observe(0.05, exemplar={"trace_id": "ab" * 16}
+                  if with_exemplar else None)
+        return r
+
+    plain = build(False)
+    traced = build(True)
+    assert (reg.snapshot_to_prometheus(plain.snapshot())
+            == reg.snapshot_to_prometheus(traced.snapshot()))
+    om = traced.to_openmetrics()
+    want = ('tfos_lat_seconds_bucket{le="0.1"} 1 '
+            '# {trace_id="' + "ab" * 16 + '"}')
+    assert want in om
+    assert om.rstrip().endswith("# EOF")
+    assert httpd.validate_openmetrics_text(om) == []
+    assert httpd.validate_prometheus_text(om.replace("# EOF\n", "")) == []
+    # classic mode without EOF is fine; openmetrics without EOF is not
+    assert httpd.validate_openmetrics_text(
+        reg.snapshot_to_prometheus(traced.snapshot())) != []
+
+
+def test_exemplars_survive_snapshot_merge_freshest_wins():
+    r1, r2 = reg.Registry(), reg.Registry()
+    h1 = r1.histogram("lat_seconds", buckets=(0.1,))
+    h2 = r2.histogram("lat_seconds", buckets=(0.1,))
+    h1.observe(0.05, exemplar={"trace_id": "aa" * 16})
+    time.sleep(0.01)
+    h2.observe(0.06, exemplar={"trace_id": "bb" * 16})
+    merged = reg.merge_snapshots({"n1": r1.snapshot(), "n2": r2.snapshot()})
+    ex = merged["histograms"]["lat_seconds"]["exemplars"]["0.1"]
+    assert ex[0]["trace_id"] == "bb" * 16  # freshest ts won
+    # an exemplar-free merge keeps the historical export shape
+    r3 = reg.Registry()
+    r3.histogram("lat_seconds", buckets=(0.1,)).observe(0.01)
+    merged = reg.merge_snapshots({"n": r3.snapshot()})
+    assert "exemplars" not in merged["histograms"]["lat_seconds"]
+
+
+def test_openmetrics_validator_catches_violations():
+    from tensorflowonspark_tpu.obs import httpd
+
+    bad_exemplar = ('# TYPE m histogram\n'
+                    'm_bucket{le="+Inf"} 1 # not-an-exemplar 1\n'
+                    'm_sum 1\nm_count 1\n# EOF\n')
+    assert any("exemplar" in p
+               for p in httpd.validate_openmetrics_text(bad_exemplar))
+    on_non_bucket = ('# TYPE m counter\n'
+                     'm 1 # {trace_id="ab"} 1\n# EOF\n')
+    assert any("non-bucket" in p
+               for p in httpd.validate_openmetrics_text(on_non_bucket))
+    after_eof = '# TYPE m counter\nm 1\n# EOF\nm 2\n'
+    assert any("after" in p
+               for p in httpd.validate_openmetrics_text(after_eof))
+
+
+def test_series_label_values_with_backslashes_round_trip():
+    """split_series/_unescape must decode escaped values in one pass —
+    'C:\\new' must NOT come back with a newline in it."""
+    key = reg.series_key("m_total", {"path": "C:\\new", "q": 'say "hi"\n'})
+    fam, labels = reg.split_series(key)
+    assert fam == "m_total"
+    assert labels == {"path": "C:\\new", "q": 'say "hi"\n'}
+
+
+def test_validator_does_not_missplit_hash_inside_label_value():
+    from tensorflowonspark_tpu.obs import httpd
+
+    text = ('# TYPE m counter\n'
+            'm{path="/a # b"} 1\n'
+            'm{path="/a # {x"} 2\n')
+    assert httpd.validate_prometheus_text(text) == []

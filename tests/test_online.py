@@ -516,3 +516,353 @@ def test_healthz_503_after_stop(export_dir):
         assert ei.value.code == 503
     finally:
         http.stop()
+
+
+# ---------------------------------------------------------------------------
+# request-scoped tracing (ISSUE 10): span trees, tail retention, exemplars
+# ---------------------------------------------------------------------------
+
+import os
+import sys
+
+from tensorflowonspark_tpu.obs import trace as trace_lib
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import check_trace  # noqa: E402
+
+
+def _post_traced(url, doc, headers=None, timeout=30.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def test_request_tracing_e2e_http(export_dir, monkeypatch):
+    """The acceptance e2e: a deliberately slow (SLO-breaching) request
+    driven through the real HTTP path with a supplied traceparent yields
+    a retained trace on /debug/requests whose span tree names its
+    coalesced batch and flush trigger; the tenant's latency histogram
+    exposes an exemplar carrying that trace id; a fast request under the
+    sample floor retains nothing."""
+    monkeypatch.setenv("TFOS_TRACE_SAMPLE", "0")  # tail retention only
+    monkeypatch.setenv("TFOS_TRACE_ARM", "1")  # capture every request
+    store = trace_lib.get_trace_store()
+    store.clear()
+    # tenant "slow": a 0-ms SLO every real request breaches (the
+    # deliberate delay); tenant "fast": an SLO nothing breaches
+    srv = online.OnlineServer()
+    for name, slo in (("slow", 0.0), ("fast", 60_000.0)):
+        srv.add_tenant(
+            name, export_dir=export_dir, predict_fn=_predict,
+            batch_size=8, bucket_sizes=[2, 8], flush_ms=5.0, slo_ms=slo,
+            warmup_example={"features": np.zeros(4, np.float32)})
+    srv.start()
+    http = online.OnlineHTTPServer(srv)
+    http.start()
+    try:
+        ctx = trace_lib.TraceContext.new()
+        x = _rows(1, seed=11)
+        status, doc = _post_traced(
+            http.url("/v1/predict"),
+            {"tenant": "slow", "inputs": {"features": x.tolist()}},
+            headers={"traceparent": ctx.traceparent()})
+        assert status == 200
+        assert doc["trace_id"] == ctx.trace_id  # joined the caller's trace
+        np.testing.assert_allclose(np.asarray(doc["outputs"]["score"]),
+                                   x @ W, rtol=1e-5)
+
+        # the fast request under the sample floor retains NOTHING
+        _post_traced(http.url("/v1/predict"),
+                     {"tenant": "fast",
+                      "inputs": {"features": _rows(1, seed=12).tolist()}})
+
+        # drop accounting lands just AFTER the reply is scattered — poll
+        # until both requests' commits are visible
+        deadline = time.perf_counter() + 10.0
+        while True:
+            with urllib.request.urlopen(http.url("/debug/requests"),
+                                        timeout=10) as r:
+                debug = json.loads(r.read().decode())
+            if debug["committed"] >= 2 or time.perf_counter() > deadline:
+                break
+            time.sleep(0.01)
+        # the schema gate the tooling enforces
+        assert check_trace.validate_requests_doc(debug) == []
+        traces = {t["trace_id"]: t for t in debug["retained"]}
+        assert ctx.trace_id in traces
+        mine = traces[ctx.trace_id]
+        assert mine["retained"] == "slo_breach"
+        assert mine["status"] == "ok"
+        # the root joined the inbound context: its parent is the remote
+        # caller's span
+        assert mine["parent_span_id"] == ctx.span_id
+        spans = {s["name"]: s for s in mine["spans"]}
+        assert set(spans) == {"admission", "queue", "coalesce", "forward",
+                              "reply", "online.request"}
+        coalesce = spans["coalesce"]["attrs"]
+        assert coalesce["flush"] in ("deadline", "full_bucket",
+                                     "engine_idle")
+        assert coalesce["batch_id"] >= 1
+        assert coalesce["bucket"] in (2, 8)
+        assert 0.0 <= coalesce["pad_waste"] < 1.0
+        assert coalesce["batch_mates"] == []  # it rode alone
+        assert spans["forward"]["attrs"]["batch_id"] == \
+            coalesce["batch_id"]
+        # the fast tenant's request committed but was dropped whole
+        assert debug["committed"] >= 2
+        assert all(t.get("name") != "fast" and
+                   (t["spans"][-1]["attrs"] or {}).get("tenant") != "fast"
+                   for t in debug["retained"])
+
+        # exemplar linkage: the OpenMetrics /metrics carries the retained
+        # trace id on the slow tenant's latency histogram
+        req = urllib.request.Request(
+            http.url("/metrics"),
+            headers={"Accept": "application/openmetrics-text"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            om = r.read().decode()
+            ctype = r.headers["Content-Type"]
+        assert "openmetrics" in ctype
+        from tensorflowonspark_tpu.obs import httpd
+        assert httpd.validate_openmetrics_text(om) == []
+        assert f'trace_id="{ctx.trace_id}"' in om
+        exemplar_lines = [ln for ln in om.splitlines()
+                          if ctx.trace_id in ln]
+        assert any('online_request_seconds_bucket' in ln
+                   and 'tenant="slow"' in ln for ln in exemplar_lines)
+        # classic scrape: no exemplars, still valid, labeled + legacy
+        # series both present (one round of dual publication)
+        with urllib.request.urlopen(http.url("/metrics"), timeout=10) as r:
+            classic = r.read().decode()
+        assert httpd.validate_prometheus_text(classic) == []
+        assert ctx.trace_id not in classic
+        assert 'online_request_seconds_bucket{le="0.001",tenant="slow"}' \
+            in classic
+        assert "online_request_seconds_slow_bucket" in classic
+    finally:
+        http.stop()
+        srv.stop()
+        store.clear()
+
+
+def test_batch_mates_cross_reference(export_dir, monkeypatch):
+    """Batch-level causality: two requests coalescing into one batch name
+    each other's trace ids in their coalesce spans."""
+    monkeypatch.setenv("TFOS_TRACE_SAMPLE", "0")
+    monkeypatch.setenv("TFOS_TRACE_ARM", "1")
+    store = trace_lib.get_trace_store()
+    store.clear()
+    gate = threading.Event()
+
+    def gated_predict(p, b):
+        gate.wait(timeout=30.0)
+        return _predict(p, b)
+
+    srv = _server(export_dir, predict_fn=gated_predict, flush_ms=250.0,
+                  warmup=False, slo_ms=0.0)  # everything breaches → kept
+    try:
+        results = []
+
+        def go(seed):
+            results.append(
+                srv.submit("a", {"features": _rows(1, seed=seed)},
+                           timeout=30.0))
+
+        # request 1 occupies the (gated) forward; 2 and 3 queue behind it
+        t1 = threading.Thread(target=go, args=(1,), daemon=True)
+        t1.start()
+        deadline = time.perf_counter() + 10.0
+        while srv.stats()["tenants"]["a"]["pending_rows"] != 0 \
+                and time.perf_counter() < deadline:
+            time.sleep(0.005)  # request 1 drained into the stalled batch
+        t2 = threading.Thread(target=go, args=(2,), daemon=True)
+        t3 = threading.Thread(target=go, args=(3,), daemon=True)
+        t2.start(), t3.start()
+        deadline = time.perf_counter() + 10.0
+        while srv.stats()["tenants"]["a"]["pending_rows"] < 2 \
+                and time.perf_counter() < deadline:
+            time.sleep(0.005)
+        gate.set()
+        for t in (t1, t2, t3):
+            t.join(timeout=30.0)
+        assert len(results) == 3
+        retained = store.recent(limit=10)
+        assert check_trace.validate_requests_doc(retained) == []
+        # find the two that rode together (requests 2+3 coalesced while
+        # request 1 computed)
+        mates = [t for t in retained
+                 if (t["spans"] and any(
+                     (s.get("attrs") or {}).get("batch_mates")
+                     for s in t["spans"]))]
+        assert len(mates) >= 2, [t["trace_id"] for t in retained]
+        ids = {t["trace_id"] for t in mates}
+        for t in mates:
+            co = next(s for s in t["spans"] if s["name"] == "coalesce")
+            listed = set(co["attrs"]["batch_mates"])
+            assert listed and listed <= (ids - {t["trace_id"]})
+    finally:
+        gate.set()
+        srv.stop()
+        store.clear()
+
+
+def test_shed_and_error_requests_are_tail_retained(export_dir, monkeypatch):
+    monkeypatch.setenv("TFOS_TRACE_SAMPLE", "0")
+    store = trace_lib.get_trace_store()
+    store.clear()
+    gate = threading.Event()
+
+    def gated_predict(p, b):
+        gate.wait(timeout=30.0)
+        return _predict(p, b)
+
+    srv = _server(export_dir, predict_fn=gated_predict, flush_ms=1.0,
+                  warmup=False, slo_ms=60_000.0,
+                  max_pending_mb=3 * 16 / (1 << 20))
+    try:
+        threads = []
+        for _ in range(10):
+            t = threading.Thread(
+                target=lambda: _swallow(srv), daemon=True)
+            t.start()
+            threads.append(t)
+            time.sleep(0.02)
+        with pytest.raises(online.Rejected):
+            srv.submit("a", {"features": _rows(1)}, timeout=0.5)
+    finally:
+        gate.set()
+        srv.stop()
+    sheds = [t for t in store.recent(limit=50) if t["status"] == "shed"]
+    assert sheds
+    shed = sheds[0]
+    assert shed["retained"] == "shed"
+    admission = next(s for s in shed["spans"] if s["name"] == "admission")
+    assert admission["attrs"]["outcome"] == "shed"
+    assert admission["attrs"]["max_pending_bytes"] > 0
+    assert check_trace.validate_requests_doc(sheds) == []
+    store.clear()
+
+
+def _swallow(srv):
+    try:
+        srv.submit("a", {"features": _rows(1)}, timeout=60.0)
+    except Exception:
+        pass
+
+
+def test_tracing_disabled_by_env_retains_nothing(export_dir, monkeypatch):
+    monkeypatch.setenv("TFOS_TRACE_REQUESTS", "0")
+    store = trace_lib.get_trace_store()
+    store.clear()
+    srv = _server(export_dir, flush_ms=1.0, slo_ms=0.0)
+    try:
+        out = srv.submit("a", {"features": _rows(1)}, timeout=10.0)
+        assert "score" in out
+        assert store.committed == 0 and store.recent() == []
+    finally:
+        srv.stop()
+
+
+def test_healthz_reports_shed_window_and_slo(export_dir):
+    srv = _server(export_dir, flush_ms=2.0)
+    try:
+        srv.submit("a", {"features": _rows(1)}, timeout=10.0)
+        doc = srv.stats()["tenants"]["a"]
+        assert doc["slo_ms"] == 20.0  # default: 10 × flush_ms
+        win = doc["shed_window"]
+        assert win["offered"] >= 1 and win["shed"] == 0
+        assert win["shed_rate"] == 0.0
+        assert win["window_s"] > 0
+    finally:
+        srv.stop()
+
+
+def test_shed_window_rate_rises_and_tumbles():
+    win = online._ShedWindow(interval_s=10.0)
+    now = 1000.0
+    for _ in range(8):
+        win.note(shed=False, now=now)
+    for _ in range(2):
+        win.note(shed=True, now=now)
+    snap = win.snapshot(now=now)
+    assert snap["offered"] == 10 and snap["shed"] == 2
+    assert snap["shed_rate"] == 0.2
+    # one interval later the counts survive (prev bucket)...
+    snap = win.snapshot(now=now + 10.0)
+    assert snap["offered"] == 10
+    # ...two intervals later the window has tumbled them out
+    snap = win.snapshot(now=now + 20.0)
+    assert snap["offered"] == 0 and snap["shed_rate"] == 0.0
+
+
+def test_remove_tenant_evicts_metric_series(export_dir):
+    srv = _server(export_dir, tenants=("a", "b"))
+    try:
+        srv.submit("a", {"features": _rows(1)}, timeout=10.0)
+        text = obs.get_registry().to_prometheus()
+        assert 'online_tenant_requests_total{tenant="a"}' in text
+        srv.remove_tenant("a")
+        text = obs.get_registry().to_prometheus()
+        assert 'online_tenant_requests_total{tenant="a"}' not in text
+        with pytest.raises(KeyError):
+            srv.submit("a", {"features": _rows(1)})
+        # tenant b unaffected
+        out = srv.submit("b", {"features": _rows(1)}, timeout=10.0)
+        assert "score" in out
+    finally:
+        srv.stop()
+
+
+def test_remove_tenant_evicts_legacy_series_too(export_dir):
+    """Eviction covers the one-round name-mangled aliases as well — a
+    removed tenant must not pin ANY registry slot."""
+    srv = _server(export_dir, tenants=("gone",))
+    try:
+        srv.submit("gone", {"features": _rows(1)}, timeout=10.0)
+        text = obs.get_registry().to_prometheus()
+        assert "online_request_seconds_gone" in text
+        srv.remove_tenant("gone")
+        text = obs.get_registry().to_prometheus()
+        assert "online_requests_gone_total" not in text
+        assert "online_shed_gone_total" not in text
+        assert "online_request_seconds_gone" not in text
+    finally:
+        srv.stop()
+
+
+def test_timeout_commit_not_double_counted_by_late_reply(export_dir,
+                                                         monkeypatch):
+    """A caller-side timeout claims and commits the trace; the late reply
+    must neither commit again nor count the request as dropped."""
+    monkeypatch.setenv("TFOS_TRACE_SAMPLE", "0")
+    monkeypatch.setenv("TFOS_TRACE_ARM", "1")
+    store = trace_lib.get_trace_store()
+    store.clear()
+    gate = threading.Event()
+
+    def gated_predict(p, b):
+        gate.wait(timeout=30.0)
+        return _predict(p, b)
+
+    srv = _server(export_dir, predict_fn=gated_predict, flush_ms=1.0,
+                  warmup=False, slo_ms=60_000.0)
+    try:
+        with pytest.raises(TimeoutError):
+            srv.submit("a", {"features": _rows(1)}, timeout=0.3)
+        gate.set()
+        deadline = time.perf_counter() + 10.0
+        while store.committed < 1 and time.perf_counter() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.3)  # let the late reply's bookkeeping run
+        assert store.committed == 1  # timeout commit only, no double count
+        retained = store.recent()
+        assert len(retained) == 1 and retained[0]["status"] == "timeout"
+    finally:
+        gate.set()
+        srv.stop()
+        store.clear()

@@ -384,3 +384,38 @@ def test_input_specs_polymorphic_nonbatch_dim_is_value_error():
         serving.input_specs()
     with pytest.raises(ValueError, match="no inputs"):
         serving.input_specs(signature={"inputs": []})
+
+
+def test_note_compile_hit_miss_counters_and_compile_seconds():
+    """The hit/miss counter split + compile-seconds histogram (the
+    persistent-compile-cache groundwork, ROADMAP item 4): every miss is a
+    compile, every repeat is a hit, and warm_buckets times its forced
+    warm forwards into serving_compile_seconds."""
+    import numpy as np
+
+    from tensorflowonspark_tpu import obs, serving
+
+    misses = obs.counter("serving_compile_cache_misses_total")
+    hits = obs.counter("serving_compile_cache_hits_total")
+    compiles = obs.counter("serving_compiles_total")
+    hist = obs.histogram("serving_compile_seconds")
+    m0, h0, c0, n0 = misses.value, hits.value, compiles.value, hist.count
+    key = ("hit_miss_test", id(object()))
+    b = {"x": np.zeros((4, 2), np.float32)}
+    assert serving.note_compile(key, b) is True
+    assert serving.note_compile(key, dict(b)) is False
+    assert serving.note_compile(key, dict(b)) is False
+    assert misses.value - m0 == 1
+    assert compiles.value - c0 == 1  # compiles == misses today
+    assert hits.value - h0 == 2
+    serving.observe_compile_seconds(0.25)
+    assert hist.count - n0 == 1
+
+    # warm_buckets reports one compile-seconds observation per bucket
+    key2 = ("hit_miss_warm", id(object()))
+    specs = {"x": ((2,), np.float32)}
+    n1 = hist.count
+    serving.warm_buckets(lambda p, batch: {"y": batch["x"] * 2}, None,
+                         specs, (2, 8), key2)
+    assert hist.count - n1 == 2
+    assert misses.value - m0 == 3

@@ -52,7 +52,12 @@ p99-bound config identity — a closed-loop throughput is only meaningful AT
 its measured p99, so a numeric value must ship ``online_p99_ms`` within
 ``online_slo_ms`` (or explicit ``null`` + ``online_reason``); healthy
 numbers are only compared across runs with the same client count, model
-geometry, bucket ladder and SLO.
+geometry, bucket ladder and SLO.  From round ``--require-trace-from``
+(default 12, the round that introduced request-scoped tracing) the primary
+half must carry ``trace_overhead_frac`` — the A/B-measured cost of
+request tracing on the online path (enabled vs ``TFOS_TRACE_REQUESTS=0``)
+— as a fraction in [-1, 1], or an explicit ``null`` +
+``trace_overhead_reason`` (same convention as the flight breakdowns).
 
 Usage::
 
@@ -95,6 +100,10 @@ DEFAULT_REQUIRE_RECOVERY_FROM = 10
 #: first round whose primary half must carry the online-serving microbench
 #: (``online_rows_per_sec``, introduced with the continuous-batching tier)
 DEFAULT_REQUIRE_ONLINE_FROM = 11
+#: first round whose primary half must carry the measured request-tracing
+#: overhead (``trace_overhead_frac``, introduced with request-scoped
+#: distributed tracing)
+DEFAULT_REQUIRE_TRACE_FROM = 12
 #: |stage_sum / wall - 1| beyond this fails the artifact: a breakdown that
 #: does not add up is decoration, not attribution
 DEFAULT_FLIGHT_TOLERANCE = 0.15
@@ -112,6 +121,7 @@ _RECOVERY_IDENT_KEYS = ("recovery_num_executors",
                         "recovery_ckpt_every_steps",
                         "recovery_kill_at_step", "recovery_batch_size")
 _ONLINE_KEY = "online_rows_per_sec"
+_TRACE_OVERHEAD_KEY = "trace_overhead_frac"
 #: the online microbench's config identity: closed-loop rows/sec is only
 #: comparable at the same client count / request volume / model geometry /
 #: bucket ladder AND the same p99 SLO — a number sustained at a looser
@@ -236,7 +246,8 @@ def validate_half(half: dict[str, Any], *,
                   require_feed: bool = False,
                   require_serving: bool = False,
                   require_recovery: bool = False,
-                  require_online: bool = False) -> list[str]:
+                  require_online: bool = False,
+                  require_trace: bool = False) -> list[str]:
     """Schema problems of one measured result (a wrapper's half)."""
     problems = []
     for key in _REQUIRED_HALF_KEYS:
@@ -346,6 +357,26 @@ def validate_half(half: dict[str, Any], *,
                     f"online_p99_ms {p99} exceeds online_slo_ms {slo}: a "
                     "throughput claimed at an SLO it missed is not a "
                     "measurement")
+    # request-tracing overhead: A/B-measured on the online path, so a
+    # degraded-accelerator round still owes it; null + reason always
+    # satisfies (e.g. TFOS_TRACE_REQUESTS=0 runs have no A to B against)
+    if require_trace or _TRACE_OVERHEAD_KEY in half:
+        if _TRACE_OVERHEAD_KEY not in half:
+            problems.append(
+                f"missing {_TRACE_OVERHEAD_KEY!r} (measured tracing "
+                "overhead is part of the schema from r12: A/B it or "
+                "stamp an explicit null + 'trace_overhead_reason')")
+        elif half[_TRACE_OVERHEAD_KEY] is None \
+                and "trace_overhead_reason" not in half:
+            problems.append(
+                f"{_TRACE_OVERHEAD_KEY!r} is null without a "
+                "'trace_overhead_reason'")
+        elif isinstance(half.get(_TRACE_OVERHEAD_KEY), (int, float)) \
+                and not -1.0 <= half[_TRACE_OVERHEAD_KEY] <= 1.0:
+            problems.append(
+                f"{_TRACE_OVERHEAD_KEY!r} {half[_TRACE_OVERHEAD_KEY]} is "
+                "not a fraction in [-1, 1] — it is 1 - traced/untraced "
+                "throughput")
     return problems
 
 
@@ -457,7 +488,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
          require_flight_from: int = DEFAULT_REQUIRE_FLIGHT_FROM,
          flight_tolerance: float = DEFAULT_FLIGHT_TOLERANCE,
          require_recovery_from: int = DEFAULT_REQUIRE_RECOVERY_FROM,
-         require_online_from: int = DEFAULT_REQUIRE_ONLINE_FROM
+         require_online_from: int = DEFAULT_REQUIRE_ONLINE_FROM,
+         require_trace_from: int = DEFAULT_REQUIRE_TRACE_FROM
          ) -> dict[str, Any]:
     """Run the gate over a trajectory; returns the verdict document."""
     checks: list[dict[str, Any]] = []
@@ -501,11 +533,14 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                           and art["n"] >= require_recovery_from)
             require_on = (label == "primary"
                           and art["n"] >= require_online_from)
+            require_tr = (label == "primary"
+                          and art["n"] >= require_trace_from)
             for problem in validate_half(half, require_roofline=require_rf,
                                          require_feed=require_fd,
                                          require_serving=require_sv,
                                          require_recovery=require_rc,
-                                         require_online=require_on):
+                                         require_online=require_on,
+                                         require_trace=require_tr):
                 check(f"schema:{name}:{label}",
                       "fail" if is_newest else "warn", problem)
             # flight breakdowns ride the primary half with the microbench
@@ -692,6 +727,8 @@ def main(argv: list[str] | None = None) -> int:
                    default=DEFAULT_REQUIRE_RECOVERY_FROM)
     p.add_argument("--require-online-from", type=int,
                    default=DEFAULT_REQUIRE_ONLINE_FROM)
+    p.add_argument("--require-trace-from", type=int,
+                   default=DEFAULT_REQUIRE_TRACE_FROM)
     args = p.parse_args(argv)
     paths = args.paths or discover(args.repo)
     if not paths:
@@ -706,7 +743,8 @@ def main(argv: list[str] | None = None) -> int:
                require_flight_from=args.require_flight_from,
                flight_tolerance=args.flight_tolerance,
                require_recovery_from=args.require_recovery_from,
-               require_online_from=args.require_online_from)
+               require_online_from=args.require_online_from,
+               require_trace_from=args.require_trace_from)
     print(json.dumps(doc))
     return 1 if doc["verdict"] == "fail" else 0
 

@@ -12,11 +12,22 @@ Checks the artifact ``TFCluster.dump_trace`` / ``bench.py`` write:
   event (the merged-node contract of ``obs.chrome.merge``);
 - non-metadata events are sorted by ``(ts, pid, tid, name)`` — the
   determinism guarantee ``tests/test_obs.py`` relies on;
-- ``args``, when present, is a JSON object.
+- ``args``, when present, is a JSON object, and any trace identity it
+  carries (``trace_id`` / ``span_id`` / ``parent_span_id``) is
+  well-formed W3C hex.
+
+``--requests`` switches to the request-span schema (the
+``/debug/requests`` JSON the online tier serves — retained tail-sampled
+span trees): every trace has a 32-hex ``trace_id``, every span a unique
+16-hex ``span_id`` on the same trace, parent linkage resolves (exactly
+one root; the root's parent may be the upstream caller's span), the
+parent graph is acyclic, and ``batch_mates`` lists are well-formed
+foreign trace ids (never the trace's own).
 
 Usage::
 
     python tools/check_trace.py TRACE.json [TRACE2.json ...]
+    python tools/check_trace.py --requests REQUESTS.json [...]
 
 Exit code 0 when every file validates, 1 otherwise (problems on stderr).
 Wired into tier-1 via ``tests/test_check_trace.py`` so a malformed event
@@ -26,9 +37,13 @@ fails the suite, not a downstream trace viewer.
 from __future__ import annotations
 
 import json
+import re
 import sys
 
 VALID_PHASES = {"X", "i", "M"}
+
+TRACE_ID_RE = re.compile(r"^[0-9a-f]{32}$")
+SPAN_ID_RE = re.compile(r"^[0-9a-f]{16}$")
 
 
 def validate_doc(doc: object) -> list[str]:
@@ -59,6 +74,21 @@ def validate_doc(doc: object) -> list[str]:
                                 f"got {ev.get(field)!r}")
         if "args" in ev and not isinstance(ev["args"], dict):
             problems.append(f"{where}: 'args' must be an object")
+        elif isinstance(ev.get("args"), dict):
+            args = ev["args"]
+            tid = args.get("trace_id")
+            if tid is not None and not (isinstance(tid, str)
+                                        and TRACE_ID_RE.match(tid)):
+                problems.append(
+                    f"{where}: malformed args.trace_id {tid!r} "
+                    "(32 lowercase hex)")
+            for field in ("span_id", "parent_span_id"):
+                sid = args.get(field)
+                if sid is not None and not (isinstance(sid, str)
+                                            and SPAN_ID_RE.match(sid)):
+                    problems.append(
+                        f"{where}: malformed args.{field} {sid!r} "
+                        "(16 lowercase hex)")
         if ph == "M":
             if ev.get("name") == "process_name":
                 name = (ev.get("args") or {}).get("name")
@@ -101,22 +131,151 @@ def validate_doc(doc: object) -> list[str]:
     return problems
 
 
-def validate_file(path: str) -> list[str]:
+def _validate_request_trace(trace: object, where: str) -> list[str]:
+    """One retained request trace (a ``/debug/requests`` entry)."""
+    problems: list[str] = []
+    if not isinstance(trace, dict):
+        return [f"{where}: trace must be an object"]
+    trace_id = trace.get("trace_id")
+    if not (isinstance(trace_id, str) and TRACE_ID_RE.match(trace_id)):
+        problems.append(f"{where}: malformed trace_id {trace_id!r} "
+                        "(32 lowercase hex)")
+        trace_id = None
+    root_sid = trace.get("root_span_id")
+    if not (isinstance(root_sid, str) and SPAN_ID_RE.match(root_sid)):
+        problems.append(f"{where}: malformed root_span_id {root_sid!r}")
+        root_sid = None
+    upstream = trace.get("parent_span_id")
+    if upstream is not None and not (isinstance(upstream, str)
+                                     and SPAN_ID_RE.match(upstream)):
+        problems.append(f"{where}: malformed parent_span_id {upstream!r}")
+        upstream = None
+    spans = trace.get("spans")
+    if not isinstance(spans, list) or not spans:
+        problems.append(f"{where}: 'spans' must be a non-empty list")
+        return problems
+
+    by_id: dict = {}
+    parents: dict = {}
+    roots = 0
+    for i, sp in enumerate(spans):
+        swhere = f"{where}.spans[{i}]"
+        if not isinstance(sp, dict):
+            problems.append(f"{swhere}: not an object")
+            continue
+        if not isinstance(sp.get("name"), str) or not sp["name"]:
+            problems.append(f"{swhere}: missing span name")
+        for field in ("ts", "dur"):
+            v = sp.get(field)
+            if not isinstance(v, (int, float)) or v < 0:
+                problems.append(f"{swhere}: {field!r} must be a "
+                                f"non-negative number, got {v!r}")
+        if trace_id is not None and sp.get("trace_id") != trace_id:
+            problems.append(
+                f"{swhere}: trace_id {sp.get('trace_id')!r} differs from "
+                f"the trace's {trace_id!r}")
+        sid = sp.get("span_id")
+        if not (isinstance(sid, str) and SPAN_ID_RE.match(sid)):
+            problems.append(f"{swhere}: malformed span_id {sid!r}")
+            continue
+        if sid in by_id:
+            problems.append(f"{swhere}: duplicate span_id {sid!r}")
+            continue
+        by_id[sid] = sp
+        psid = sp.get("parent_span_id")
+        if psid is not None and not (isinstance(psid, str)
+                                     and SPAN_ID_RE.match(psid)):
+            problems.append(f"{swhere}: malformed parent_span_id {psid!r}")
+            psid = None
+        parents[sid] = psid
+        if psid is None or psid == upstream:
+            roots += 1
+            if root_sid is not None and sid != root_sid:
+                problems.append(
+                    f"{swhere}: root-shaped span {sid!r} is not the "
+                    f"declared root_span_id {root_sid!r}")
+        # batch-level causality: mate ids must be plausible foreign traces
+        mates = (sp.get("attrs") or {}).get("batch_mates")
+        if mates is not None:
+            if not isinstance(mates, list):
+                problems.append(f"{swhere}: 'batch_mates' must be a list")
+            else:
+                for m in mates:
+                    if not (isinstance(m, str) and TRACE_ID_RE.match(m)):
+                        problems.append(
+                            f"{swhere}: malformed batch-mate trace id "
+                            f"{m!r}")
+                    elif m == trace_id:
+                        problems.append(
+                            f"{swhere}: batch_mates lists the trace's "
+                            "own id")
+    if roots != 1:
+        problems.append(
+            f"{where}: expected exactly one root span, found {roots}")
+    for sid, psid in parents.items():
+        if psid is not None and psid != upstream and psid not in by_id:
+            problems.append(
+                f"{where}: span {sid!r} parent {psid!r} resolves to no "
+                "span in the trace (and is not the upstream parent)")
+    # cycle check: walk each span's parent chain with a visited set
+    for sid in parents:
+        seen = set()
+        cur = sid
+        while cur is not None and cur in parents:
+            if cur in seen:
+                problems.append(
+                    f"{where}: parent linkage cycle through span {cur!r}")
+                break
+            seen.add(cur)
+            cur = parents[cur]
+            if cur == upstream:
+                break
+    return problems
+
+
+def validate_requests_doc(doc: object) -> list[str]:
+    """Validate a ``/debug/requests`` document (or a bare trace list).
+
+    The request-span schema: per-trace id formats, unique span ids,
+    parent linkage that resolves (one root; the root's parent may be the
+    upstream caller's span id), an acyclic parent graph, and well-formed
+    ``batch_mates`` trace ids.
+    """
+    if isinstance(doc, dict):
+        traces = doc.get("retained")
+        if not isinstance(traces, list):
+            return ["missing/invalid 'retained' (must be a list)"]
+    elif isinstance(doc, list):
+        traces = doc
+    else:
+        return [f"top level must be an object or list, got "
+                f"{type(doc).__name__}"]
+    problems: list[str] = []
+    for i, trace in enumerate(traces):
+        problems.extend(_validate_request_trace(trace, f"retained[{i}]"))
+    return problems
+
+
+def validate_file(path: str, requests: bool = False) -> list[str]:
     try:
         with open(path) as f:
             doc = json.load(f)
     except (OSError, json.JSONDecodeError) as e:
         return [f"cannot read/parse {path}: {e}"]
-    return validate_doc(doc)
+    return validate_requests_doc(doc) if requests else validate_doc(doc)
 
 
 def main(argv: list[str]) -> int:
+    requests = False
+    if argv and argv[0] == "--requests":
+        requests = True
+        argv = argv[1:]
     if not argv:
         print(__doc__, file=sys.stderr)
         return 2
     rc = 0
     for path in argv:
-        problems = validate_file(path)
+        problems = validate_file(path, requests=requests)
         if problems:
             rc = 1
             for p in problems:
