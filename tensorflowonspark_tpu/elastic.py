@@ -66,6 +66,32 @@ REGROUP_KEY = "elastic:regroup"
 RESUMED_KEY = "elastic:resumed"
 
 
+def poll_command(client: "reservation.Client", key: str,
+                 min_gen: int) -> dict[str, Any] | None:
+    """One non-blocking poll of a generation-stamped kv command.
+
+    The shared heartbeat-cadence discipline of every control-plane
+    watcher (the trainer-side :class:`ElasticWorker`, the serving-mesh
+    :class:`tensorflowonspark_tpu.mesh.ReplicaAgent`): read ``key`` off
+    the rendezvous kv, swallow absence and transient socket errors (the
+    loop's next tick IS the retry), and return the command only when it
+    is a dict stamped with a generation PAST ``min_gen`` — stale and
+    replayed commands are not news.
+    """
+    try:
+        cmd = client.get(key, timeout=0.0)
+    except KeyError:
+        return None
+    except Exception as e:  # driver restarting / transient socket
+        logger.debug("command poll of %r failed: %s", key, e)
+        return None
+    if not isinstance(cmd, dict):
+        return None
+    if int(cmd.get("gen", 0)) <= min_gen:
+        return None
+    return cmd
+
+
 class RegroupSignal(Exception):
     """Raised between steps (``Trainer.attach_elastic``) when a regroup
     command is pending; carries the command so the catcher can rejoin."""
@@ -130,14 +156,12 @@ class ElasticWorker:
 
     def _poll(self) -> None:
         while not self._stop.wait(self.poll_interval):
-            try:
-                cmd = self._client.get(REGROUP_KEY, timeout=0.0)
-            except KeyError:
-                continue
-            except Exception as e:  # driver restarting / transient socket
-                logger.debug("elastic poll failed: %s", e)
-                continue
-            if not isinstance(cmd, dict):
+            with self._lock:
+                floor = max(self.generation,
+                            int(self._pending.get("gen", 0))
+                            if self._pending else 0)
+            cmd = poll_command(self._client, REGROUP_KEY, floor)
+            if cmd is None:
                 continue
             gen = int(cmd.get("gen", 0))
             with self._lock:

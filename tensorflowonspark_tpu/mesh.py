@@ -1,0 +1,1254 @@
+"""Multi-host online serving mesh: replica registry on the reservation
+control plane, tenant-placement router, global admission control.
+
+PR 9's :class:`~tensorflowonspark_tpu.online.OnlineServer` is one process;
+one box caps the "millions of users" tier at what one coalescer and one
+compute thread can push.  This module is the horizontal tier — many
+replica processes behind one thin router — built the way TF-Replicator
+(PAPERS.md 1902.00465) and the TensorFlow system paper (1605.08695) argue
+for: an explicit, thin control plane for placement and membership, with
+the data path left exactly as PR 9 compiled it (the router adds one HTTP
+hop and nothing else to a request).
+
+Four pieces over the PR 8 generation-fenced rendezvous
+(:mod:`tensorflowonspark_tpu.reservation`):
+
+- **Replica registry** (inside :class:`MeshRouter`): the router owns a
+  ``reservation.Server``; every serving replica registers its
+  ``(replica_id, host, port)`` through a ``reservation.Client`` and the
+  gen-0 barrier forms the mesh.  A replica *joining or leaving IS a
+  regroup*: the router opens generation N+1 sized to the survivors
+  (``Server.begin_generation``), broadcasts a ``mesh:regroup`` command on
+  the rendezvous kv, and the survivors re-register under the new
+  generation — so a zombie replica of a regrouped-away epoch is fenced
+  (``StaleGenerationError``) instead of corrupting the registry, exactly
+  the discipline elastic training established for executors.  A joining
+  replica announces itself on ``mesh:join:<id>`` and is absorbed by the
+  next regroup's barrier.
+- **Tenant-placement router** (:class:`MeshRouter`): tenants are placed
+  onto replicas by their *coalescing identity* — the
+  ``pipeline.model_cache_key`` plus bucket ladder plus input/output
+  mapping, the same tuple ``online._ModelGroup`` keys on — so tenants
+  that would share batches in one process land on one replica and KEEP
+  sharing batches, until that replica's byte-bound capacity saturates
+  and the next same-model tenant spills to another replica.  Placements
+  are published as one versioned document on the kv
+  (``mesh:placement``); each replica's :class:`ReplicaAgent` applies its
+  own assignment (``OnlineServer.add_tenant`` / ``remove_tenant``) and
+  stamps ``mesh:applied:<id>`` — the router routes a tenant only after
+  its assignment is confirmed applied, so a request can never reach a
+  replica missing its model.
+- **Replica-loss detection and re-placement** (the ``ElasticSupervisor``
+  pattern): the router polls every replica's ``/healthz``;
+  ``fail_after`` consecutive failures declare it lost, trigger the
+  regroup, and re-place its tenants onto survivors within one poll —
+  in-flight requests to the dead replica fail at the proxy hop into an
+  explicit retryable 503, never a silent drop or a wedged caller.
+- **Global admission control**: the health poll caches each replica's
+  machine-consumable ``admission`` block (stable ``/healthz`` schema,
+  :meth:`tensorflowonspark_tpu.online.OnlineServer.stats`) — byte-bound
+  saturation plus the tumbling shed window.  The router sheds a request
+  *before burning the network hop* when its target is already full
+  (pending bytes at the bound) or actively shedding (window shed rate
+  over ``shed_rate_threshold`` with the byte bound half saturated),
+  returning the same explicit 429 + ``Retry-After`` contract the replica
+  itself would.  Stale health (older than ``health_stale_s``) fails
+  OPEN: shedding on stale evidence would turn a hiccup in the poll loop
+  into an outage.
+
+Request tracing crosses the router→replica hop as W3C ``traceparent``
+(the PR 10 groundwork): an armed router request records ``route`` +
+``proxy`` spans and propagates its context downstream, so the replica's
+``online.request`` tree shares the trace id and names the router's span
+as parent — ``GET /debug/requests`` on the router merges both stores'
+retained trees (:func:`tensorflowonspark_tpu.obs.trace
+.merge_request_docs`) and renders the whole request as ONE span tree.
+
+Proof: ``bench.py --serving-mesh`` runs N replica processes on this box
+through the real registry → placement → router → coalescer path, stamps
+aggregate throughput, scale efficiency vs the single-process r11
+baseline, and router-hop latency overhead into every artifact
+(``tools/bench_gate.py`` gates them from r13), and SIGKILLs a replica
+mid-load to prove zero lost or wedged requests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import logging
+import os
+import re
+import signal
+import sys
+import threading
+import time
+from typing import Any, Mapping, Sequence
+
+from tensorflowonspark_tpu import elastic, obs, reservation
+from tensorflowonspark_tpu.obs import trace as _trace
+
+logger = logging.getLogger(__name__)
+
+#: rendezvous-kv key of the structured regroup command (router → replicas)
+MESH_REGROUP_KEY = "mesh:regroup"
+#: rendezvous-kv key of the versioned placement document (router → replicas)
+MESH_PLACEMENT_KEY = "mesh:placement"
+#: per-replica join announcement: ``mesh:join:<replica_id>`` = its meta
+MESH_JOIN_PREFIX = "mesh:join:"
+#: per-replica placement-applied stamp: ``mesh:applied:<replica_id>``
+MESH_APPLIED_PREFIX = "mesh:applied:"
+#: graceful fleet shutdown broadcast
+MESH_STOP_KEY = "mesh:stop"
+
+#: env var carrying the mesh auth token into replica processes (an argv
+#: token would be visible in ``ps``)
+MESH_AUTH_ENV = "TFOS_MESH_AUTH"
+
+#: default per-replica placement capacity, MB: the sum of placed tenants'
+#: ``max_pending_mb`` admission bounds a replica will accept.  This is
+#: PLACEMENT arithmetic (worst-case pending payload if every tenant's
+#: queue fills), not a memory limit — see DEPLOY.md "Mesh sizing".
+DEFAULT_REPLICA_CAPACITY_MB = 256.0
+#: consecutive failed health polls before a replica is declared lost
+DEFAULT_FAIL_AFTER = 3
+#: health snapshots older than this fail OPEN at admission (forward the
+#: request rather than shed on stale evidence)
+DEFAULT_HEALTH_STALE_S = 5.0
+#: window shed rate at/over which the router sheds pre-hop — corroborated
+#: by byte-bound saturation ≥ 0.5 so a long-tail window alone cannot keep
+#: shedding after pressure cleared
+DEFAULT_SHED_RATE_THRESHOLD = 0.5
+#: minimum offered requests in the window before its shed rate is evidence
+DEFAULT_SHED_MIN_OFFERED = 8
+
+#: fast-path tenant extraction: when the body's FIRST key is a plain
+#: (escape-free) "tenant", the router routes without parsing the whole
+#: payload — a proxy that json-decodes every feature vector just to read
+#: one routing key pays the caller's payload size on its own CPU.
+#: Anchored at the start so a "tenant" string nested in the inputs can
+#: never be mistaken for the routing key; anything else falls back to a
+#: full parse.
+_TENANT_FAST_RE = re.compile(
+    rb'^\s*\{\s*"tenant"\s*:\s*"([A-Za-z0-9_.\-]+)"')
+
+
+class MeshError(RuntimeError):
+    """Mesh control-plane failure (membership, placement)."""
+
+
+class MeshCapacityError(MeshError):
+    """No up replica has byte-bound capacity for the tenant."""
+
+
+def tenant_config(name: str, *, export_dir: str,
+                  model_name: str | None = None,
+                  batch_size: int = 128,
+                  bucket_sizes: Sequence[int] | None = None,
+                  input_mapping: Mapping[str, str],
+                  output_mapping: Mapping[str, str] | None = None,
+                  flush_ms: float | None = None,
+                  max_pending_mb: float | None = None,
+                  slo_ms: float | None = None,
+                  warmup: bool | None = None) -> dict[str, Any]:
+    """Normalize a tenant spec into the JSON-able config the placement
+    document carries (exactly ``OnlineServer.add_tenant``'s keyword
+    surface, minus ``predict_fn`` — a callable cannot cross the
+    router→replica process boundary; mesh tenants serve self-describing
+    exports or ``model_name`` zoo entries)."""
+    from tensorflowonspark_tpu import online
+
+    if not input_mapping:
+        raise ValueError("mesh tenants need an explicit input_mapping")
+    cfg: dict[str, Any] = {
+        "name": str(name),
+        "export_dir": str(export_dir),
+        "model_name": model_name,
+        "batch_size": int(batch_size),
+        "bucket_sizes": (list(int(b) for b in bucket_sizes)
+                         if bucket_sizes else None),
+        "input_mapping": dict(input_mapping),
+        "output_mapping": (dict(output_mapping) if output_mapping
+                           else None),
+        "flush_ms": float(flush_ms if flush_ms is not None
+                          else online.DEFAULT_FLUSH_MS),
+        "max_pending_mb": float(max_pending_mb if max_pending_mb is not None
+                                else online.DEFAULT_MAX_PENDING_MB),
+        "slo_ms": (float(slo_ms) if slo_ms is not None else None),
+        "warmup": warmup,
+    }
+    return cfg
+
+
+def placement_key(cfg: Mapping[str, Any]) -> tuple:
+    """A tenant's coalescing identity: the model-cache key plus bucket
+    ladder plus input/output mapping — the same tuple
+    ``online._ModelGroup`` groups by, computed WITHOUT loading the model
+    (``pipeline.model_cache_key``).  Tenants with equal keys placed on
+    one replica coalesce into shared batches there; placing them apart
+    forfeits exactly that sharing, which is why the router only spills
+    same-key tenants to another replica when the byte bound saturates."""
+    from tensorflowonspark_tpu import pipeline, serving
+
+    buckets = tuple(serving.resolve_buckets(cfg["batch_size"],
+                                            cfg.get("bucket_sizes")))
+    return (pipeline.model_cache_key(cfg["export_dir"],
+                                     cfg.get("model_name")),
+            buckets,
+            tuple(sorted(cfg["input_mapping"].items())),
+            tuple(sorted((cfg.get("output_mapping") or {}).items())))
+
+
+def _http_json(host: str, port: int, path: str, timeout: float
+               ) -> tuple[int, Any]:
+    """One GET, parsed as JSON; raises on socket/parse failure."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read().decode("utf-8"))
+    finally:
+        conn.close()
+
+
+class _Replica:
+    """Router-side record of one serving replica."""
+
+    def __init__(self, replica_id: str, meta: dict[str, Any]):
+        self.id = replica_id
+        self.meta = dict(meta)
+        self.host = meta["host"]
+        self.port = int(meta["port"])
+        self.state = "up"  # up | lost
+        self.failures = 0
+        self.health: dict[str, Any] | None = None
+        self.health_ts = 0.0
+        #: placement-applied stamp last read off the kv
+        self.applied: dict[str, Any] | None = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def to_doc(self, placed: list[str], placed_bytes: int,
+               capacity_bytes: int) -> dict[str, Any]:
+        return {
+            "url": self.url,
+            "state": self.state,
+            "failures": self.failures,
+            "health_age_s": (round(time.time() - self.health_ts, 2)
+                             if self.health_ts else None),
+            "admission": (self.health or {}).get("admission"),
+            "tenants": sorted(placed),
+            "placed_bytes": placed_bytes,
+            "capacity_bytes": capacity_bytes,
+            "applied_version": (self.applied or {}).get("version"),
+        }
+
+
+class MeshRouter:
+    """Serving-mesh control plane + data-plane front door (module doc).
+
+    Lifecycle::
+
+        router = MeshRouter(expected_replicas=3)
+        host, port = router.start()              # rendezvous endpoint
+        # ... start replica processes pointed at (host, port) ...
+        router.await_replicas(timeout=60)        # gen-0 barrier
+        router.add_tenant("ctr", export_dir=..., input_mapping={...})
+        front = MeshHTTPServer(router).start()   # POST /v1/predict et al
+
+    States mirror the elastic supervisor: ``forming`` (pre-barrier),
+    ``watching`` (healthy, health poll running), ``regrouping`` (a
+    membership bump in flight — survivors keep serving), ``dead``
+    (regroup budget exhausted or barrier timeout; surviving placements
+    keep routing but membership no longer self-heals), ``stopped``.
+    """
+
+    def __init__(self, expected_replicas: int,
+                 replica_capacity_mb: float = DEFAULT_REPLICA_CAPACITY_MB,
+                 poll_interval: float = 1.0,
+                 fail_after: int = DEFAULT_FAIL_AFTER,
+                 health_stale_s: float = DEFAULT_HEALTH_STALE_S,
+                 shed_rate_threshold: float = DEFAULT_SHED_RATE_THRESHOLD,
+                 shed_min_offered: int = DEFAULT_SHED_MIN_OFFERED,
+                 regroup_timeout: float = 60.0, max_regroups: int = 8,
+                 min_replicas: int = 1, proxy_timeout_s: float = 60.0,
+                 auth_token: str | None = None):
+        self.expected_replicas = int(expected_replicas)
+        self.capacity_bytes = int(replica_capacity_mb * (1 << 20))
+        self.poll_interval = float(poll_interval)
+        self.fail_after = int(fail_after)
+        self.health_stale_s = float(health_stale_s)
+        self.shed_rate_threshold = float(shed_rate_threshold)
+        self.shed_min_offered = int(shed_min_offered)
+        self.regroup_timeout = float(regroup_timeout)
+        self.max_regroups = int(max_regroups)
+        self.min_replicas = max(1, int(min_replicas))
+        self.proxy_timeout_s = float(proxy_timeout_s)
+        self.server = reservation.Server(self.expected_replicas,
+                                         auth_token=auth_token)
+        self.generation = 0
+        self.state = "forming"
+        self.last_error: str | None = None
+        self.lost_replicas: list[str] = []
+        self.regroups: list[dict[str, Any]] = []
+        self._replicas: dict[str, _Replica] = {}
+        self._placements: dict[str, str | None] = {}  # tenant → replica id
+        self._tenant_cfgs: dict[str, dict[str, Any]] = {}
+        self._tenant_keys: dict[str, tuple] = {}
+        self._assigned_version: dict[str, int] = {}
+        self._version = 0
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._conns = threading.local()
+        # instruments cached once: the route path must not pay a registry
+        # lookup per request (the online tier's hot-path rule)
+        self._requests_total = obs.counter(
+            "mesh_router_requests_total",
+            "requests through the mesh router front door")
+        self._shed_total = obs.counter(
+            "mesh_router_shed_total",
+            "requests shed AT THE ROUTER by global admission control "
+            "(pre-hop 429s; replicas' own sheds are online_shed_total)")
+        self._errors_total = obs.counter(
+            "mesh_router_errors_total",
+            "proxy hops that failed (connection errors, replica 5xx)")
+        self._hop_seconds = obs.histogram(
+            "mesh_router_hop_seconds",
+            "router→replica proxy hop latency (connect+forward+reply)")
+        self._replicas_up = obs.gauge(
+            "mesh_replicas_up", "serving replicas currently up")
+        self._t_requests: dict[str, Any] = {}
+        self._t_shed: dict[str, Any] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def auth_token(self) -> str:
+        return self.server.auth_token
+
+    def start(self) -> tuple[str, int]:
+        """Start the registry listener; returns the rendezvous address
+        replicas must be pointed at."""
+        return self.server.start()
+
+    def await_replicas(self, timeout: float = 120.0) -> list[str]:
+        """Block on the gen-0 barrier; returns the replica ids, starts
+        the health/membership watch."""
+        info = self.server.await_reservations(timeout=timeout)
+        with self._lock:
+            for meta in info:
+                rid = str(meta.get("executor_id"))
+                self._replicas[rid] = _Replica(rid, meta)
+            self.state = "watching"
+            self._replicas_up.set(len(self._replicas))
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._watch, name="tfos-mesh-router-watch",
+                daemon=True)
+            self._thread.start()
+        logger.info("mesh formed: %d replicas (%s)", len(info),
+                    ", ".join(sorted(self._replicas)))
+        return sorted(self._replicas)
+
+    def stop(self, stop_replicas: bool = False) -> None:
+        self._stop.set()
+        if stop_replicas:
+            try:
+                self.server.kv_put(MESH_STOP_KEY, {"ts": time.time()})
+            except Exception:  # pragma: no cover - teardown best-effort
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        with self._lock:
+            self.state = "stopped"
+        if not stop_replicas:
+            self.server.stop()
+        # with stop_replicas the rendezvous stays up briefly so agents can
+        # read the stop broadcast; callers tear it down via server.stop()
+        # after joining their replica processes
+
+    # -- tenant placement ----------------------------------------------------
+
+    def add_tenant(self, name: str, *, wait_applied_s: float = 30.0,
+                   **spec: Any) -> str:
+        """Place tenant ``name`` (``tenant_config`` keyword surface) onto
+        a replica and publish the placement; returns the replica id.
+
+        Same-coalescing-identity tenants are co-located until the
+        replica's byte-bound capacity saturates (see
+        :func:`placement_key`).  With ``wait_applied_s`` > 0 the call
+        blocks until the replica confirms the tenant is loaded (the
+        ``mesh:applied:<id>`` stamp) and raises on a replica-side apply
+        error — so a returning ``add_tenant`` means the tenant is
+        routable."""
+        cfg = tenant_config(name, **spec)
+        key = placement_key(cfg)
+        need = int(cfg["max_pending_mb"] * (1 << 20))
+        with self._lock:
+            if name in self._tenant_cfgs:
+                raise ValueError(f"tenant {name!r} already placed")
+            rid = self._choose_replica(key, need)
+            self._tenant_cfgs[name] = cfg
+            self._tenant_keys[name] = key
+            self._placements[name] = rid
+            version = self._publish_placement_locked()
+            self._assigned_version[name] = version
+            self._t_requests[name] = obs.counter(
+                "mesh_router_tenant_requests_total",
+                "router requests per tenant", labels={"tenant": name})
+            self._t_shed[name] = obs.counter(
+                "mesh_router_tenant_shed_total",
+                "router pre-hop sheds per tenant", labels={"tenant": name})
+        logger.info("mesh tenant %r placed on replica %s (version %d)",
+                    name, rid, version)
+        if wait_applied_s > 0:
+            self._await_applied(name, rid, version, wait_applied_s)
+        return rid
+
+    def remove_tenant(self, name: str) -> None:
+        with self._lock:
+            if name not in self._tenant_cfgs:
+                raise KeyError(f"unknown tenant {name!r}")
+            self._tenant_cfgs.pop(name)
+            self._tenant_keys.pop(name, None)
+            self._placements.pop(name, None)
+            self._assigned_version.pop(name, None)
+            self._publish_placement_locked()
+            self._t_requests.pop(name, None)
+            self._t_shed.pop(name, None)
+        reg = obs.get_registry()
+        reg.remove("mesh_router_tenant_requests_total", {"tenant": name})
+        reg.remove("mesh_router_tenant_shed_total", {"tenant": name})
+
+    def _placed_bytes(self, rid: str) -> int:
+        return sum(int(self._tenant_cfgs[t]["max_pending_mb"] * (1 << 20))
+                   for t, r in self._placements.items() if r == rid)
+
+    def _choose_replica(self, key: tuple, need_bytes: int) -> str:
+        """Under the lock: the placement decision (see module doc)."""
+        up = [r for r in self._replicas.values() if r.state == "up"]
+        if not up:
+            raise MeshError("no replicas up")
+        loads = {r.id: self._placed_bytes(r.id) for r in up}
+        # co-locate with same-key tenants while the byte bound holds —
+        # that is what keeps them coalescing into shared batches
+        same: dict[str, int] = {}
+        for t, rid in self._placements.items():
+            if rid is not None and self._tenant_keys.get(t) == key:
+                same[rid] = same.get(rid, 0) + 1
+        roomy_same = [rid for rid in same
+                      if rid in loads
+                      and loads[rid] + need_bytes <= self.capacity_bytes]
+        if roomy_same:
+            return max(roomy_same, key=lambda rid: (same[rid], rid))
+        roomy = [r.id for r in up
+                 if loads[r.id] + need_bytes <= self.capacity_bytes]
+        if not roomy:
+            raise MeshCapacityError(
+                f"no replica has {need_bytes} bytes of placement capacity "
+                f"free (capacity {self.capacity_bytes} bytes each; loads "
+                f"{loads})")
+        return min(roomy, key=lambda rid: (loads[rid], rid))
+
+    def _publish_placement_locked(self) -> int:
+        self._version += 1
+        assignments: dict[str, dict[str, Any]] = {}
+        for t, rid in self._placements.items():
+            if rid is not None:
+                assignments.setdefault(rid, {})[t] = self._tenant_cfgs[t]
+        self.server.kv_put(MESH_PLACEMENT_KEY, {
+            "version": self._version, "gen": self.generation,
+            "assignments": assignments, "ts": time.time()})
+        return self._version
+
+    def _await_applied(self, tenant: str, rid: str, version: int,
+                       timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            doc = self.server.kv_get(f"{MESH_APPLIED_PREFIX}{rid}")
+            if isinstance(doc, dict) and int(doc.get("version", -1)) \
+                    >= version:
+                err = (doc.get("errors") or {}).get(tenant)
+                if err:
+                    raise MeshError(
+                        f"replica {rid} failed to load tenant "
+                        f"{tenant!r}: {err}")
+                if tenant in (doc.get("tenants") or ()):
+                    with self._lock:
+                        if rid in self._replicas:
+                            self._replicas[rid].applied = doc
+                    return
+            time.sleep(0.05)
+        raise MeshError(
+            f"replica {rid} did not confirm tenant {tenant!r} within "
+            f"{timeout}s (placement version {version})")
+
+    def _tenant_routable(self, tenant: str, replica: _Replica) -> bool:
+        """Has the replica confirmed it applied this tenant's assignment?
+        Routing an unconfirmed tenant would manufacture bogus 404s during
+        a re-placement window."""
+        doc = replica.applied
+        return (isinstance(doc, dict)
+                and int(doc.get("version", -1))
+                >= self._assigned_version.get(tenant, 0)
+                and tenant in (doc.get("tenants") or ()))
+
+    # -- membership watch (the ElasticSupervisor pattern) --------------------
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            with self._lock:
+                if self.state not in ("watching",):
+                    continue
+                replicas = [r for r in self._replicas.values()
+                            if r.state == "up"]
+            lost: list[str] = []
+            for r in replicas:
+                doc = self._fetch_health(r)
+                if doc is None:
+                    r.failures += 1
+                    if r.failures >= self.fail_after:
+                        lost.append(r.id)
+                else:
+                    r.failures = 0
+                    r.health = doc
+                    r.health_ts = time.time()
+            self._refresh_applied()
+            joins = self._pending_joins()
+            if lost or joins:
+                try:
+                    self.regroup(lost, joins)
+                except Exception as e:
+                    logger.error("mesh regroup failed: %s", e)
+
+    def _fetch_health(self, r: _Replica) -> dict[str, Any] | None:
+        try:
+            _status, doc = _http_json(
+                r.host, r.port, "/healthz",
+                timeout=min(2.0, self.poll_interval + 1.0))
+            return doc if isinstance(doc, dict) else None
+        except Exception:
+            return None
+
+    def _refresh_applied(self) -> None:
+        try:
+            stamps = self.server.kv_items(MESH_APPLIED_PREFIX)
+        except Exception:  # pragma: no cover - in-process kv
+            return
+        with self._lock:
+            for key, doc in stamps.items():
+                rid = key[len(MESH_APPLIED_PREFIX):]
+                r = self._replicas.get(rid)
+                if r is not None and isinstance(doc, dict):
+                    if int(doc.get("version", -1)) >= int(
+                            (r.applied or {}).get("version", -1)):
+                        r.applied = doc
+
+    def _pending_joins(self) -> list[dict[str, Any]]:
+        try:
+            announcements = self.server.kv_items(MESH_JOIN_PREFIX)
+        except Exception:  # pragma: no cover - in-process kv
+            return []
+        with self._lock:
+            known = set(self._replicas) | set(self.lost_replicas)
+        joins = []
+        for key, meta in announcements.items():
+            rid = key[len(MESH_JOIN_PREFIX):]
+            if rid not in known and isinstance(meta, dict):
+                joins.append(dict(meta, executor_id=rid))
+        return joins
+
+    def regroup(self, lost_ids: list[str],
+                joins: list[dict[str, Any]] | None = None,
+                reason: str = "replica_lost") -> dict[str, Any] | None:
+        """Membership bump: fence the lost, absorb the joining, barrier
+        the survivors under generation N+1, re-place orphaned tenants.
+
+        Survivors keep serving throughout — only traffic to lost
+        replicas degrades (explicit retryable 503 at the proxy hop)
+        until their tenants land elsewhere."""
+        joins = joins or []
+        with self._lock:
+            lost_new = [i for i in lost_ids if i not in self.lost_replicas]
+            if not lost_new and not joins:
+                return None
+            if self.state == "dead":
+                raise MeshError(
+                    f"mesh supervisor is dead ({self.last_error})")
+            if self.state == "regrouping":
+                raise MeshError("a regroup is already in flight")
+            if len(self.regroups) >= self.max_regroups:
+                self.state = "dead"
+                self.last_error = (f"regroup budget exhausted "
+                                   f"({self.max_regroups})")
+                raise MeshError(self.last_error)
+            survivors = [r for r in self._replicas.values()
+                         if r.state == "up" and r.id not in lost_new]
+            if len(survivors) + len(joins) < self.min_replicas:
+                self.state = "dead"
+                self.last_error = (
+                    f"only {len(survivors)} survivors — fewer than "
+                    f"min_replicas={self.min_replicas}")
+                raise MeshError(self.last_error)
+            for rid in lost_new:
+                r = self._replicas.get(rid)
+                if r is not None:
+                    r.state = "lost"
+            self.state = "regrouping"
+            gen = self.generation + 1
+            survivor_ids = sorted(r.id for r in survivors)
+            join_ids = sorted(str(m["executor_id"]) for m in joins)
+            all_lost = sorted(set(self.lost_replicas) | set(lost_new))
+        t0 = time.time()
+        logger.warning(
+            "mesh regroup → generation %d: lost %s, joining %s, "
+            "%d survivors", gen, lost_new, join_ids, len(survivor_ids))
+        try:
+            with obs.span("mesh.regroup", gen=gen,
+                          lost=",".join(lost_new),
+                          joining=",".join(join_ids),
+                          survivors=len(survivor_ids)):
+                self.server.begin_generation(
+                    gen, len(survivor_ids) + len(join_ids))
+                self.server.kv_put(MESH_REGROUP_KEY, {
+                    "gen": gen, "reason": reason, "lost": all_lost,
+                    "survivors": survivor_ids, "joining": join_ids,
+                    "ts": t0})
+                info = self.server.await_generation(
+                    gen, timeout=self.regroup_timeout)
+        except Exception as e:
+            with self._lock:
+                self.state = "dead"
+                self.last_error = f"regroup to generation {gen} failed: {e}"
+            obs.event("mesh.regroup_failed", gen=gen, error=str(e)[:200])
+            raise
+        barrier_s = time.time() - t0
+        with self._lock:
+            self.generation = gen
+            self.lost_replicas = all_lost
+            old = self._replicas
+            self._replicas = {}
+            for meta in info:
+                rid = str(meta.get("executor_id"))
+                prev = old.get(rid)
+                rep = _Replica(rid, meta)
+                if prev is not None:  # keep health/applied continuity
+                    rep.health, rep.health_ts = prev.health, prev.health_ts
+                    rep.applied = prev.applied
+                self._replicas[rid] = rep
+            self._replicas_up.set(len(self._replicas))
+            orphaned = sorted(t for t, rid in self._placements.items()
+                              if rid not in self._replicas)
+            replaced: dict[str, str | None] = {}
+            for t in orphaned:
+                need = int(self._tenant_cfgs[t]["max_pending_mb"]
+                           * (1 << 20))
+                try:
+                    new_rid = self._choose_replica(
+                        self._tenant_keys[t], need)
+                except MeshError as e:
+                    logger.error(
+                        "tenant %r unplaceable after regroup: %s", t, e)
+                    new_rid = None
+                self._placements[t] = new_rid
+                replaced[t] = new_rid
+            version = self._publish_placement_locked()
+            for t, new_rid in replaced.items():
+                self._assigned_version[t] = version
+            record = {
+                "gen": gen, "reason": reason, "lost": lost_new,
+                "joined": join_ids,
+                "replicas": sorted(self._replicas),
+                "replaced_tenants": replaced,
+                "barrier_seconds": round(barrier_s, 3), "ts": t0,
+            }
+            self.regroups.append(record)
+            self.state = "watching"
+        obs.counter("mesh_regroups_total").inc()
+        if lost_new:
+            obs.counter("mesh_lost_replicas_total").inc(len(lost_new))
+        if join_ids:
+            obs.counter("mesh_joined_replicas_total").inc(len(join_ids))
+        obs.event("mesh.regrouped", gen=gen, lost=",".join(lost_new),
+                  joined=",".join(join_ids),
+                  barrier_seconds=round(barrier_s, 3))
+        return record
+
+    # -- data path -----------------------------------------------------------
+
+    def route_predict(self, body: bytes, headers: Any) -> tuple:
+        """The ``POST /v1/predict`` front door: placement lookup → global
+        admission → one proxied hop.  Returns the httpd reply tuple
+        ``(status, content_type, body, extra_headers)``."""
+        t0 = time.perf_counter()
+        self._requests_total.inc()
+        # the fast path must agree with the replica's authoritative
+        # json.loads (LAST duplicate key wins there): only trust the
+        # anchored first-key match when '"tenant"' appears exactly once —
+        # a crafted duplicate-key body must not be admitted/metered as
+        # one tenant and served as another
+        m = _TENANT_FAST_RE.match(body[:256] if body else b"")
+        if m and body.count(b'"tenant"') == 1:
+            tenant = m.group(1).decode("ascii")
+        else:
+            try:
+                doc = json.loads(body or b"{}")
+                tenant = doc.get("tenant")
+            except (ValueError, UnicodeDecodeError) as e:
+                return (400, "application/json",
+                        json.dumps({"error": f"malformed body: {e}"}),
+                        None)
+            if not tenant or not isinstance(tenant, str):
+                return (400, "application/json",
+                        json.dumps({"error": "body must carry 'tenant'"}),
+                        None)
+        inbound = _trace.parse_traceparent(
+            headers.get("traceparent") if headers is not None else None)
+        tracing = _trace.requests_enabled()
+        armed = tracing and (inbound is not None
+                             or _trace.arm_roll())
+        rt = None
+        if armed:
+            rt = _trace.RequestTrace("mesh.request", ctx=inbound,
+                                     tenant=tenant)
+        with self._lock:
+            cfg = self._tenant_cfgs.get(tenant)
+            rid = self._placements.get(tenant)
+            replica = self._replicas.get(rid) if rid else None
+            treq = self._t_requests.get(tenant)
+        if treq is not None:
+            treq.inc()
+        if cfg is None:
+            return self._reply_traced(
+                rt, t0, "error", 404, {"error": f"unknown tenant "
+                                                f"{tenant!r}"}, None)
+        retry_after = {"Retry-After": "1"}
+        if replica is None or replica.state != "up":
+            # lost replica mid-re-placement, or unplaceable: explicit
+            # retryable 503 — never a silent drop, never a wedge
+            return self._reply_traced(
+                rt, t0, "unavailable", 503,
+                {"error": f"tenant {tenant!r} is being re-placed "
+                          "(replica lost); retry"}, retry_after)
+        with self._lock:
+            routable = self._tenant_routable(tenant, replica)
+        if not routable:
+            return self._reply_traced(
+                rt, t0, "unavailable", 503,
+                {"error": f"tenant {tenant!r} placement not yet applied "
+                          f"on replica {rid}; retry"}, retry_after)
+        shed_why = self._admission_verdict(replica, tenant)
+        if shed_why is not None:
+            self._shed_total.inc()
+            with self._lock:
+                tshed = self._t_shed.get(tenant)
+            if tshed is not None:
+                tshed.inc()
+            ra = max(0.05, cfg["flush_ms"] / 1000.0)
+            if rt is not None:
+                rt.add("route", time.perf_counter() - t0,
+                       outcome="shed", replica=rid, why=shed_why)
+            return self._reply_traced(
+                rt, t0, "shed", 429,
+                {"error": f"shed at the router: {shed_why}",
+                 "retry_after_s": ra},
+                {"Retry-After": str(max(1, int(ra + 0.999)))},
+                route_recorded=True)
+        fwd_headers = {"Content-Type": "application/json",
+                       "Content-Length": str(len(body))}
+        if rt is not None:
+            fwd_headers["traceparent"] = rt.ctx.traceparent()
+            rt.add("route", time.perf_counter() - t0,
+                   outcome="forwarded", replica=rid)
+        t1 = time.perf_counter()
+        try:
+            status, rbody, rheaders = self._proxy(replica, "/v1/predict",
+                                                  body, fwd_headers)
+        except Exception as e:
+            # the hop itself failed: feed detection (a SIGKILLed replica
+            # shows up here before the next health poll) and hand the
+            # caller an explicit retryable 503
+            replica.failures += 1
+            self._errors_total.inc()
+            if rt is not None:
+                rt.add("proxy", time.perf_counter() - t1, replica=rid,
+                       error=f"{type(e).__name__}: {e}"[:200])
+            return self._reply_traced(
+                rt, t0, "error", 503,
+                {"error": f"replica {rid} unreachable "
+                          f"({type(e).__name__}); retry"}, retry_after,
+                route_recorded=True)
+        hop = time.perf_counter() - t1
+        self._hop_seconds.observe(hop)
+        if rt is not None:
+            rt.add("proxy", hop, replica=rid, status=status)
+        if status == 404:
+            # the replica denies a tenant the router placed there — an
+            # apply race (e.g. remove+re-add mid-flight), not a caller
+            # error; retryable rather than a bogus hard 404
+            return self._reply_traced(
+                rt, t0, "unavailable", 503,
+                {"error": f"replica {rid} has not applied tenant "
+                          f"{tenant!r} yet; retry"}, retry_after,
+                route_recorded=True)
+        if status >= 500:
+            self._errors_total.inc()
+        extra = None
+        if "Retry-After" in (rheaders or {}):
+            extra = {"Retry-After": rheaders["Retry-After"]}
+        outcome = ("ok" if status < 400 else
+                   "shed" if status == 429 else "error")
+        if rt is not None:
+            retain = None if outcome == "ok" else outcome
+            rt.finish(status=outcome, http_status=status,
+                      latency_ms=round((time.perf_counter() - t0) * 1000,
+                                       3))
+            _trace.get_trace_store().commit(rt, retain=retain)
+        return (status, "application/json", rbody, extra)
+
+    def _reply_traced(self, rt, t0: float, outcome: str, status: int,
+                      doc: dict, extra: dict | None,
+                      route_recorded: bool = False) -> tuple:
+        if rt is not None:
+            if not route_recorded:
+                rt.add("route", time.perf_counter() - t0, outcome=outcome)
+            rt.finish(status=outcome, http_status=status)
+            # router-side sheds/errors are always tail-retained; an "ok"
+            # here never happens (the happy path finishes inline above)
+            _trace.get_trace_store().commit(
+                rt, retain=None if outcome == "ok" else outcome)
+        return (status, "application/json", json.dumps(doc), extra)
+
+    def _admission_verdict(self, replica: _Replica,
+                           tenant: str) -> str | None:
+        """Global admission: shed pre-hop on FRESH evidence of pressure
+        at the target — the tenant's own ``/healthz`` block when present,
+        else the replica-wide ``admission`` block.  Stale health fails
+        open (forward): shedding on a poll hiccup would be an outage."""
+        h = replica.health
+        if h is None or time.time() - replica.health_ts \
+                > self.health_stale_s:
+            return None
+        block = (h.get("tenants") or {}).get(tenant) or h.get("admission")
+        if not isinstance(block, dict):
+            return None
+        maxb = block.get("max_pending_bytes") or 0
+        pend = block.get("pending_bytes") or 0
+        if maxb and pend >= maxb:
+            return (f"replica {replica.id} pending bytes {pend} at its "
+                    f"bound {maxb}")
+        w = block.get("shed_window") or {}
+        saturation = pend / maxb if maxb else 0.0
+        if (w.get("offered", 0) >= self.shed_min_offered
+                and w.get("shed_rate", 0.0) >= self.shed_rate_threshold
+                and saturation >= 0.5):
+            return (f"replica {replica.id} shed rate "
+                    f"{w['shed_rate']} over its last {w.get('window_s')}s "
+                    f"window (byte bound {round(saturation, 2)} "
+                    "saturated)")
+        return None
+
+    def _proxy(self, replica: _Replica, path: str, body: bytes,
+               headers: dict[str, str]) -> tuple[int, bytes, dict]:
+        """One POST hop over a per-thread keep-alive connection.
+
+        A failure on a REUSED connection retries once on a fresh one
+        (stale keep-alive — the request never reached the replica); a
+        fresh connection's failure propagates (retrying a request the
+        replica may have started would be a duplicate forward)."""
+        pool = getattr(self._conns, "by_addr", None)
+        if pool is None:
+            pool = self._conns.by_addr = {}
+        key = (replica.host, replica.port)
+        conn = pool.pop(key, None)
+        reused = conn is not None
+        while True:
+            if conn is None:
+                conn = http.client.HTTPConnection(
+                    replica.host, replica.port,
+                    timeout=self.proxy_timeout_s)
+            try:
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                rheaders = dict(resp.getheaders())
+                pool[key] = conn
+                return resp.status, data, rheaders
+            except (OSError, http.client.HTTPException):
+                try:
+                    conn.close()
+                except Exception:  # pragma: no cover
+                    pass
+                conn = None
+                if not reused:
+                    raise
+                reused = False
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> dict[str, Any]:
+        """The router's ``/healthz`` body."""
+        with self._lock:
+            placements = dict(self._placements)
+            placed_by_rid: dict[str, list[str]] = {}
+            for t, rid in placements.items():
+                if rid is not None:
+                    placed_by_rid.setdefault(rid, []).append(t)
+            replicas = {
+                rid: r.to_doc(placed_by_rid.get(rid, []),
+                              self._placed_bytes(rid),
+                              self.capacity_bytes)
+                for rid, r in self._replicas.items()}
+            return {
+                "state": self.state,
+                "generation": self.generation,
+                "expected_replicas": self.expected_replicas,
+                "replicas": replicas,
+                "placements": placements,
+                "placement_version": self._version,
+                "lost_replicas": list(self.lost_replicas),
+                "regroups": list(self.regroups),
+                "last_error": self.last_error,
+                "router": {
+                    "requests_total": int(self._requests_total.value),
+                    "shed_total": int(self._shed_total.value),
+                    "errors_total": int(self._errors_total.value),
+                },
+            }
+
+    def merged_request_docs(self, limit: int = 50) -> dict[str, Any]:
+        """The router's ``/debug/requests`` body: its own retained traces
+        merged with every up replica's, joined by trace id — one request,
+        one span tree across the router→replica hop."""
+        docs = [_trace.get_trace_store().to_doc(limit)]
+        with self._lock:
+            replicas = [r for r in self._replicas.values()
+                        if r.state == "up"]
+        for r in replicas:
+            try:
+                _status, doc = _http_json(r.host, r.port,
+                                          "/debug/requests", timeout=2.0)
+                docs.append(doc)
+            except Exception:
+                continue  # a scrape miss must not fail the debug view
+        return _trace.merge_request_docs(docs, limit=limit)
+
+
+class MeshHTTPServer:
+    """The router's stdlib HTTP front end (``obs/httpd.py`` server):
+
+    - ``POST /v1/predict`` — the mesh front door (429/503 with
+      ``Retry-After`` per the admission/membership contract above; a
+      W3C ``traceparent`` joins the caller's trace across the hop);
+    - ``GET /healthz`` — :meth:`MeshRouter.stats`; 200 while the mesh
+      self-heals (``watching``/``regrouping``), 503 once ``dead``;
+    - ``GET /metrics`` — this process's registry (Prometheus text);
+    - ``GET /debug/requests`` — router+replica span trees merged by
+      trace id (slowest-first).
+    """
+
+    def __init__(self, router: MeshRouter, host: str = "127.0.0.1",
+                 port: int = 0):
+        from tensorflowonspark_tpu.obs import httpd
+
+        self.router = router
+        self._srv = httpd.ObservabilityServer(
+            routes={
+                "/healthz": self._healthz,
+                "/metrics": self._metrics,
+                "/debug/requests": self._debug_requests,
+            },
+            post_routes={"/v1/predict": router.route_predict},
+            host=host, port=port)
+
+    def _healthz(self) -> tuple:
+        doc = self.router.stats()
+        ok = doc["state"] in ("watching", "regrouping")
+        return (200 if ok else 503, "application/json", json.dumps(doc))
+
+    def _metrics(self) -> tuple:
+        from tensorflowonspark_tpu.obs import httpd
+
+        return (200, httpd.PROMETHEUS_CONTENT_TYPE,
+                obs.get_registry().to_prometheus())
+
+    def _debug_requests(self) -> tuple:
+        return (200, "application/json",
+                json.dumps(self.router.merged_request_docs()))
+
+    def start(self) -> tuple[str, int]:
+        return self._srv.start()
+
+    def stop(self) -> None:
+        self._srv.stop()
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._srv.address
+
+    @property
+    def port(self) -> int:
+        return self._srv.port
+
+    def url(self, path: str = "/") -> str:
+        return self._srv.url(path)
+
+
+class ReplicaAgent:
+    """Replica-side mesh membership + placement agent.
+
+    Runs beside an :class:`~tensorflowonspark_tpu.online.OnlineServer` +
+    :class:`~tensorflowonspark_tpu.online.OnlineHTTPServer` pair (the
+    data plane is untouched — the agent only registers, watches the kv,
+    and applies tenant assignments).  One poll thread at heartbeat
+    cadence:
+
+    - ``mesh:regroup`` (via :func:`elastic.poll_command`): a command
+      naming this replica lost fences it (state ``lost``, serving
+      stops); one naming it survivor/joining re-registers under the new
+      generation — the regroup barrier's replica half;
+    - ``mesh:placement``: newer versions are applied as an
+      add/remove-tenant diff against the local server, then confirmed on
+      ``mesh:applied:<id>`` (the router routes only confirmed
+      assignments);
+    - ``mesh:stop``: graceful fleet shutdown.
+    """
+
+    def __init__(self, replica_id: str, registry_addr, auth_token: str,
+                 server, http_server, poll_interval: float = 0.25):
+        self.replica_id = str(replica_id)
+        self.registry_addr = (registry_addr[0], int(registry_addr[1]))
+        self.auth_token = auth_token
+        self.online = server
+        self.http = http_server
+        self.poll_interval = float(poll_interval)
+        self.generation = 0
+        self.state = "created"  # created|serving|lost|stopped
+        self.last_error: str | None = None
+        self._applied_version = -1
+        self._applied_cfgs: dict[str, dict] = {}
+        self._stop = threading.Event()
+        self._done = threading.Event()
+        self._thread: threading.Thread | None = None
+        # retries=0: the poll loop's next tick IS the retry (the
+        # ElasticWorker discipline)
+        self._client = reservation.Client(self.registry_addr, auth_token,
+                                          retries=0)
+
+    def _meta(self) -> dict[str, Any]:
+        host, port = self.http.address
+        return {"executor_id": self.replica_id, "host": host,
+                "port": int(port), "role": "serving", "pid": os.getpid()}
+
+    def start(self, join: bool = False) -> "ReplicaAgent":
+        """Register with the mesh (gen-0 barrier) or announce a join
+        (absorbed by the next regroup), then start the poll thread."""
+        meta = self._meta()
+        client = reservation.Client(self.registry_addr, self.auth_token)
+        if join:
+            client.put(f"{MESH_JOIN_PREFIX}{self.replica_id}", meta)
+            logger.info("replica %s announced join to %s",
+                        self.replica_id, self.registry_addr)
+        else:
+            client.register(meta)
+            logger.info("replica %s registered with %s", self.replica_id,
+                        self.registry_addr)
+        self.state = "serving"
+        self._thread = threading.Thread(
+            target=self._poll, name=f"tfos-mesh-agent-{self.replica_id}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self.state not in ("lost",):
+            self.state = "stopped"
+        self._stop.set()
+        self._done.set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the agent stops (graceful stop, fleet stop
+        broadcast, or fenced as lost)."""
+        return self._done.wait(timeout)
+
+    # -- poll loop -----------------------------------------------------------
+
+    def _poll(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                cmd = elastic.poll_command(self._client, MESH_REGROUP_KEY,
+                                           self.generation)
+                if cmd is not None:
+                    self._handle_regroup(cmd)
+                    if self.state == "lost":
+                        return
+                self._apply_placement_if_newer()
+                self._check_stop()
+            except Exception as e:  # the loop must survive anything
+                logger.debug("mesh agent %s poll failed: %s",
+                             self.replica_id, e)
+
+    def _handle_regroup(self, cmd: dict[str, Any]) -> None:
+        gen = int(cmd["gen"])
+        if self.replica_id in (cmd.get("lost") or []):
+            # this replica IS the fenced zombie: the only correct move is
+            # to stop serving — its epoch was regrouped away
+            logger.warning("replica %s declared lost in generation %d; "
+                           "stopping", self.replica_id, gen)
+            self.state = "lost"
+            self.last_error = f"declared lost in generation {gen}"
+            obs.event("mesh.replica_fenced", replica=self.replica_id,
+                      gen=gen)
+            self._stop.set()
+            self._done.set()
+            return
+        named = set(cmd.get("survivors") or []) | set(
+            cmd.get("joining") or [])
+        if self.replica_id not in named:
+            # not lost but not named either: this replica belongs to no
+            # current membership (e.g. it joined during a dead mesh);
+            # keep waiting — a later regroup may absorb its announcement
+            return
+        with obs.span("mesh.rejoin", gen=gen, replica=self.replica_id):
+            client = reservation.Client(self.registry_addr,
+                                        self.auth_token, generation=gen)
+            client.register(self._meta())
+        self.generation = gen
+        obs.counter("mesh_rejoins_total").inc()
+        logger.info("replica %s re-registered under generation %d",
+                    self.replica_id, gen)
+
+    def _apply_placement_if_newer(self) -> None:
+        try:
+            doc = self._client.get(MESH_PLACEMENT_KEY, timeout=0.0)
+        except KeyError:
+            return
+        if not isinstance(doc, dict):
+            return
+        version = int(doc.get("version", -1))
+        if version <= self._applied_version:
+            return
+        mine = (doc.get("assignments") or {}).get(self.replica_id) or {}
+        errors: dict[str, str] = {}
+        for name in sorted(set(self._applied_cfgs) - set(mine)):
+            try:
+                self.online.remove_tenant(name)
+            except KeyError:
+                pass
+            self._applied_cfgs.pop(name, None)
+            logger.info("replica %s dropped tenant %r (version %d)",
+                        self.replica_id, name, version)
+        for name, cfg in sorted(mine.items()):
+            if self._applied_cfgs.get(name) == cfg:
+                continue
+            if name in self._applied_cfgs:  # changed config: replace
+                try:
+                    self.online.remove_tenant(name)
+                except KeyError:
+                    pass
+                self._applied_cfgs.pop(name, None)
+            kwargs = {k: v for k, v in cfg.items() if k != "name"}
+            try:
+                with obs.span("mesh.apply_tenant", replica=self.replica_id,
+                              tenant=name):
+                    self.online.add_tenant(name, **kwargs)
+                self._applied_cfgs[name] = dict(cfg)
+                logger.info("replica %s loaded tenant %r (version %d)",
+                            self.replica_id, name, version)
+            except Exception as e:
+                # a bad export must not wedge the whole placement: every
+                # other tenant still applies, and the error is stamped
+                # where the router's add_tenant(wait_applied) reads it
+                errors[name] = f"{type(e).__name__}: {e}"[:300]
+                logger.error("replica %s failed to load tenant %r: %s",
+                             self.replica_id, name, e)
+        # the confirmation stamp gates routing — only record the version
+        # as applied once the router can actually read it (a failed put is
+        # retried next tick: the add/remove diff above is idempotent)
+        self._client.put(f"{MESH_APPLIED_PREFIX}{self.replica_id}", {
+            "version": version, "gen": self.generation,
+            "tenants": sorted(self._applied_cfgs),
+            "errors": errors, "ts": time.time()})
+        self._applied_version = version
+
+    def _check_stop(self) -> None:
+        try:
+            self._client.get(MESH_STOP_KEY, timeout=0.0)
+        except KeyError:
+            return
+        logger.info("replica %s observed mesh stop broadcast",
+                    self.replica_id)
+        self.stop()
+
+
+# ---------------------------------------------------------------------------
+# replica process entry point (bench / deployment)
+# ---------------------------------------------------------------------------
+
+
+def replica_main(argv: list[str] | None = None) -> int:
+    """Run one serving replica: OnlineServer + HTTP front end + mesh
+    agent, until stopped (kv broadcast / SIGTERM) or fenced as lost.
+
+    ::
+
+        TFOS_MESH_AUTH=<token> python -m tensorflowonspark_tpu.mesh \\
+            --registry HOST:PORT --replica-id r0 [--join]
+
+    Exit code 0 on graceful stop, 2 when fenced as lost.
+    """
+    p = argparse.ArgumentParser(description=replica_main.__doc__)
+    p.add_argument("--registry", required=True,
+                   help="rendezvous address host:port (MeshRouter.start)")
+    p.add_argument("--replica-id", required=True)
+    p.add_argument("--http-host", default="127.0.0.1")
+    p.add_argument("--http-port", type=int, default=0)
+    p.add_argument("--join", action="store_true",
+                   help="join a live mesh (absorbed by the next regroup) "
+                        "instead of the gen-0 barrier")
+    p.add_argument("--poll-interval", type=float, default=0.25)
+    args = p.parse_args(argv)
+    auth = os.environ.get(MESH_AUTH_ENV)
+    if not auth:
+        p.error(f"{MESH_AUTH_ENV} must carry the mesh auth token")
+    host, port_s = args.registry.rsplit(":", 1)
+
+    from tensorflowonspark_tpu import util
+
+    util.ensure_jax_platform()
+    from tensorflowonspark_tpu import online
+
+    obs.configure(node=f"mesh-replica-{args.replica_id}")
+    srv = online.OnlineServer()
+    http_srv = online.OnlineHTTPServer(srv, host=args.http_host,
+                                       port=args.http_port)
+    http_srv.start()
+    srv.start()
+    agent = ReplicaAgent(args.replica_id, (host, int(port_s)), auth,
+                         srv, http_srv,
+                         poll_interval=args.poll_interval)
+
+    def _sigterm(_signum, _frame):  # pragma: no cover - process teardown
+        agent.stop()
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    agent.start(join=args.join)
+    logger.info("replica %s serving on %s (registry %s)",
+                args.replica_id, http_srv.url(), args.registry)
+    try:
+        while not agent.wait(timeout=1.0):
+            pass
+    except KeyboardInterrupt:  # pragma: no cover - interactive teardown
+        agent.stop()
+    http_srv.stop()
+    srv.stop()
+    return 2 if agent.state == "lost" else 0
+
+
+if __name__ == "__main__":  # pragma: no cover - subprocess entry
+    logging.basicConfig(level=logging.INFO)
+    sys.exit(replica_main())

@@ -90,6 +90,7 @@ from tensorflowonspark_tpu.obs.trace import (  # noqa: F401
     format_traceparent,
     get_trace_store,
     get_tracer,
+    merge_request_docs,
     parse_traceparent,
     span,
     trace_context,
@@ -105,6 +106,6 @@ __all__ = [
     "TRACE_KV_PREFIX", "Tracer", "collect_blackboard", "configure",
     "event", "flush", "get_tracer", "span",
     "TraceContext", "RequestTrace", "TraceStore", "get_trace_store",
-    "parse_traceparent", "format_traceparent", "trace_context",
-    "with_context",
+    "parse_traceparent", "format_traceparent", "merge_request_docs",
+    "trace_context", "with_context",
 ]

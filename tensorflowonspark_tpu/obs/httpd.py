@@ -38,15 +38,30 @@ PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 OPENMETRICS_CONTENT_TYPE = ("application/openmetrics-text; version=1.0.0; "
                             "charset=utf-8")
 
-Route = Callable[[], tuple[int, str, Any]]
+#: GET route: no-arg callable → ``(status, content_type, body)`` or
+#: ``(status, content_type, body, extra_headers)``
+Route = Callable[[], tuple]
+#: POST route: ``(body_bytes, request_headers)`` → the same reply tuple
+#: shape.  The handler never parses the body itself — interpretation
+#: (JSON, propagated ``traceparent``, …) belongs to the route.
+PostRoute = Callable[[bytes, Any], tuple]
 
 
 class ObservabilityServer:
-    """Threaded HTTP server over a route table; start() → (host, port)."""
+    """Threaded HTTP server over a route table; start() → (host, port).
+
+    ``routes`` serves GETs; ``post_routes`` (optional) serves POSTs —
+    the serving-mesh router front end mounts ``POST /v1/predict`` here
+    beside its read-only views.  Either kind of route may return a
+    4-tuple whose last element is an extra-headers dict (e.g. a 429's
+    ``Retry-After``).
+    """
 
     def __init__(self, routes: dict[str, Route], host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0,
+                 post_routes: dict[str, PostRoute] | None = None):
         self.routes = dict(routes)
+        self.post_routes = dict(post_routes or {})
         self._host = host
         self._port = port
         self._httpd: ThreadingHTTPServer | None = None
@@ -54,8 +69,15 @@ class ObservabilityServer:
 
     def start(self) -> tuple[str, int]:
         routes = self.routes
+        post_routes = self.post_routes
 
         class _Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive: every reply carries Content-Length, so
+            # persistent connections are safe — scrapers and the mesh
+            # router's health poll reuse one connection instead of paying
+            # a reconnect per request
+            protocol_version = "HTTP/1.1"
+
             def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
                 path = self.path.split("?", 1)[0].rstrip("/") or "/"
                 route = routes.get(path)
@@ -65,8 +87,39 @@ class ObservabilityServer:
                          "routes": sorted(routes)}).encode()
                     self._reply(404, "application/json", body)
                     return
+                self._run_route(path, route)
+
+            def do_POST(self) -> None:  # noqa: N802 (stdlib naming)
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                route = post_routes.get(path)
+                # ALWAYS drain the body before replying: under HTTP/1.1
+                # keep-alive an unread body stays in the socket buffer
+                # and is parsed as the NEXT request line, desyncing the
+                # connection (the 404 path used to skip the read)
                 try:
-                    status, ctype, body = route()
+                    length = int(self.headers.get("Content-Length") or 0)
+                    payload = self.rfile.read(length) if length else b""
+                except (OSError, ValueError) as e:
+                    self._reply(400, "application/json", json.dumps(
+                        {"error": f"unreadable body: {e}"}).encode())
+                    self.close_connection = True  # body state unknown
+                    return
+                if route is None:
+                    body = json.dumps(
+                        {"error": "not found",
+                         "routes": sorted(post_routes)}).encode()
+                    self._reply(404, "application/json", body)
+                    return
+                self._run_route(path, lambda: route(payload, self.headers))
+
+            def _run_route(self, path: str, route: Callable) -> None:
+                try:
+                    result = route()
+                    if len(result) == 4:
+                        status, ctype, body, extra = result
+                    else:
+                        status, ctype, body = result
+                        extra = None
                 except Exception as e:  # endpoint must never kill the driver
                     logger.warning("observability route %s failed: %s",
                                    path, e)
@@ -75,12 +128,15 @@ class ObservabilityServer:
                     return
                 if isinstance(body, str):
                     body = body.encode()
-                self._reply(status, ctype, body)
+                self._reply(status, ctype, body, extra)
 
-            def _reply(self, status: int, ctype: str, body: bytes) -> None:
+            def _reply(self, status: int, ctype: str, body: bytes,
+                       extra_headers: dict | None = None) -> None:
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(body)
 

@@ -549,6 +549,12 @@ def sample_roll(rate: float | None = None) -> bool:
     return s >= 1.0 or (s > 0.0 and _ID_RNG.random() < s)
 
 
+def arm_roll() -> bool:
+    """One head-armed capture roll at :func:`arm_rate` — the decision a
+    request entry point makes when no inbound context forces capture."""
+    return sample_roll(arm_rate())
+
+
 class RequestTrace:
     """Span-tree collector for ONE request, safe to hand across threads.
 
@@ -856,6 +862,80 @@ class TraceStore:
             self._retained.clear()
             self.committed = 0
             self.retained_total = 0
+
+
+def merge_request_docs(docs: list, limit: int = 50) -> dict[str, Any]:
+    """Merge several trace stores' ``/debug/requests`` documents into one,
+    joining retained entries that share a ``trace_id`` into a single tree.
+
+    This is how one request renders as ONE span tree across processes:
+    the serving-mesh router propagates its context over the router→replica
+    hop as a ``traceparent`` header, so the replica's retained
+    ``online.request`` tree carries the router's trace id and its root
+    names the router's span as parent — concatenating the two entries'
+    spans yields the full tree.  The merged entry keeps the
+    upstream-most member's identity/latency (the one whose
+    ``parent_span_id`` is not supplied by any other member — for a
+    router+replica pair, the router's, which covers the whole hop) and
+    lists the contributing ``nodes``.  Entries retained by only one side
+    (e.g. a replica-side SLO breach the router sampled away) pass through
+    unmerged — a partial view beats none.
+    """
+    committed = retained_total = dropped = 0
+    by_tid: dict[str, list[dict]] = {}
+    stores = 0
+    for doc in docs:
+        if not isinstance(doc, dict):
+            continue
+        stores += 1
+        committed += int(doc.get("committed") or 0)
+        retained_total += int(doc.get("retained_total") or 0)
+        dropped += int(doc.get("dropped_total") or 0)
+        for entry in doc.get("retained") or ():
+            tid = entry.get("trace_id") if isinstance(entry, dict) else None
+            if not tid:
+                continue
+            group = by_tid.setdefault(tid, [])
+            # two docs can carry the SAME materialized tree (co-resident
+            # stores, a store scraped twice): the root span id identifies
+            # it — merge distinct trees, don't duplicate one
+            if any(e.get("root_span_id") == entry.get("root_span_id")
+                   for e in group):
+                continue
+            group.append(entry)
+    merged: list[dict[str, Any]] = []
+    for entries in by_tid.values():
+        if len(entries) == 1:
+            merged.append(entries[0])
+            continue
+        roots = {e.get("root_span_id") for e in entries}
+        # upstream-most member first: its root's parent lies OUTSIDE the
+        # group (the external caller, or nothing) — ties break oldest-first
+        primary = min(entries, key=lambda e: (
+            e.get("parent_span_id") in roots, e.get("ts") or 0.0))
+        spans: list[dict] = []
+        seen: set = set()
+        for e in entries:
+            for sp in e.get("spans") or ():
+                sid = sp.get("span_id")
+                if sid is None or sid not in seen:
+                    seen.add(sid)
+                    spans.append(sp)
+        out = dict(primary)
+        out["spans"] = spans
+        out["merged_entries"] = len(entries)
+        out["nodes"] = sorted({sp.get("node") for sp in spans
+                               if sp.get("node")})
+        merged.append(out)
+    merged.sort(key=lambda d: -(d.get("duration_ms") or 0.0))
+    return {
+        "merged": True,
+        "stores": stores,
+        "committed": committed,
+        "retained_total": retained_total,
+        "dropped_total": dropped,
+        "retained": merged[:limit],
+    }
 
 
 # -- module-level default tracer (one per process) --------------------------

@@ -43,13 +43,16 @@ single-process (scale out = run more of them behind any TCP balancer):
   ``online_shed_total`` counters, an ``online_coalesce_size`` histogram,
   and per-tenant latency histograms — first-class Prometheus labels
   (``online_request_seconds{tenant="..."}``; the round-11 name-mangled
-  ``online_request_seconds_<tenant>`` series still dual-published for one
-  round, then gone) in the ``obs`` registry — on any ``/metrics``
-  exposition; a ``FlightRecorder`` plane ``"online"``
+  ``online_request_seconds_<tenant>`` aliases were dual-published for
+  exactly one round and are now gone) in the ``obs`` registry — on any
+  ``/metrics`` exposition; a ``FlightRecorder`` plane ``"online"``
   (``wait``/``coalesce``/``pad``/``compute``/``reply``) with bottleneck
   verdicts on ``/pipeline``; server + per-tenant state (including the
   last-window shed *rate*, not just the lifetime counter) on
-  ``/healthz``.
+  ``/healthz``, whose stable machine-consumable ``admission`` block is
+  what the serving-mesh router's *global* admission control reads
+  (:mod:`tensorflowonspark_tpu.mesh` sheds at the router from it before
+  burning the network hop).
 - **Request-scoped tracing** (ISSUE 10 tentpole): every request carries a
   span tree — ``admission`` (validate + byte-bound decision), ``queue``
   (enqueue → drain), ``coalesce`` (batch id, bucket, flush trigger,
@@ -96,7 +99,6 @@ import itertools
 import logging
 import os
 import queue as _queue_mod
-import re
 import threading
 import time
 from typing import Any, Callable, Mapping, Sequence
@@ -188,11 +190,6 @@ class Rejected(RuntimeError):
     def __init__(self, message: str, retry_after_s: float = 0.05):
         super().__init__(message)
         self.retry_after_s = float(retry_after_s)
-
-
-def _sanitize(tenant: str) -> str:
-    """Tenant name → metric-name-safe suffix."""
-    return re.sub(r"[^a-zA-Z0-9_]", "_", str(tenant))
 
 
 def _canon(a: np.ndarray) -> np.ndarray:
@@ -363,12 +360,11 @@ class _Tenant:
         self.pending_rows = 0
         self.pending_bytes = 0
         self.shed_window = _ShedWindow()
-        safe = _sanitize(name)
         # instrument handles cached here: submit/reply are the hot path
         # and must not pay a registry lookup per request (flight-recorder
-        # rule).  The tenant is a first-class Prometheus LABEL; the
-        # round-11 name-mangled series are dual-published for one round
-        # so existing scrapes keep parsing, then they go away.
+        # rule).  The tenant is a first-class Prometheus LABEL (the
+        # round-11 name-mangled ``online_*_<tenant>`` aliases were
+        # dual-published for exactly one round and are now gone).
         # labeled families are DISJOINT from the unlabeled server-wide
         # grand totals (online_requests_total / online_shed_total): mixing
         # a labelless series into a labeled family would double-count
@@ -386,44 +382,26 @@ class _Tenant:
             "submit→reply latency (p50/p99 from the buckets; slow "
             "observations carry retained-trace exemplars)",
             buckets=LATENCY_BUCKETS, labels=tenant_label)
-        self._legacy_requests_total = obs.counter(
-            f"online_requests_{safe}_total",
-            f"DEPRECATED name-mangled alias of "
-            f"online_tenant_requests_total{{tenant=\"{name}\"}} — one round")
-        self._legacy_shed_total = obs.counter(
-            f"online_shed_{safe}_total",
-            f"DEPRECATED name-mangled alias of "
-            f"online_tenant_shed_total{{tenant=\"{name}\"}} — one round")
-        self._legacy_latency = obs.histogram(
-            f"online_request_seconds_{safe}",
-            f"DEPRECATED name-mangled alias of "
-            f"online_request_seconds{{tenant=\"{name}\"}} — one round",
-            buckets=LATENCY_BUCKETS)
 
     def note_admitted(self) -> None:
         self.requests_total.inc()
-        self._legacy_requests_total.inc()
         self.shed_window.note(shed=False)
 
     def note_shed(self) -> None:
         self.shed_total.inc()
-        self._legacy_shed_total.inc()
         self.shed_window.note(shed=True)
 
     def observe_latency(self, seconds: float,
                         trace_id: str | None = None) -> None:
         """Record one reply latency; a retained trace's id rides the
-        labeled histogram as the bucket's exemplar (the legacy series
-        never carries exemplars — it is on its way out)."""
+        labeled histogram as the bucket's exemplar."""
         self.latency.observe(
             seconds,
             exemplar={"trace_id": trace_id} if trace_id else None)
-        self._legacy_latency.observe(seconds)
 
     def evict_metrics(self) -> None:
-        """Drop this tenant's labeled series — AND its one-round legacy
-        name-mangled aliases — with the tenant (bounded cardinality: a
-        removed tenant frees every slot it pinned)."""
+        """Drop this tenant's labeled series with the tenant (bounded
+        cardinality: a removed tenant frees every slot it pinned)."""
         from tensorflowonspark_tpu import obs
 
         reg = obs.get_registry()
@@ -431,10 +409,6 @@ class _Tenant:
         reg.remove("online_tenant_requests_total", label)
         reg.remove("online_tenant_shed_total", label)
         reg.remove("online_request_seconds", label)
-        safe = _sanitize(self.name)
-        reg.remove(f"online_requests_{safe}_total")
-        reg.remove(f"online_shed_{safe}_total")
-        reg.remove(f"online_request_seconds_{safe}")
 
     def quantile_ms(self, q: float) -> float | None:
         from tensorflowonspark_tpu.obs import anomaly
@@ -1283,13 +1257,42 @@ class OnlineServer:
         ``shed_window`` is the last-window shed *rate* (shed / offered
         over the tumbling window) — admission pressure visible without
         Prometheus rate() math over the lifetime counters.
+
+        The top-level ``admission`` block is a STABLE, machine-consumable
+        summary (``admission_schema`` versions it; field removals or
+        semantic changes bump the version) — the one field the
+        serving-mesh router's global admission control reads instead of
+        scraping Prometheus text:
+
+        - ``pending_bytes`` / ``max_pending_bytes`` / ``pending_rows`` —
+          byte-bound admission state summed over the tenants (the
+          ``_ByteBoundedQueue`` accounting: payload bytes held from
+          enqueue to drain);
+        - ``saturation`` — ``pending_bytes / max_pending_bytes`` (0 when
+          unbounded), the replica-level back-pressure signal;
+        - ``shed_window`` — the tumbling offered/shed/``shed_rate``
+          window aggregated across tenants (coverage = the longest
+          tenant window).
+
+        Per-tenant blocks carry the same fields tenant-scoped, so a
+        router that places tenants individually can shed per (replica,
+        tenant) rather than per replica.
         """
         tenants = {}
         with self._lock:
             # window snapshots roll under the same lock note() runs under
             snap = [(ts, ts.shed_window.snapshot())
                     for ts in self._tenants.values()]
+        agg_offered = agg_shed = 0
+        agg_window_s = 0.0
+        agg_pending_bytes = agg_pending_rows = agg_max_bytes = 0
         for ts, window in snap:
+            agg_offered += window["offered"]
+            agg_shed += window["shed"]
+            agg_window_s = max(agg_window_s, window["window_s"])
+            agg_pending_bytes += ts.pending_bytes
+            agg_pending_rows += ts.pending_rows
+            agg_max_bytes += ts.max_pending_bytes
             tenants[ts.name] = {
                 "pending_rows": ts.pending_rows,
                 "pending_bytes": ts.pending_bytes,
@@ -1305,6 +1308,21 @@ class OnlineServer:
         return {
             "state": self.state,
             "tenants": tenants,
+            "admission": {
+                "admission_schema": 1,
+                "pending_bytes": agg_pending_bytes,
+                "pending_rows": agg_pending_rows,
+                "max_pending_bytes": agg_max_bytes,
+                "saturation": (round(agg_pending_bytes / agg_max_bytes, 4)
+                               if agg_max_bytes else 0.0),
+                "shed_window": {
+                    "window_s": agg_window_s,
+                    "offered": agg_offered,
+                    "shed": agg_shed,
+                    "shed_rate": (round(agg_shed / agg_offered, 4)
+                                  if agg_offered else 0.0),
+                },
+            },
             "models_loaded": len(self._groups),
             "staged_batches": self._staged.qsize(),
             "requests_total": int(self._requests_total.value),
@@ -1365,6 +1383,13 @@ class OnlineHTTPServer:
         online = self._online
 
         class _Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive: every reply carries Content-Length, so
+            # persistent connections are safe — and the serving-mesh
+            # router proxies EVERY request through here on a pooled
+            # connection (HTTP/1.0's close-per-request made each proxied
+            # hop pay a reconnect)
+            protocol_version = "HTTP/1.1"
+
             def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
                 path = self.path.split("?", 1)[0].rstrip("/") or "/"
                 try:
@@ -1405,13 +1430,17 @@ class OnlineHTTPServer:
 
             def do_POST(self) -> None:  # noqa: N802 (stdlib naming)
                 path = self.path.split("?", 1)[0].rstrip("/")
+                # drain the body even on the 404 path: under HTTP/1.1
+                # keep-alive an unread body desyncs the connection (the
+                # leftover bytes parse as the next request line)
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length else b""
                 if path != "/v1/predict":
                     self._reply(404, "application/json",
                                 json.dumps({"error": "not found"}))
                     return
                 try:
-                    length = int(self.headers.get("Content-Length") or 0)
-                    body = json.loads(self.rfile.read(length) or b"{}")
+                    body = json.loads(raw or b"{}")
                     tenant = body.get("tenant")
                     inputs = body.get("inputs")
                     if not tenant or not isinstance(inputs, dict):

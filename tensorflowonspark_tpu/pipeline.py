@@ -431,6 +431,44 @@ def _cache_token(path: str, export_dir: str):
     return fp if fp is not None else 0.0
 
 
+def model_cache_key(export_dir: str, model_name: str | None = None,
+                    predict_fn: Callable | None = None) -> tuple:
+    """The ``_MODEL_CACHE`` identity of a model artifact:
+    ``(resolved path, forward id, cache-invalidation token)``.
+
+    Computable WITHOUT loading the model — which is what makes it usable
+    as a *placement* identity too: the serving-mesh router
+    (:mod:`tensorflowonspark_tpu.mesh`) co-locates tenants whose model
+    cache key (plus bucket ladder and input mapping) agree, because those
+    are exactly the tenants whose requests coalesce into shared batches
+    on a replica (``online._ModelGroup`` keys on the same tuple).
+    ``_RunModel._load`` derives its cache key here so the two can never
+    drift.
+    """
+    import os
+
+    from tensorflowonspark_tpu import saved_model
+
+    path = export_dir
+    model_sub = os.path.join(path, "model")
+    if "://" not in path and os.path.isdir(model_sub):
+        path = model_sub  # layout written by compat.export_saved_model
+    mtime = _cache_token(path, export_dir)
+    # precedence: an explicitly passed predict_fn (user intent) beats
+    # the artifact's serialized forward, which beats model_name.  The
+    # zoo id is namespaced so no model_name can collide with the
+    # "saved_forward" sentinel (consumers — _load included — decide the
+    # load path from the fn_id alone)
+    serialized = predict_fn is None and saved_model.has_forward(export_dir)
+    if serialized:
+        fn_id = "saved_forward"
+    elif predict_fn is not None:
+        fn_id = getattr(predict_fn, "__qualname__", None)
+    else:
+        fn_id = f"model:{model_name}" if model_name else None
+    return (path, fn_id, mtime)
+
+
 def _cache_insert(key: tuple, entry: tuple) -> None:
     """Insert into ``_MODEL_CACHE``, evicting prior entries for the same
     export path.
@@ -506,22 +544,10 @@ class _RunModel:
     # -- executor-side ------------------------------------------------------
 
     def _load(self):
-        import os
-
-        from tensorflowonspark_tpu import saved_model
-
-        path = self.export_dir
-        model_sub = os.path.join(path, "model")
-        if "://" not in path and os.path.isdir(model_sub):
-            path = model_sub  # layout written by compat.export_saved_model
-        mtime = _cache_token(path, self.export_dir)
-        # precedence: an explicitly passed predict_fn (user intent) beats
-        # the artifact's serialized forward, which beats model_name
-        serialized = (self.predict_fn is None
-                      and saved_model.has_forward(self.export_dir))
-        fn_id = ("saved_forward" if serialized else
-                 getattr(self.predict_fn, "__qualname__", self.model_name))
-        key = (path, fn_id, mtime)
+        key = model_cache_key(self.export_dir, self.model_name,
+                              self.predict_fn)
+        path, fn_id, _mtime = key
+        serialized = self.predict_fn is None and fn_id == "saved_forward"
         # the serving data plane's compile accounting (serving.note_compile)
         # tracks shape signatures per loaded model — same key as the cache,
         # so eviction drops both together (_cache_insert)

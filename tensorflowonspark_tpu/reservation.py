@@ -396,6 +396,14 @@ class Server:
             return {"ok": True}
         if mtype == "QUERY":
             return {"ok": True, "done": self.reservations.done()}
+        if mtype == "QGEN":
+            # current-generation query: a node that wants to JOIN a live
+            # membership (serving-mesh replica, replacement executor)
+            # registers for generation current+1 — which it can only name
+            # after asking.  Never fenced: the asker is by definition not
+            # yet a member of any generation.
+            with self._gen_lock:
+                return {"ok": True, "gen": self.generation}
         if mtype == "QINFO":
             done = self.reservations.done()
             return {
@@ -566,6 +574,18 @@ class Client:
             )
             if reply["done"]:
                 return reply["cluster"]
+
+    def current_generation(self) -> int:
+        """The server's current membership generation (``QGEN``).
+
+        A node joining a LIVE membership registers for generation
+        ``current + 1`` (the server parks the registration until the next
+        regroup absorbs it) — this query is how it names that generation.
+        Deliberately unstamped even on a generation-stamped client:
+        asking "what is current?" must work from any epoch.
+        """
+        reply = self._call({"type": "QGEN", "gen": None})
+        return int(reply["gen"])
 
     def put(self, key: str, value: Any) -> None:
         """Publish to the cluster-wide kv blackboard."""

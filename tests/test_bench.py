@@ -294,6 +294,45 @@ class TestOutageProofing(unittest.TestCase):
         self.assertIsNone(out["trace_overhead_frac"])
         self.assertIn("TFOS_TRACE_REQUESTS", out["trace_overhead_reason"])
 
+    @pytest.mark.slow  # spawns 2 replica subprocesses + SIGKILL chaos
+    def test_serving_mesh_microbench_small_config(self):
+        # ISSUE 11: aggregate closed-loop rows/sec through the REAL
+        # registry → placement → router → replica-coalescer path, with
+        # the SIGKILL zero-loss contract and the traceparent-linked
+        # router+replica span tree.  Small config to stay affordable;
+        # the in-artifact number uses the defaults (BENCH_NOTES.md
+        # "Round 13").  No scale floor here: N processes on a 1-core CI
+        # box measure scheduling, not scaling — efficiency is judged in
+        # the artifact gate within one mesh_host_cpus identity.
+        sys.path.insert(0, os.path.dirname(BENCH))
+        import bench
+
+        out = bench.measure_serving_mesh(
+            replicas=2, clients=4, reqs_per_client=8, feature_dim=16,
+            hidden_dim=32, out_dim=4, batch_size=8, flush_ms=2.0,
+            slo_ms=30000.0, kill_replica=True)
+        self.assertGreater(out["mesh_rows_per_sec"], 0.0)
+        self.assertGreater(out["mesh_rows_per_sec_single_process"], 0.0)
+        self.assertIsInstance(out["mesh_scale_efficiency"], float)
+        self.assertEqual(out["mesh_replicas"], 2)
+        self.assertEqual(out["mesh_rows_total"], 32)
+        self.assertEqual(out["mesh_host_cpus"], os.cpu_count())
+        # the zero-loss contract under SIGKILL: every request answered,
+        # the router regrouped past the victim
+        self.assertEqual(out["mesh_kill_lost_requests"], 0)
+        self.assertGreaterEqual(out["mesh_kill_generation"], 1)
+        # one request renders router+replica spans in one tree
+        self.assertTrue(out["mesh_trace_linked"])
+
+    def test_mesh_stamp_is_total_on_exhausted_budget(self):
+        sys.path.insert(0, os.path.dirname(BENCH))
+        import bench
+
+        result = {}
+        bench._stamp_mesh(result, bench._Deadline(0.0))
+        self.assertIsNone(result["mesh_rows_per_sec"])
+        self.assertIn("wall budget", result["mesh_reason"])
+
     def test_online_stamp_is_total_on_exhausted_budget(self):
         sys.path.insert(0, os.path.dirname(BENCH))
         import bench

@@ -878,3 +878,117 @@ def test_trace_overhead_must_be_a_fraction(tmp_path):
                 _r11(trace_overhead_frac=-2.0))])
     assert verdict["verdict"] == "fail"
     assert any("not a fraction" in r for r in verdict["reasons"])
+
+
+# -- multi-host serving mesh (ISSUE 11) --------------------------------------
+
+
+def _mesh_fields(rps=6000.0, p99=40.0, **extra):
+    fields = {"mesh_rows_per_sec": rps,
+              "mesh_rows_per_sec_single_process": 11000.0,
+              "mesh_speedup_vs_single_process": round(rps / 11000.0, 3),
+              "mesh_scale_efficiency": round(rps / (3 * 11000.0), 3),
+              "mesh_p50_ms": 12.0, "mesh_p99_ms": p99,
+              "mesh_p99_ms_single_process": 5.2,
+              "mesh_router_hop_ms": 1.4,
+              "mesh_replicas": 3, "mesh_clients": 16,
+              "mesh_rows_total": 640, "mesh_batch_size": 64,
+              "mesh_feature_dim": 256, "mesh_hidden_dim": 1024,
+              "mesh_flush_ms": 4.0, "mesh_slo_ms": 500.0,
+              "mesh_bucket_sizes": [16, 32, 64],
+              "mesh_host_cpus": 1,
+              "mesh_trace_linked": True,
+              "mesh_kill_lost_requests": 0, "mesh_kill_retries": 12,
+              "mesh_kill_loop_seconds": 9.5, "mesh_kill_generation": 1}
+    fields.update(extra)
+    return fields
+
+
+def _r13(**extra):
+    """A round-13-complete primary half: r12 + the serving mesh."""
+    half = _r12(**_mesh_fields())
+    half.update(extra)
+    return half
+
+
+def test_mesh_field_required_on_primary_from_round_13(tmp_path):
+    # round 12: grandfathered — no mesh number owed
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r12.json", _r12())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # round 13+: the primary must carry it (or explicit null + reason)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r13.json", _r12())])
+    assert verdict["verdict"] == "fail"
+    assert any("mesh_rows_per_sec" in r for r in verdict["reasons"])
+    # complete round 13 passes
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r13.json", _r13())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # explicit null + reason satisfies (e.g. wall budget exhausted)
+    half = _r12(mesh_rows_per_sec=None,
+                mesh_reason="wall budget exhausted before serving-mesh "
+                            "microbench")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r13.json", half)])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # bare null does not
+    half = _r12(mesh_rows_per_sec=None)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r13.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("mesh_reason" in r for r in verdict["reasons"])
+
+
+def test_mesh_value_without_config_identity_fails(tmp_path):
+    half = _r13()
+    del half["mesh_host_cpus"]  # N processes vs N cores: part of identity
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r13.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("config identity" in r and "mesh_host_cpus" in r
+               for r in verdict["reasons"])
+
+
+def test_mesh_value_without_scale_efficiency_fails(tmp_path):
+    half = _r13()
+    del half["mesh_scale_efficiency"]
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r13.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("mesh_scale_efficiency" in r for r in verdict["reasons"])
+
+
+def test_mesh_p99_over_slo_fails(tmp_path):
+    half = _r13(mesh_p99_ms=700.0)  # over the 500ms SLO it claims
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r13.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("SLO it missed" in r for r in verdict["reasons"])
+
+
+def test_mesh_regression_within_geometry_only(tmp_path):
+    # same geometry: a halved aggregate rate is a regression
+    paths = [
+        _write(tmp_path, "BENCH_r13.json", _r13()),
+        _write(tmp_path, "BENCH_r14.json",
+               _r13(**_mesh_fields(rps=2500.0))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("mesh tier regressed" in r for r in verdict["reasons"])
+    # a different host CPU count is a different experiment — no
+    # comparison in either direction
+    paths = [
+        _write(tmp_path, "BENCH_r13.json", _r13()),
+        _write(tmp_path, "BENCH_r14.json",
+               _r13(**_mesh_fields(rps=2500.0, mesh_host_cpus=8))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+
+
+def test_mesh_judged_even_on_degraded_newest(tmp_path):
+    """Host-side like the other microbenches: a degraded accelerator
+    half still measured the real mesh, so its number stays gated."""
+    paths = [
+        _write(tmp_path, "BENCH_r13.json", _r13()),
+        _write(tmp_path, "BENCH_r14.json",
+               _r13(**_mesh_fields(rps=2500.0),
+                    degraded="accelerator unavailable: probe timeout")),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("mesh tier regressed" in r for r in verdict["reasons"])

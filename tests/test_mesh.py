@@ -1,0 +1,604 @@
+"""Serving mesh (``tensorflowonspark_tpu.mesh``): replica registry on the
+reservation control plane, tenant-placement invariants (co-location until
+byte-bound saturation, never routing to a replica missing the model),
+replica-loss re-placement, global admission control, and the
+router→replica traceparent-linked span tree."""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import compat, mesh, obs, online, reservation
+from tensorflowonspark_tpu.obs import trace as trace_lib
+
+
+def _fwd(state, batch):
+    return {"score": batch["x"] @ state["params"]["w"]}
+
+
+def _make_export(tmp_path, name="exp", scale=1.0, dim=4):
+    """A self-describing export (serialized forward + weights) — the only
+    model form that can cross the router→replica process boundary."""
+    w = (np.arange(dim * 3, dtype=np.float32).reshape(dim, 3) / 10.0
+         * scale)
+    d = str(tmp_path / name)
+    compat.export_saved_model(
+        {"params": {"w": w}}, d, forward_fn=_fwd,
+        example_batch={"x": np.zeros((2, dim), np.float32)})
+    return d, w
+
+
+def _tenant_kw(export_dir, **kw):
+    base = dict(export_dir=export_dir, batch_size=8, bucket_sizes=[2, 8],
+                input_mapping={"x": "x"}, flush_ms=10.0,
+                max_pending_mb=4.0)
+    base.update(kw)
+    return base
+
+
+# ---------------------------------------------------------------------------
+# placement units (no live replicas: fake membership, in-process kv)
+# ---------------------------------------------------------------------------
+
+
+def _fake_router(n=3, capacity_mb=10.0, **kw):
+    """A router with N fake up replicas — placement/admission logic only
+    (the rendezvous kv works in-process without sockets)."""
+    r = mesh.MeshRouter(expected_replicas=n,
+                        replica_capacity_mb=capacity_mb, **kw)
+    for i in range(n):
+        r._replicas[f"r{i}"] = mesh._Replica(
+            f"r{i}", {"executor_id": f"r{i}", "host": "127.0.0.1",
+                      "port": 1 + i})
+    r.state = "watching"
+    return r
+
+
+def test_tenant_config_rejects_missing_input_mapping(tmp_path):
+    with pytest.raises(ValueError):
+        mesh.tenant_config("t", export_dir=str(tmp_path),
+                           input_mapping={})
+
+
+def test_placement_key_is_the_coalescing_identity(tmp_path):
+    d, _ = _make_export(tmp_path)
+    a = mesh.tenant_config("a", **_tenant_kw(d))
+    b = mesh.tenant_config("b", **_tenant_kw(d))
+    assert mesh.placement_key(a) == mesh.placement_key(b)
+    # a different bucket ladder is a different coalescing identity —
+    # those tenants could not share batches anyway
+    c = mesh.tenant_config("c", **_tenant_kw(d, bucket_sizes=[4, 8]))
+    assert mesh.placement_key(c) != mesh.placement_key(a)
+    e = mesh.tenant_config("e", **_tenant_kw(d, input_mapping={"y": "x"}))
+    assert mesh.placement_key(e) != mesh.placement_key(a)
+
+
+def test_same_model_tenants_colocate_until_byte_bound_saturates(tmp_path):
+    d, _ = _make_export(tmp_path)
+    router = _fake_router(n=3, capacity_mb=10.0)
+    rids = [router.add_tenant(f"t{i}", wait_applied_s=0,
+                              **_tenant_kw(d, max_pending_mb=4.0))
+            for i in range(3)]
+    # 4MB each into a 10MB bound: two co-locate, the third spills
+    assert rids[0] == rids[1]
+    assert rids[2] != rids[0]
+    # ... and the spilled one becomes the new co-location target
+    assert router.add_tenant("t3", wait_applied_s=0,
+                             **_tenant_kw(d, max_pending_mb=4.0)) \
+        == rids[2]
+
+
+def test_different_models_balance_by_load(tmp_path):
+    da, _ = _make_export(tmp_path, "a")
+    db, _ = _make_export(tmp_path, "b")
+    router = _fake_router(n=2, capacity_mb=100.0)
+    ra = router.add_tenant("a", wait_applied_s=0,
+                           **_tenant_kw(da, max_pending_mb=8.0))
+    rb = router.add_tenant("b", wait_applied_s=0,
+                           **_tenant_kw(db, max_pending_mb=1.0))
+    assert rb != ra  # least-loaded replica, not the one already burdened
+
+
+def test_capacity_exhaustion_is_loud(tmp_path):
+    d, _ = _make_export(tmp_path)
+    router = _fake_router(n=1, capacity_mb=5.0)
+    router.add_tenant("a", wait_applied_s=0,
+                      **_tenant_kw(d, max_pending_mb=4.0))
+    with pytest.raises(mesh.MeshCapacityError):
+        router.add_tenant("b", wait_applied_s=0,
+                          **_tenant_kw(d, max_pending_mb=4.0))
+
+
+def test_placement_doc_published_on_kv(tmp_path):
+    d, _ = _make_export(tmp_path)
+    router = _fake_router(n=2)
+    rid = router.add_tenant("a", wait_applied_s=0, **_tenant_kw(d))
+    doc = router.server.kv_get(mesh.MESH_PLACEMENT_KEY)
+    assert doc["version"] == 1
+    assert "a" in doc["assignments"][rid]
+    assert doc["assignments"][rid]["a"]["export_dir"] == d
+    router.remove_tenant("a")
+    doc = router.server.kv_get(mesh.MESH_PLACEMENT_KEY)
+    assert doc["version"] == 2 and doc["assignments"] == {}
+
+
+def test_admission_verdict_sheds_on_fresh_pressure_only(tmp_path):
+    router = _fake_router(n=1)
+    r = router._replicas["r0"]
+    full = {"tenants": {"t": {
+        "pending_bytes": 100, "max_pending_bytes": 100,
+        "shed_window": {"offered": 50, "shed": 40, "shed_rate": 0.8,
+                        "window_s": 30}}}}
+    r.health, r.health_ts = full, time.time()
+    assert router._admission_verdict(r, "t") is not None
+    # stale health FAILS OPEN: shedding on a poll hiccup is an outage
+    r.health_ts = time.time() - 60.0
+    assert router._admission_verdict(r, "t") is None
+    # high shed rate with the byte bound nearly empty: pressure already
+    # cleared — the long window alone must not keep shedding
+    r.health = {"tenants": {"t": {
+        "pending_bytes": 5, "max_pending_bytes": 100,
+        "shed_window": {"offered": 50, "shed": 40, "shed_rate": 0.8,
+                        "window_s": 30}}}}
+    r.health_ts = time.time()
+    assert router._admission_verdict(r, "t") is None
+    # corroborated: shedding AND half saturated
+    r.health = {"tenants": {"t": {
+        "pending_bytes": 60, "max_pending_bytes": 100,
+        "shed_window": {"offered": 50, "shed": 40, "shed_rate": 0.8,
+                        "window_s": 30}}}}
+    assert router._admission_verdict(r, "t") is not None
+    # replica-wide admission block backs tenants absent from the doc
+    r.health = {"admission": {"pending_bytes": 100,
+                              "max_pending_bytes": 100,
+                              "shed_window": {"offered": 0, "shed": 0,
+                                              "shed_rate": 0.0}}}
+    assert router._admission_verdict(r, "t") is not None
+
+
+def test_merge_request_docs_joins_by_trace_id():
+    tid = "ab" * 16
+    router_doc = {
+        "committed": 10, "retained_total": 1, "dropped_total": 9,
+        "retained": [{
+            "trace_id": tid, "root_span_id": "11" * 8,
+            "parent_span_id": None, "name": "mesh.request",
+            "status": "ok", "ts": 100.0, "duration_ms": 5.0,
+            "spans": [{"name": "mesh.request", "span_id": "11" * 8,
+                       "trace_id": tid, "node": "router"}]}]}
+    replica_doc = {
+        "committed": 4, "retained_total": 2, "dropped_total": 2,
+        "retained": [
+            {"trace_id": tid, "root_span_id": "22" * 8,
+             "parent_span_id": "11" * 8, "name": "online.request",
+             "status": "ok", "ts": 100.001, "duration_ms": 4.0,
+             "spans": [{"name": "online.request", "span_id": "22" * 8,
+                        "trace_id": tid, "node": "replica"}]},
+            {"trace_id": "cd" * 16, "root_span_id": "33" * 8,
+             "parent_span_id": None, "name": "online.request",
+             "status": "ok", "ts": 101.0, "duration_ms": 1.0,
+             "spans": []}]}
+    out = trace_lib.merge_request_docs([router_doc, replica_doc])
+    assert out["stores"] == 2 and out["committed"] == 14
+    assert len(out["retained"]) == 2
+    merged = next(e for e in out["retained"] if e["trace_id"] == tid)
+    # the router entry is upstream-most (its parent is outside the group)
+    assert merged["name"] == "mesh.request"
+    assert merged["duration_ms"] == 5.0
+    assert merged["merged_entries"] == 2
+    assert merged["nodes"] == ["replica", "router"]
+    assert {s["name"] for s in merged["spans"]} == {"mesh.request",
+                                                    "online.request"}
+    # the solo replica-side entry passes through unmerged
+    solo = next(e for e in out["retained"] if e["trace_id"] == "cd" * 16)
+    assert "merged_entries" not in solo
+
+
+def test_reservation_qgen_reports_current_generation():
+    srv = reservation.Server(1)
+    addr = srv.start()
+    try:
+        client = reservation.Client(addr, srv.auth_token)
+        assert client.current_generation() == 0
+        srv.begin_generation(3, 1)
+        assert client.current_generation() == 3
+        # a generation-stamped client can still ask (QGEN is unfenced)
+        stale = reservation.Client(addr, srv.auth_token, generation=1)
+        assert stale.current_generation() == 3
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# live in-process mesh: registry barrier, routing, loss, join, tracing
+# ---------------------------------------------------------------------------
+
+
+class _LiveReplica:
+    def __init__(self, rid, addr, token, join=False, poll_interval=0.1):
+        self.srv = online.OnlineServer()
+        self.http = online.OnlineHTTPServer(self.srv)
+        self.http.start()
+        self.srv.start()
+        self.agent = mesh.ReplicaAgent(rid, addr, token, self.srv,
+                                       self.http,
+                                       poll_interval=poll_interval)
+        self.agent.start(join=join)
+
+    def kill(self):
+        """Abrupt death: HTTP gone, agent silenced — the in-process
+        stand-in for SIGKILL (no graceful deregistration)."""
+        self.agent._stop.set()
+        self.http.stop()
+        self.srv.stop()
+
+    def stop(self):
+        self.agent.stop()
+        self.http.stop()
+        self.srv.stop()
+
+
+@pytest.fixture()
+def live_mesh(tmp_path):
+    made = []
+
+    def build(n=2, **router_kw):
+        kw = dict(poll_interval=0.2, fail_after=2, regroup_timeout=20.0,
+                  replica_capacity_mb=64.0)
+        kw.update(router_kw)
+        router = mesh.MeshRouter(expected_replicas=n, **kw)
+        addr = router.start()
+        reps = [_LiveReplica(f"r{i}", addr, router.auth_token)
+                for i in range(n)]
+        router.await_replicas(timeout=30.0)
+        made.append((router, reps))
+        return router, reps
+
+    yield build
+    for router, reps in made:
+        router.stop()
+        for rep in reps:
+            rep.stop()
+
+
+def _predict_via(router, tenant, x, headers=None):
+    body = json.dumps({"tenant": tenant,
+                       "inputs": {"x": x.tolist()}}).encode()
+    status, _ctype, rbody, extra = router.route_predict(
+        body, headers or {})
+    doc = json.loads(rbody if isinstance(rbody, str) else
+                     rbody.decode())
+    return status, doc, extra
+
+
+def test_mesh_forms_routes_and_isolates_models(live_mesh, tmp_path):
+    """Gen-0 barrier, placement application, and the no-misroute
+    invariant: each tenant's requests are answered by ITS model, and the
+    other replica never even loads it."""
+    router, reps = live_mesh(2)
+    da, wa = _make_export(tmp_path, "a", scale=1.0)
+    db, wb = _make_export(tmp_path, "b", scale=-3.0)
+    ra = router.add_tenant("ta", **_tenant_kw(da, max_pending_mb=8.0))
+    rb = router.add_tenant("tb", **_tenant_kw(db, max_pending_mb=1.0))
+    assert ra != rb  # different models balance apart
+    x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    s, doc, _ = _predict_via(router, "ta", x)
+    assert s == 200
+    np.testing.assert_allclose(np.asarray(doc["outputs"]["score"]),
+                               x @ wa, rtol=1e-5)
+    s, doc, _ = _predict_via(router, "tb", x)
+    assert s == 200
+    np.testing.assert_allclose(np.asarray(doc["outputs"]["score"]),
+                               x @ wb, rtol=1e-5)
+    # the replica NOT hosting a tenant does not know it at all — a
+    # misroute would be a KeyError, not a wrong answer
+    by_id = {rep.agent.replica_id: rep for rep in reps}
+    with pytest.raises(KeyError):
+        by_id[rb].srv.submit("ta", {"x": x}, timeout=5.0)
+    with pytest.raises(KeyError):
+        by_id[ra].srv.submit("tb", {"x": x}, timeout=5.0)
+    # unknown tenant at the router: a real 404 (not a retryable)
+    s, doc, _ = _predict_via(router, "nope", x)
+    assert s == 404
+
+
+def test_replica_loss_replaces_tenants_and_fences_zombie(live_mesh,
+                                                         tmp_path):
+    """Kill the replica hosting a tenant: the router regroups within one
+    poll cycle, re-places the tenant on the survivor, and requests flow
+    again — while the dead replica's generation is fenced off."""
+    router, reps = live_mesh(2)
+    d, w = _make_export(tmp_path)
+    rid = router.add_tenant("t", **_tenant_kw(d))
+    by_id = {rep.agent.replica_id: rep for rep in reps}
+    victim, survivor = by_id[rid], next(
+        rep for rep in reps if rep.agent.replica_id != rid)
+    x = np.random.RandomState(1).rand(2, 4).astype(np.float32)
+    assert _predict_via(router, "t", x)[0] == 200
+
+    victim.kill()
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        st = router.stats()
+        if (st["generation"] == 1 and st["state"] == "watching"
+                and st["placements"].get("t")
+                == survivor.agent.replica_id):
+            break
+        time.sleep(0.1)
+    st = router.stats()
+    assert st["generation"] == 1
+    assert st["placements"]["t"] == survivor.agent.replica_id
+    assert st["lost_replicas"] == [victim.agent.replica_id]
+    assert st["regroups"][-1]["replaced_tenants"] == {
+        "t": survivor.agent.replica_id}
+
+    # requests flow again (retry through the apply window)
+    deadline = time.monotonic() + 20.0
+    while True:
+        s, doc, _ = _predict_via(router, "t", x)
+        if s == 200:
+            break
+        assert s in (429, 503), doc  # only explicit retryables en route
+        assert time.monotonic() < deadline
+        time.sleep(0.1)
+    np.testing.assert_allclose(np.asarray(doc["outputs"]["score"]),
+                               x @ w, rtol=1e-5)
+
+    # the zombie's old generation is fenced: a gen-0-stamped write fails
+    stale = reservation.Client(router.server.address, router.auth_token,
+                               generation=0)
+    with pytest.raises(reservation.StaleGenerationError):
+        stale.register({"executor_id": victim.agent.replica_id,
+                        "host": "127.0.0.1", "port": 1})
+
+
+def test_join_is_a_regroup(live_mesh, tmp_path):
+    router, reps = live_mesh(1)
+    addr = router.server.address
+    joiner = _LiveReplica("rj", addr, router.auth_token, join=True)
+    try:
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            st = router.stats()
+            if "rj" in st["replicas"] and st["state"] == "watching":
+                break
+            time.sleep(0.1)
+        st = router.stats()
+        assert set(st["replicas"]) == {"r0", "rj"}
+        assert st["generation"] == 1
+        assert st["regroups"][-1]["joined"] == ["rj"]
+        assert joiner.agent.generation == 1
+        # the joined replica takes placements like any member
+        d, _ = _make_export(tmp_path)
+        router.add_tenant("t0", **_tenant_kw(d, max_pending_mb=40.0))
+        rid2 = router.add_tenant(
+            "t1", **_tenant_kw(d, max_pending_mb=40.0))
+        assert rid2 == "rj" or router.stats()["placements"]["t0"] == "rj"
+    finally:
+        joiner.stop()
+
+
+def test_router_shed_is_explicit_429_pre_hop(live_mesh, tmp_path):
+    router, reps = live_mesh(1)
+    d, _ = _make_export(tmp_path)
+    rid = router.add_tenant("t", **_tenant_kw(d))
+    r = router._replicas[rid]
+    shed_before = int(router._shed_total.value)
+    # forge a fresh over-bound health snapshot: the router must 429
+    # WITHOUT burning the hop
+    r.health = {"tenants": {"t": {"pending_bytes": 10, "max_pending_bytes":
+                                  10, "shed_window": {"offered": 0,
+                                                      "shed": 0,
+                                                      "shed_rate": 0.0}}}}
+    r.health_ts = time.time()
+    x = np.ones((1, 4), np.float32)
+    s, doc, extra = _predict_via(router, "t", x)
+    assert s == 429
+    assert "Retry-After" in (extra or {})
+    assert int(router._shed_total.value) == shed_before + 1
+    # fresh healthy snapshot: flows again
+    r.health = {"tenants": {"t": {"pending_bytes": 0, "max_pending_bytes":
+                                  10, "shed_window": {"offered": 0,
+                                                      "shed": 0,
+                                                      "shed_rate": 0.0}}}}
+    r.health_ts = time.time()
+    assert _predict_via(router, "t", x)[0] == 200
+
+
+def test_traceparent_renders_single_router_replica_tree(live_mesh,
+                                                        tmp_path,
+                                                        monkeypatch):
+    """One request through the real HTTP front end with a W3C
+    traceparent: the merged /debug/requests shows ONE tree — router
+    ``route``/``proxy`` spans and the replica's ``online.request`` tree
+    under the router's root."""
+    monkeypatch.setenv("TFOS_TRACE_SAMPLE", "1")
+    store = trace_lib.get_trace_store()
+    store.clear()
+    router, reps = live_mesh(1)
+    d, w = _make_export(tmp_path)
+    router.add_tenant("t", **_tenant_kw(d))
+    front = mesh.MeshHTTPServer(router)
+    host, port = front.start()
+    try:
+        ctx = trace_lib.TraceContext.new()
+        body = json.dumps({"tenant": "t",
+                           "inputs": {"x": [[1.0, 2.0, 3.0, 4.0]]}})
+        conn = http.client.HTTPConnection(host, port, timeout=20)
+        conn.request("POST", "/v1/predict", body=body,
+                     headers={"Content-Type": "application/json",
+                              "traceparent": ctx.traceparent()})
+        resp = conn.getresponse()
+        doc = json.loads(resp.read().decode())
+        assert resp.status == 200
+        np.testing.assert_allclose(
+            np.asarray(doc["outputs"]["score"]),
+            np.asarray([[1.0, 2.0, 3.0, 4.0]], np.float32) @ w,
+            rtol=1e-5)
+        conn.close()
+
+        conn = http.client.HTTPConnection(host, port, timeout=20)
+        conn.request("GET", "/debug/requests")
+        resp = conn.getresponse()
+        debug = json.loads(resp.read().decode())
+        conn.close()
+        assert debug["merged"] is True
+        entries = [e for e in debug["retained"]
+                   if e["trace_id"] == ctx.trace_id]
+        assert len(entries) == 1, "one request, ONE merged tree"
+        tree = entries[0]
+        assert tree["merged_entries"] == 2
+        names = {s["name"] for s in tree["spans"]}
+        assert {"mesh.request", "route", "proxy",
+                "online.request"} <= names
+        spans = {s["name"]: s for s in tree["spans"]}
+        # the whole tree hangs together: router root under the caller's
+        # context, replica root under the router's root
+        assert spans["mesh.request"]["parent_span_id"] == ctx.span_id
+        assert spans["online.request"]["parent_span_id"] == \
+            spans["mesh.request"]["span_id"]
+        assert spans["proxy"]["parent_span_id"] == \
+            spans["mesh.request"]["span_id"]
+    finally:
+        front.stop()
+        store.clear()
+
+
+def test_mesh_http_front_end_views(live_mesh, tmp_path):
+    router, reps = live_mesh(1)
+    d, _ = _make_export(tmp_path)
+    router.add_tenant("t", **_tenant_kw(d))
+    front = mesh.MeshHTTPServer(router)
+    host, port = front.start()
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=20)
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        doc = json.loads(resp.read().decode())
+        assert resp.status == 200
+        assert doc["state"] == "watching"
+        assert doc["placements"]["t"] in doc["replicas"]
+        conn.close()
+        conn = http.client.HTTPConnection(host, port, timeout=20)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        text = resp.read().decode()
+        assert resp.status == 200
+        assert "mesh_replicas_up" in text
+        from tensorflowonspark_tpu.obs import httpd
+        assert httpd.validate_prometheus_text(text) == []
+        conn.close()
+        # POST to an unrouted path: structured 404 from the shared server
+        conn = http.client.HTTPConnection(host, port, timeout=20)
+        conn.request("POST", "/nope", body=b"{}")
+        resp = conn.getresponse()
+        assert resp.status == 404
+        assert "/v1/predict" in json.loads(resp.read().decode())["routes"]
+        conn.close()
+    finally:
+        front.stop()
+
+
+def test_duplicate_tenant_key_routes_like_the_replica_parses(live_mesh,
+                                                             tmp_path):
+    """A crafted duplicate-key body must not be admitted/metered as one
+    tenant and served as another: the router's fast path only trusts a
+    unique '"tenant"', falling back to json.loads — whose last-key-wins
+    matches the replica's authoritative parse."""
+    router, reps = live_mesh(2)
+    da, wa = _make_export(tmp_path, "a", scale=1.0)
+    db, wb = _make_export(tmp_path, "b", scale=-2.0)
+    router.add_tenant("ta", **_tenant_kw(da, max_pending_mb=8.0))
+    router.add_tenant("tb", **_tenant_kw(db, max_pending_mb=1.0))
+    x = np.random.RandomState(3).rand(2, 4).astype(np.float32)
+    body = ('{"tenant": "ta", "inputs": {"x": '
+            + json.dumps(x.tolist()) + '}, "tenant": "tb"}').encode()
+    status, _ct, rbody, _extra = router.route_predict(body, {})
+    assert status == 200
+    doc = json.loads(rbody if isinstance(rbody, str) else rbody.decode())
+    # the reply is TB's model — the tenant the replica would serve
+    np.testing.assert_allclose(np.asarray(doc["outputs"]["score"]),
+                               x @ wb, rtol=1e-5)
+
+
+def test_keepalive_connection_survives_unrouted_post(live_mesh, tmp_path):
+    """HTTP/1.1 keep-alive: a POST to an unknown path (body unread by the
+    router logic) must not desync the connection — the next request on
+    the SAME connection must still parse."""
+    router, reps = live_mesh(1)
+    d, w = _make_export(tmp_path)
+    router.add_tenant("t", **_tenant_kw(d))
+    front = mesh.MeshHTTPServer(router)
+    host, port = front.start()
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=20)
+        conn.request("POST", "/nope", body=b'{"some": "body"}',
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 404
+        # same connection, next request: must be served, not mis-parsed
+        body = json.dumps({"tenant": "t",
+                           "inputs": {"x": [[1.0, 2.0, 3.0, 4.0]]}})
+        conn.request("POST", "/v1/predict", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        doc = json.loads(resp.read().decode())
+        assert resp.status == 200, doc
+        conn.close()
+    finally:
+        front.stop()
+
+
+def test_elastic_poll_command_filters_stale_and_garbage():
+    srv = reservation.Server(1)
+    addr = srv.start()
+    try:
+        from tensorflowonspark_tpu import elastic
+
+        client = reservation.Client(addr, srv.auth_token, retries=0)
+        assert elastic.poll_command(client, "k", 0) is None  # absent
+        srv.kv_put("k", "not-a-dict")
+        assert elastic.poll_command(client, "k", 0) is None
+        srv.kv_put("k", {"gen": 2, "op": "x"})
+        assert elastic.poll_command(client, "k", 2) is None  # not news
+        cmd = elastic.poll_command(client, "k", 1)
+        assert cmd == {"gen": 2, "op": "x"}
+    finally:
+        srv.stop()
+
+
+def test_concurrent_mixed_tenant_requests_route_correctly(live_mesh,
+                                                          tmp_path):
+    """A mixed-tenant burst through the router: every reply comes from
+    the right model, concurrently (the satellite invariant end-to-end)."""
+    router, reps = live_mesh(2)
+    da, wa = _make_export(tmp_path, "a", scale=1.0)
+    db, wb = _make_export(tmp_path, "b", scale=2.5)
+    router.add_tenant("ta", **_tenant_kw(da, max_pending_mb=8.0))
+    router.add_tenant("tb", **_tenant_kw(db, max_pending_mb=1.0))
+    weights = {"ta": wa, "tb": wb}
+    errors = []
+
+    def call(i):
+        tenant = "ta" if i % 2 == 0 else "tb"
+        x = np.random.RandomState(i).rand(1, 4).astype(np.float32)
+        try:
+            s, doc, _ = _predict_via(router, tenant, x)
+            assert s == 200, doc
+            np.testing.assert_allclose(
+                np.asarray(doc["outputs"]["score"]),
+                x @ weights[tenant], rtol=1e-4)
+        except Exception as e:  # noqa: BLE001 - collected for the assert
+            errors.append(f"req {i} ({tenant}): {e!r}")
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    assert errors == []

@@ -443,7 +443,10 @@ def test_http_predict_metrics_healthz_pipeline(export_dir):
         with urllib.request.urlopen(http.url("/metrics"), timeout=10) as r:
             text = r.read().decode()
         assert "online_requests_total" in text
-        assert "online_request_seconds_a" in text
+        assert 'online_request_seconds_bucket' in text
+        assert 'tenant="a"' in text
+        # the round-11 name-mangled aliases are gone (scheduled deletion)
+        assert "online_request_seconds_a" not in text
         from tensorflowonspark_tpu.obs import httpd
         assert httpd.validate_prometheus_text(text) == []
 
@@ -453,6 +456,15 @@ def test_http_predict_metrics_healthz_pipeline(export_dir):
         assert health["state"] == "serving"
         assert "a" in health["tenants"]
         assert health["tenants"]["a"]["latency_p99_ms"] is not None
+        # the machine-consumable admission block (stable schema v1) the
+        # mesh router's global admission control reads
+        adm = health["admission"]
+        assert adm["admission_schema"] == 1
+        assert adm["pending_bytes"] >= 0
+        assert adm["max_pending_bytes"] > 0
+        assert 0.0 <= adm["saturation"] <= 1.0
+        assert set(adm["shed_window"]) == {"window_s", "offered", "shed",
+                                           "shed_rate"}
 
         with urllib.request.urlopen(http.url("/pipeline"),
                                     timeout=10) as r:
@@ -635,15 +647,15 @@ def test_request_tracing_e2e_http(export_dir, monkeypatch):
                           if ctx.trace_id in ln]
         assert any('online_request_seconds_bucket' in ln
                    and 'tenant="slow"' in ln for ln in exemplar_lines)
-        # classic scrape: no exemplars, still valid, labeled + legacy
-        # series both present (one round of dual publication)
+        # classic scrape: no exemplars, still valid, labeled series only
+        # (the round-11 name-mangled aliases are gone)
         with urllib.request.urlopen(http.url("/metrics"), timeout=10) as r:
             classic = r.read().decode()
         assert httpd.validate_prometheus_text(classic) == []
         assert ctx.trace_id not in classic
         assert 'online_request_seconds_bucket{le="0.001",tenant="slow"}' \
             in classic
-        assert "online_request_seconds_slow_bucket" in classic
+        assert "online_request_seconds_slow_bucket" not in classic
     finally:
         http.stop()
         srv.stop()
@@ -818,19 +830,52 @@ def test_remove_tenant_evicts_metric_series(export_dir):
         srv.stop()
 
 
-def test_remove_tenant_evicts_legacy_series_too(export_dir):
-    """Eviction covers the one-round name-mangled aliases as well — a
-    removed tenant must not pin ANY registry slot."""
+def test_legacy_name_mangled_series_never_published(export_dir):
+    """The round-11 name-mangled per-tenant aliases
+    (``online_request_seconds_<tenant>`` et al.) were dual-published for
+    exactly one round; their scheduled deletion is done — a live tenant
+    publishes ONLY the labeled families."""
     srv = _server(export_dir, tenants=("gone",))
     try:
         srv.submit("gone", {"features": _rows(1)}, timeout=10.0)
         text = obs.get_registry().to_prometheus()
-        assert "online_request_seconds_gone" in text
-        srv.remove_tenant("gone")
-        text = obs.get_registry().to_prometheus()
+        assert 'online_tenant_requests_total{tenant="gone"}' in text
+        assert 'tenant="gone"' in text
         assert "online_requests_gone_total" not in text
         assert "online_shed_gone_total" not in text
         assert "online_request_seconds_gone" not in text
+        srv.remove_tenant("gone")
+        text = obs.get_registry().to_prometheus()
+        assert 'tenant="gone"' not in text
+    finally:
+        srv.stop()
+
+
+def test_stats_admission_block_aggregates_tenants(export_dir):
+    """The ``/healthz`` ``admission`` block sums byte-bound state and the
+    tumbling shed window across tenants — one field for the mesh
+    router's global admission control (schema v1)."""
+    srv = _server(export_dir, tenants=("a", "b"),
+                  max_pending_mb=1.0)
+    try:
+        srv.submit("a", {"features": _rows(2)}, timeout=10.0)
+        srv.submit("b", {"features": _rows(1)}, timeout=10.0)
+        doc = srv.stats()
+        adm = doc["admission"]
+        assert adm["admission_schema"] == 1
+        assert adm["max_pending_bytes"] == sum(
+            t["max_pending_bytes"] for t in doc["tenants"].values())
+        assert adm["pending_bytes"] == sum(
+            t["pending_bytes"] for t in doc["tenants"].values())
+        assert adm["pending_rows"] == sum(
+            t["pending_rows"] for t in doc["tenants"].values())
+        w = adm["shed_window"]
+        assert w["offered"] == sum(
+            t["shed_window"]["offered"] for t in doc["tenants"].values())
+        assert w["offered"] >= 2 and w["shed"] == 0
+        assert w["shed_rate"] == 0.0
+        assert adm["saturation"] == pytest.approx(
+            adm["pending_bytes"] / adm["max_pending_bytes"], abs=1e-4)
     finally:
         srv.stop()
 

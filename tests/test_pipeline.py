@@ -1,6 +1,7 @@
 """Spark ML pipeline layer: param protocol units + fit/transform end-to-end
 on the local substrate (SURVEY.md §4 — test/test_pipeline.py analogue)."""
 
+import os
 import sys
 
 import cloudpickle
@@ -230,6 +231,28 @@ def test_serving_pump_failure_propagates_to_consumer(tmp_path):
     rows, _ = _feature_rows(10)
     with pytest.raises(KeyError, match="missing_col"):
         list(rm(iter(rows)))
+
+
+def test_model_cache_key_namespaces_zoo_names(tmp_path):
+    """The placement/cache identity must be computable without loading
+    the model, agree with _RunModel._load, and never let a zoo model
+    named 'saved_forward' collide with the serialized-forward sentinel."""
+    d = str(tmp_path / "exp")
+    os.makedirs(d)
+
+    def my_fn(params, batch):  # noqa: ANN001 - key fixture only
+        return batch
+
+    path, fn_id, _mt = pipeline.model_cache_key(d, model_name="wide_deep")
+    assert fn_id == "model:wide_deep"
+    # a pathological model_name cannot masquerade as a serialized forward
+    _p, fn_id, _mt = pipeline.model_cache_key(
+        d, model_name="saved_forward")
+    assert fn_id != "saved_forward"
+    # predict_fn beats model_name (user intent)
+    _p, fn_id, _mt = pipeline.model_cache_key(
+        d, model_name="wide_deep", predict_fn=my_fn)
+    assert "my_fn" in fn_id
 
 
 def test_model_cache_evicts_prior_entry_on_reexport(tmp_path):

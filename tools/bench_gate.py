@@ -58,6 +58,16 @@ half must carry ``trace_overhead_frac`` — the A/B-measured cost of
 request tracing on the online path (enabled vs ``TFOS_TRACE_REQUESTS=0``)
 — as a fraction in [-1, 1], or an explicit ``null`` +
 ``trace_overhead_reason`` (same convention as the flight breakdowns).
+From round ``--require-mesh-from`` (default 13, the round that introduced
+the multi-host serving mesh) the primary half must carry
+``mesh_rows_per_sec`` — aggregate closed-loop throughput of N replica
+processes behind the placement router — or an explicit ``null`` +
+``mesh_reason``; a numeric value must ship its config identity
+(replica/client/geometry/SLO *and host CPU count*: N processes cannot
+scale past the cores the box has, so scale efficiency is only comparable
+at one CPU count), its ``mesh_scale_efficiency`` (mesh ÷ replicas ×
+single-process baseline), and a ``mesh_p99_ms`` within ``mesh_slo_ms``;
+healthy numbers are regression-compared only within one mesh geometry.
 
 Usage::
 
@@ -104,6 +114,9 @@ DEFAULT_REQUIRE_ONLINE_FROM = 11
 #: overhead (``trace_overhead_frac``, introduced with request-scoped
 #: distributed tracing)
 DEFAULT_REQUIRE_TRACE_FROM = 12
+#: first round whose primary half must carry the serving-mesh microbench
+#: (``mesh_rows_per_sec``, introduced with the multi-host serving mesh)
+DEFAULT_REQUIRE_MESH_FROM = 13
 #: |stage_sum / wall - 1| beyond this fails the artifact: a breakdown that
 #: does not add up is decoration, not attribution
 DEFAULT_FLIGHT_TOLERANCE = 0.15
@@ -122,6 +135,16 @@ _RECOVERY_IDENT_KEYS = ("recovery_num_executors",
                         "recovery_kill_at_step", "recovery_batch_size")
 _ONLINE_KEY = "online_rows_per_sec"
 _TRACE_OVERHEAD_KEY = "trace_overhead_frac"
+_MESH_KEY = "mesh_rows_per_sec"
+#: the mesh microbench's config identity: aggregate rows/sec is only
+#: comparable at the same replica/client counts, request volume, model
+#: geometry, bucket ladder, SLO AND host CPU count — N processes cannot
+#: scale past the cores the box has, so a number measured on a different
+#: core count is a different experiment
+_MESH_IDENT_KEYS = ("mesh_replicas", "mesh_clients", "mesh_rows_total",
+                    "mesh_batch_size", "mesh_feature_dim",
+                    "mesh_hidden_dim", "mesh_bucket_sizes",
+                    "mesh_slo_ms", "mesh_flush_ms", "mesh_host_cpus")
 #: the online microbench's config identity: closed-loop rows/sec is only
 #: comparable at the same client count / request volume / model geometry /
 #: bucket ladder AND the same p99 SLO — a number sustained at a looser
@@ -247,7 +270,8 @@ def validate_half(half: dict[str, Any], *,
                   require_serving: bool = False,
                   require_recovery: bool = False,
                   require_online: bool = False,
-                  require_trace: bool = False) -> list[str]:
+                  require_trace: bool = False,
+                  require_mesh: bool = False) -> list[str]:
     """Schema problems of one measured result (a wrapper's half)."""
     problems = []
     for key in _REQUIRED_HALF_KEYS:
@@ -357,6 +381,46 @@ def validate_half(half: dict[str, Any], *,
                     f"online_p99_ms {p99} exceeds online_slo_ms {slo}: a "
                     "throughput claimed at an SLO it missed is not a "
                     "measurement")
+    # serving-mesh microbench (multi-host tier): host-side like the
+    # others — required on primary from r13 even on degraded rounds;
+    # null + 'mesh_reason' always satisfies.  A numeric value must carry
+    # its config identity, its scale efficiency (the claim the mesh
+    # exists to make), and prove the SLO was met
+    if require_mesh or _MESH_KEY in half:
+        if _MESH_KEY not in half:
+            problems.append(
+                f"missing {_MESH_KEY!r} (serving-mesh microbench is part "
+                "of the schema from r13: measure it or stamp an explicit "
+                "null + 'mesh_reason')")
+        elif half[_MESH_KEY] is None and "mesh_reason" not in half:
+            problems.append(
+                f"{_MESH_KEY!r} is null without a 'mesh_reason'")
+        elif isinstance(half.get(_MESH_KEY), (int, float)):
+            missing = [k for k in _MESH_IDENT_KEYS if k not in half]
+            if missing:
+                problems.append(
+                    f"{_MESH_KEY!r} without its config identity "
+                    f"({', '.join(missing)}) — aggregate rows/sec is "
+                    "only comparable within one replica/geometry/SLO/"
+                    "CPU-count config")
+            if not isinstance(half.get("mesh_scale_efficiency"),
+                              (int, float)):
+                problems.append(
+                    f"{_MESH_KEY!r} without a numeric "
+                    "'mesh_scale_efficiency' — the aggregate number is "
+                    "only meaningful against the single-process "
+                    "baseline it scales from")
+            p99 = half.get("mesh_p99_ms")
+            slo = half.get("mesh_slo_ms")
+            if not isinstance(p99, (int, float)):
+                problems.append(
+                    f"{_MESH_KEY!r} without its measured 'mesh_p99_ms' "
+                    "— the number is only meaningful AT its p99")
+            elif isinstance(slo, (int, float)) and p99 > slo:
+                problems.append(
+                    f"mesh_p99_ms {p99} exceeds mesh_slo_ms {slo}: a "
+                    "throughput claimed at an SLO it missed is not a "
+                    "measurement")
     # request-tracing overhead: A/B-measured on the online path, so a
     # degraded-accelerator round still owes it; null + reason always
     # satisfies (e.g. TFOS_TRACE_REQUESTS=0 runs have no A to B against)
@@ -444,6 +508,16 @@ def _comparable_prior_online(artifacts: list[dict], newest: dict,
                                       _ONLINE_KEY, _ONLINE_IDENT_KEYS)
 
 
+def _comparable_prior_mesh(artifacts: list[dict], newest: dict,
+                           half: dict) -> tuple[float, str] | None:
+    """Best prior ``mesh_rows_per_sec`` under the same replica/client
+    counts, model geometry, SLO and host CPU count
+    (``_MESH_IDENT_KEYS``).  Host-side like the other microbenches:
+    degraded-accelerator priors still count."""
+    return _comparable_prior_hostside(artifacts, newest, half,
+                                      _MESH_KEY, _MESH_IDENT_KEYS)
+
+
 def _comparable_prior_recovery(artifacts: list[dict], newest: dict,
                                half: dict) -> tuple[float, str] | None:
     """Best (i.e. LOWEST — recovery is a latency) prior
@@ -489,7 +563,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
          flight_tolerance: float = DEFAULT_FLIGHT_TOLERANCE,
          require_recovery_from: int = DEFAULT_REQUIRE_RECOVERY_FROM,
          require_online_from: int = DEFAULT_REQUIRE_ONLINE_FROM,
-         require_trace_from: int = DEFAULT_REQUIRE_TRACE_FROM
+         require_trace_from: int = DEFAULT_REQUIRE_TRACE_FROM,
+         require_mesh_from: int = DEFAULT_REQUIRE_MESH_FROM
          ) -> dict[str, Any]:
     """Run the gate over a trajectory; returns the verdict document."""
     checks: list[dict[str, Any]] = []
@@ -535,12 +610,15 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                           and art["n"] >= require_online_from)
             require_tr = (label == "primary"
                           and art["n"] >= require_trace_from)
+            require_ms = (label == "primary"
+                          and art["n"] >= require_mesh_from)
             for problem in validate_half(half, require_roofline=require_rf,
                                          require_feed=require_fd,
                                          require_serving=require_sv,
                                          require_recovery=require_rc,
                                          require_online=require_on,
-                                         require_trace=require_tr):
+                                         require_trace=require_tr,
+                                         require_mesh=require_ms):
                 check(f"schema:{name}:{label}",
                       "fail" if is_newest else "warn", problem)
             # flight breakdowns ride the primary half with the microbench
@@ -620,6 +698,27 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                     check(oname, "fail",
                           f"{oval} is {round(oval / oprior[0], 4)}× best "
                           f"prior {oprior[0]} ({oprior[1]}) — the online "
+                          f"tier regressed below {threshold}")
+            # serving-mesh microbench: host-side, judged before the
+            # degraded skip like the others
+            if isinstance(half.get(_MESH_KEY), (int, float)):
+                mprior = _comparable_prior_mesh(artifacts, newest, half)
+                mname = f"regression:{_MESH_KEY}"
+                mval = float(half[_MESH_KEY])
+                if mprior is None:
+                    check(mname, "pass",
+                          "no comparable prior mesh measurement (same "
+                          "replicas + geometry + SLO + host CPUs) — "
+                          "nothing to regress against")
+                elif mval >= threshold * mprior[0]:
+                    check(mname, "pass",
+                          f"{mval} vs best prior {mprior[0]} "
+                          f"({mprior[1]}): ratio "
+                          f"{round(mval / mprior[0], 4)} ≥ {threshold}")
+                else:
+                    check(mname, "fail",
+                          f"{mval} is {round(mval / mprior[0], 4)}× best "
+                          f"prior {mprior[0]} ({mprior[1]}) — the mesh "
                           f"tier regressed below {threshold}")
             # recovery microbench: host-side, judged before the degraded
             # skip too.  LOWER is better (it is a latency): the newest run
@@ -729,6 +828,8 @@ def main(argv: list[str] | None = None) -> int:
                    default=DEFAULT_REQUIRE_ONLINE_FROM)
     p.add_argument("--require-trace-from", type=int,
                    default=DEFAULT_REQUIRE_TRACE_FROM)
+    p.add_argument("--require-mesh-from", type=int,
+                   default=DEFAULT_REQUIRE_MESH_FROM)
     args = p.parse_args(argv)
     paths = args.paths or discover(args.repo)
     if not paths:
@@ -744,7 +845,8 @@ def main(argv: list[str] | None = None) -> int:
                flight_tolerance=args.flight_tolerance,
                require_recovery_from=args.require_recovery_from,
                require_online_from=args.require_online_from,
-               require_trace_from=args.require_trace_from)
+               require_trace_from=args.require_trace_from,
+               require_mesh_from=args.require_mesh_from)
     print(json.dumps(doc))
     return 1 if doc["verdict"] == "fail" else 0
 
