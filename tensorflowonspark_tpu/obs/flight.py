@@ -16,10 +16,16 @@ enough to leave on:
     ``ingest`` (shm read + chunk intake), ``collate`` (column
     concatenation + mapping), ``stage`` (an in-feed ``device_put``),
     ``shard`` (the trainer's own shard call), ``compute`` (the jitted
-    step dispatch).  ``TFNode.DataFeed`` adds the wait/ingest/collate/
-    stage parts, ``trainer.Trainer`` adds shard/compute and commits one
-    record per step — every stage name is recorded by exactly one call
-    site, so each histogram stays one observation per batch.
+    step dispatch), ``allreduce`` (the bucketed gradient exchange —
+    modelled against the roofline's delivered ICI bandwidth; always
+    recorded ``_bg``: a model is an upper bound on exposed comm and
+    must not name the bottleneck — the measured ``comm_bound`` verdict
+    comes from bench's step-collectives A/B, which times a no-reduce
+    twin).  ``TFNode.DataFeed`` adds the wait/ingest/
+    collate/stage parts, ``trainer.Trainer`` adds shard/compute/
+    allreduce and commits one record per step — every stage name is
+    recorded by exactly one call site, so each histogram stays one
+    observation per batch.
   - ``"serve"`` — the bucketed serving plane in ``pipeline._RunModel``:
     ``ingest``/``pad``/``stage`` on the prefetch pump (overlapped),
     ``wait``/``compute``/``emit`` on the consumer; ``emit`` includes the
@@ -83,12 +89,13 @@ STAGE_VERDICT = {
     "stage": "ingest_bound",
     "shard": "ingest_bound",
     "compute": "device_bound",
+    "allreduce": "comm_bound",
     "emit": "emit_bound",
     "reply": "emit_bound",
 }
 
 #: every verdict :func:`classify` can return
-VERDICTS = ("feed_starved", "device_bound", "emit_bound",
+VERDICTS = ("feed_starved", "device_bound", "comm_bound", "emit_bound",
             "queue_backpressured", "ingest_bound", "balanced")
 
 #: a verdict needs this share of the additive batch time to be named

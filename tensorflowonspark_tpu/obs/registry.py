@@ -282,6 +282,16 @@ class Registry:
                                  buckets=buckets)
         return self._get(name, Histogram, help=help, buckets=buckets)
 
+    def peek(self, name: str, labels: dict[str, str] | None = None):
+        """The instrument if it already exists, else None — a read that
+        never registers.  For consumers of someone else's measurement
+        (e.g. the trainer reading the roofline probe's gauge): the
+        get-or-create accessors would mint a phantom 0.0 series in every
+        process that merely ASKED, indistinguishable on /metrics from a
+        measured zero."""
+        with self._lock:
+            return self._instruments.get(series_key(name, labels))
+
     def remove(self, name: str,
                labels: dict[str, str] | None = None) -> bool:
         """Drop one series (labeled or plain); True when it existed.

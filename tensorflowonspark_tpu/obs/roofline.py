@@ -88,6 +88,20 @@ def _fetch_scalar(x) -> float:
     return float(np.asarray(jax.device_get(x)).ravel()[0])
 
 
+def _fetch_first_local(arr) -> float:
+    """Host round-trip of ONE element of the local shard — the same
+    data-dependent completion proof as :func:`_fetch_scalar`, but
+    addressable from EVERY process of a multi-host pod (indexing row 0 of
+    a globally-sharded array is only fetchable where device 0 lives).
+    The slice happens on-device so the fetch moves 4 bytes, not the
+    shard (a shard-sized device_get would inflate every timed sample by
+    the very transfer being measured)."""
+    import numpy as np
+
+    return float(np.asarray(arr.addressable_shards[0].data[:1, :1])
+                 .ravel()[0])
+
+
 def _best_time(fn, repeats: int) -> float:
     """Best-of-N wall time of ``fn()`` (bandwidth = peak of the samples;
     the min is the least-interfered measurement)."""
@@ -162,9 +176,20 @@ def measure_ici_bandwidth(size_bytes_per_device: int | None = None,
     ``2*S*(n-1)/n / dt`` — the standard algorithmic-bandwidth convention,
     comparable across world sizes.  Returns ``{"gbps": None, "reason": ...}``
     on a single device.
+
+    The collective is a ``shard_map`` + explicit ``psum`` over a 1-D mesh
+    — the SAME flavor the bucketed train-step path issues per gradient
+    bucket (``parallel/collectives.py``), so ``allreduce_overlap_frac``
+    divides exposed comm by an ideal measured through a like-for-like
+    dispatch/lowering path (the previous ``jax.pmap`` probe measured a
+    lowering the step path never uses).
     """
     import jax
     import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from tensorflowonspark_tpu.parallel import mesh as mesh_lib
 
     n_dev = jax.device_count()  # GLOBAL: the psum axis spans all hosts
     if n_dev < 2:
@@ -172,18 +197,24 @@ def measure_ici_bandwidth(size_bytes_per_device: int | None = None,
     if size_bytes_per_device is None:
         size_bytes_per_device = _default_bytes() // 4
     s = max(1024, int(size_bytes_per_device) // 4)
-    # pmap maps over LOCAL devices only (its collectives still span the
-    # global axis in multi-process JAX) — a global-count leading dim
-    # would raise on every multi-host pod, the very target of this probe
-    x = jnp.ones((jax.local_device_count(), s), jnp.float32)
-    allreduce = jax.pmap(lambda a: jax.lax.psum(a, "i"), axis_name="i")
-    _fetch_scalar(allreduce(x)[0, :1])  # compile outside the clock
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()), ("ici",))
+    sharded = jax.sharding.NamedSharding(mesh, P("ici"))
+    # materialise the operand ON the mesh inside jit (a global shape works
+    # on multi-host pods, where no process could build the full array)
+    x = jax.jit(lambda: jnp.ones((n_dev, s), jnp.float32),
+                out_shardings=sharded)()
+    allreduce = jax.jit(mesh_lib.shard_map_compat(
+        lambda a: jax.lax.psum(a, "ici"), mesh,
+        in_specs=P("ici"), out_specs=P("ici")))
+    # fetch from the LOCAL shard: every process of a multi-host pod can
+    # prove completion from its own slice (row 0 lives on process 0 only)
+    _fetch_first_local(allreduce(x))  # compile outside the clock
     # same honesty contract as the memory probe: subtract the dispatch /
     # fetch overhead (tens of ms on the tunneled backend — BENCH_NOTES
     # timing methodology), and refuse to stamp a number an overhead-
     # dominated sample would massively understate
     overhead = _dispatch_overhead(repeats)
-    dt = _best_time(lambda: _fetch_scalar(allreduce(x)[0, :1]), repeats)
+    dt = _best_time(lambda: _fetch_first_local(allreduce(x)), repeats)
     if dt < 2.0 * overhead:
         return {"gbps": None, "n_devices": n_dev,
                 "reason": "probe dominated by dispatch overhead "

@@ -7,6 +7,11 @@ XLA collectives emitted by ``pjit``/``shard_map`` over a
 ``jax.sharding.Mesh`` — ``psum`` over ICI within a slice, DCN across slices.
 """
 
+from tensorflowonspark_tpu.parallel.collectives import (  # noqa: F401
+    ideal_serial_allreduce_seconds,
+    make_bucketed_train_step,
+    partition_buckets,
+)
 from tensorflowonspark_tpu.parallel.distributed import (  # noqa: F401
     maybe_initialize,
 )

@@ -1,0 +1,322 @@
+"""Bucketed, overlapped gradient collectives for the train-step path.
+
+The monolithic step (``train.make_train_step``) leaves the cross-replica
+gradient exchange entirely to GSPMD: one ``jax.value_and_grad`` over the
+globally-sharded batch, with XLA free to place (and its combiner pass free
+to fuse) the grad all-reduces wherever it likes — in practice after the
+whole backward, so no gradient byte moves over ICI until the last gradient
+is produced.  This module implements the overlap half of "Automatic
+Cross-Replica Sharding of Weight Update in Data-Parallel Training"
+(PAPERS.md 2004.13336; the ZeRO sharding half landed with
+``train.apply_zero_sharding``), with the bucket-size discipline both MPI
+characterization studies (PAPERS.md 1603.02339, 1810.11112) measured:
+bucketed/overlapped collectives dominate monolithic ones at exactly the
+message sizes a model's gradient pytree produces.
+
+Mechanism: the gradient pytree is partitioned into size-bounded **buckets**
+(``TFOS_ALLREDUCE_BUCKET_MB``; leaves larger than a bucket stand alone,
+small leaves coalesce in deterministic flatten order), and the step is
+rebuilt as a ``shard_map`` over the data axes (``dp``/``fsdp``) in
+which each bucket's cross-replica reduction is an **explicit per-bucket**
+``psum``/``pmean``, issued in reverse flatten order — the order backward
+produces gradients.  Because the collectives are separate ops with explicit
+data dependencies, XLA's latency-hiding scheduler can launch bucket *i*'s
+all-reduce while backward is still producing bucket *i-1*'s gradients, and
+the per-leaf optimizer dataflow (each parameter's ``optax`` update depends
+only on its own bucket's reduction plus a scalar count) lets weight updates
+overlap the remaining reductions — comm hides behind both remaining
+backward and weight update, the 2004.13336 discipline.
+
+Composition contract (everything the monolithic step supports):
+
+- **stateful losses** (BatchNorm collections): local ``(loss, new_cols)``
+  per data shard; the returned loss and every *floating* collection leaf
+  are cross-replica ``pmean``'d, so running statistics track the global
+  batch mean exactly (batch-*mean* statistics are linear; a batch
+  *variance* differs from the global-view one by the between-shard mean
+  spread — the standard local-BatchNorm DDP semantics, restored to
+  global-view by ``TFOS_BUCKETED_ALLREDUCE=0``).
+- **ZeRO** ``fsdp`` sharding: params enter the manual region replicated
+  (XLA all-gathers the ``fsdp`` shards — the same per-weight collective
+  ZeRO issues anyway), reduced grads leave replicated, and the optimizer
+  update outside the region runs under GSPMD against the ``fsdp``-sharded
+  optimizer state.
+- **model-parallel meshes opt out cleanly**: ``tp``/``sp``/``pp``/``ep``
+  collectives live *inside* the model (GSPMD constraints, ring attention,
+  GPipe) and do not compose with a data-axis manual region, so those
+  meshes — and models prescribing their own sharded step or collection
+  shardings (wide&deep) — keep the monolithic path
+  (:func:`mesh_eligibility` names the reason).
+- **buffer donation** and ``Trainer.attach_elastic``'s step-boundary
+  regroup ride the unchanged ``compile_step`` plumbing.
+
+``TFOS_BUCKETED_ALLREDUCE=0`` opts back into the monolithic step.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Callable, Sequence
+
+from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+
+logger = logging.getLogger(__name__)
+
+#: the data-parallel mesh axes a gradient all-reduce spans: dp and fsdp
+#: *are* the data-parallel world under ZeRO (the batch-axis split of
+#: ``mesh.batch_spec`` minus ``ep``, which :data:`MODEL_AXES` bars from
+#: this path — an ep>1 mesh keeps the monolithic step because MoE's token
+#: all_to_alls live inside the model, so the size-1 ep axis never needs
+#: to appear in these collectives)
+DATA_AXES = ("dp", "fsdp")
+
+#: mesh axes whose collectives live inside the model, not on the gradient
+#: exchange — any of these sized >1 keeps the monolithic step (``ep``
+#: included: expert-parallel gradient bucketing is future work, see
+#: ROADMAP item 2's remaining opportunities)
+MODEL_AXES = ("tp", "sp", "pp", "ep")
+
+#: default bucket size (MiB).  Sized against the PR 2 ICI roofline probe:
+#: the probe's delivered-bandwidth plateau starts at single-digit-MB
+#: payloads (its own working set is ``_default_bytes()/4`` ≈ 8 MB/device on
+#: accelerators), while per-collective launch latency is ~10 µs — at
+#: 4 MiB a v4 ICI link (~2.4e10 B/s algorithmic) spends ~350 µs moving
+#: bytes, ~35× the launch cost, yet a ResNet-50-sized gradient set still
+#: splits into ~25 buckets to pipeline.  See DEPLOY.md for the sizing
+#: arithmetic.
+DEFAULT_BUCKET_MB = 4.0
+
+
+def bucketing_enabled() -> bool:
+    """``TFOS_BUCKETED_ALLREDUCE`` gate, default ON (re-read per call so
+    tests and the bench A/B can toggle it live)."""
+    return os.environ.get("TFOS_BUCKETED_ALLREDUCE", "1").strip().lower() \
+        not in ("0", "false", "no")
+
+
+def bucket_bytes_default() -> int:
+    """Bucket size in bytes: ``TFOS_ALLREDUCE_BUCKET_MB`` override, else
+    :data:`DEFAULT_BUCKET_MB`."""
+    env = os.environ.get("TFOS_ALLREDUCE_BUCKET_MB", "")
+    try:
+        mb = float(env) if env else DEFAULT_BUCKET_MB
+    except ValueError:
+        mb = DEFAULT_BUCKET_MB
+    return max(1, int(mb * 1024 * 1024))
+
+
+def mesh_eligibility(mesh, collection_shardings=None) -> tuple[bool, str]:
+    """Can the bucketed step run on this mesh/model combination?
+
+    Returns ``(ok, reason)`` — the reason names exactly why the monolithic
+    step is kept, so the fallback is observable, not silent.
+    """
+    for axis in MODEL_AXES:
+        if mesh.shape.get(axis, 1) > 1:
+            return False, (
+                f"mesh axis {axis!r} > 1: model-internal collectives "
+                "(tensor/sequence/pipeline/expert) do not compose with a "
+                "data-axis manual region")
+    if data_parallel_world(mesh) < 2:
+        return False, ("single data shard: no cross-replica gradient "
+                       "exchange to bucket")
+    if collection_shardings:
+        return False, ("model-prescribed collection shardings: collections "
+                       "cannot be treated as replicated inside the manual "
+                       "region")
+    return True, "eligible"
+
+
+def data_parallel_world(mesh) -> int:
+    """Participants in the gradient all-reduce (``dp × fsdp``; ``ep`` is
+    barred from this path by :data:`MODEL_AXES`)."""
+    return int(mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1))
+
+
+def leaf_bytes(leaf) -> int:
+    """Gradient bytes one param leaf contributes to the exchange."""
+    size = int(getattr(leaf, "size", 0) or 0)
+    dtype = getattr(leaf, "dtype", None)
+    itemsize = getattr(dtype, "itemsize", 4) if dtype is not None else 4
+    return size * itemsize
+
+
+def partition_buckets(leaves: Sequence[Any], bucket_bytes: int
+                      ) -> list[list[int]]:
+    """Partition param leaves (by flatten index) into size-bounded buckets.
+
+    Deterministic — a pure function of flatten order and sizes, so every
+    process of a multi-host job builds the identical collective schedule:
+
+    - a leaf of ``>= bucket_bytes`` stands alone (never split: one leaf =
+      one array = one collective operand);
+    - smaller leaves coalesce greedily in flatten order until the next
+      leaf would push the bucket past ``bucket_bytes``.
+    """
+    buckets: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    for i, leaf in enumerate(leaves):
+        nb = leaf_bytes(leaf)
+        if nb >= bucket_bytes:
+            if cur:
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            buckets.append([i])
+            continue
+        if cur and cur_bytes + nb > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nb
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def ideal_serial_allreduce_seconds(nbytes: int, n_devices: int,
+                                   bw_gbps: float | None) -> float | None:
+    """Serial (zero-overlap) wall cost of all-reducing ``nbytes`` of
+    gradients across ``n_devices`` at the *delivered* interconnect
+    bandwidth — the denominator of ``allreduce_overlap_frac``.
+
+    Uses the ring algorithmic-bandwidth convention ``2·S·(n-1)/n``,
+    matching how ``obs/roofline.py::measure_ici_bandwidth`` reports
+    ``ici_bw_gbps``, so exposed-comm-time divides by a like-for-like
+    ideal.  ``None`` when there is no bandwidth figure or no interconnect.
+    """
+    if not bw_gbps or bw_gbps <= 0 or n_devices < 2 or nbytes <= 0:
+        return None
+    moved = 2.0 * float(nbytes) * (n_devices - 1) / n_devices
+    return moved / (bw_gbps * 1e9)
+
+
+def _cross_replica_mean_collections(cols):
+    """``pmean`` floating collection leaves over the data axes (running
+    batch statistics become global-batch means); non-float leaves (step
+    counters etc.) pass through as local values."""
+    import jax
+    import jax.numpy as jnp
+
+    def _one(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact):
+            return jax.lax.pmean(x, DATA_AXES)
+        return x
+
+    return jax.tree_util.tree_map(_one, cols)
+
+
+def make_bucketed_train_step(
+    loss_fn: Callable[..., Any],
+    optimizer,
+    mesh,
+    param_shardings,
+    state,
+    batch_example: Any,
+    sequence_axes: dict[str, int] | None = None,
+    donate: bool = True,
+    collection_shardings=None,
+    bucket_bytes: int | None = None,
+    reduce: bool = True,
+):
+    """Compile the bucketed-collective ``state, batch -> state, loss`` step.
+
+    Same contract as :func:`train.make_train_step` (which dispatches here
+    when :func:`mesh_eligibility` holds), plus:
+
+    - ``bucket_bytes``: bucket bound (default
+      :func:`bucket_bytes_default`);
+    - ``reduce=False`` compiles the *no-reduce* twin — identical graph
+      minus the per-bucket gradient collectives — used by ``bench.py`` to
+      measure the compute-only floor an overlap fraction is judged
+      against.  Its numbers are NOT a valid training step.
+
+    The returned step carries the bucket/comm metadata the trainer and
+    bench read: ``.bucketed`` (True), ``.n_buckets``, ``.bucket_bytes``,
+    ``.comm_bytes`` (gradient bytes crossing replicas per step) and
+    ``.data_world`` (all-reduce participants).
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from tensorflowonspark_tpu.parallel.train import TrainState, compile_step
+
+    ok, reason = mesh_eligibility(mesh, collection_shardings)
+    if not ok:
+        raise ValueError(f"bucketed train step unavailable: {reason}")
+
+    stateful = bool(getattr(loss_fn, "stateful", False))
+    param_leaves, param_treedef = jax.tree_util.tree_flatten(state.params)
+    if bucket_bytes is None:
+        bucket_bytes = bucket_bytes_default()
+    buckets = partition_buckets(param_leaves, bucket_bytes)
+    comm_bytes = sum(leaf_bytes(leaf) for leaf in param_leaves)
+
+    def _local_grads(params, collections, batch):
+        """Per-data-shard body: local loss/grads, explicit per-bucket
+        cross-replica means.  The local loss is the mean over this
+        shard's examples; ``pmean`` of equal-sized shard means is exactly
+        the global-batch mean, so losses and gradients match the
+        monolithic step to f32 reduction order."""
+        if stateful:
+            (loss, new_cols), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, collections, batch)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            new_cols = collections
+        grad_leaves = jax.tree_util.tree_leaves(grads)
+        reduced = list(grad_leaves)
+        if reduce:
+            # one variadic collective per bucket, issued in reverse
+            # flatten order — the order backward produces gradients, so
+            # the scheduler can overlap each reduction with the rest of
+            # the backward still running
+            for bucket in reversed(buckets):
+                vals = jax.lax.pmean(
+                    [grad_leaves[i] for i in bucket], DATA_AXES)
+                for i, v in zip(bucket, vals):
+                    reduced[i] = v
+        loss = jax.lax.pmean(loss, DATA_AXES)
+        if stateful:
+            new_cols = _cross_replica_mean_collections(new_cols)
+        return loss, new_cols, tuple(reduced)
+
+    def _batch_in_spec(leaf):
+        ndim = getattr(leaf, "ndim", 0)
+        if not ndim:
+            return P()
+        return P(*([DATA_AXES] + [None] * (ndim - 1)))
+
+    replicated = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)  # noqa: E731
+    smapped = mesh_lib.shard_map_compat(
+        _local_grads, mesh,
+        in_specs=(replicated(state.params), replicated(state.collections),
+                  jax.tree_util.tree_map(_batch_in_spec, batch_example)),
+        out_specs=(P(), replicated(state.collections),
+                   tuple(P() for _ in param_leaves)),
+    )
+
+    def _step(st: TrainState, batch):
+        loss, new_cols, reduced = smapped(st.params, st.collections, batch)
+        grads = jax.tree_util.tree_unflatten(param_treedef, list(reduced))
+        # one optax call, per-leaf dataflow: each param's update/apply
+        # depends only on its own bucket's reduction (plus the scalar
+        # count), so XLA schedules bucket i's weight update behind bucket
+        # i's all-reduce while later buckets are still reducing
+        updates, opt_state = optimizer.update(grads, st.opt_state, st.params)
+        import optax
+
+        params = optax.apply_updates(st.params, updates)
+        return TrainState(params, opt_state, st.step + 1, new_cols), loss
+
+    step = compile_step(_step, mesh, param_shardings, state, batch_example,
+                        sequence_axes=sequence_axes, donate=donate,
+                        collection_shardings=collection_shardings)
+    step.bucketed = True
+    step.reduce = reduce
+    step.n_buckets = len(buckets)
+    step.bucket_bytes = bucket_bytes
+    step.comm_bytes = comm_bytes
+    step.data_world = data_parallel_world(mesh)
+    return step

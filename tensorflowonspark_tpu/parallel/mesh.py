@@ -187,6 +187,29 @@ def hybrid_device_array(config: MeshConfig, devices: list):
     return stacked.reshape(tuple(sizes[a] for a in AXES))
 
 
+def shard_map_compat(f, mesh, *, in_specs, out_specs):
+    """``shard_map`` with replication checking off, across jax versions
+    (the kwarg was renamed ``check_rep`` → ``check_vma``).
+
+    The one manual-collective entry point shared by ring attention, the
+    GPipe schedule, the bucketed gradient collectives
+    (``parallel/collectives.py``) and the ICI roofline probe
+    (``obs/roofline.py``) — so "the collective flavor the step path uses"
+    is a single construction, not four drifting copies.
+    """
+    import inspect
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    params = inspect.signature(shard_map).parameters
+    kw = "check_vma" if "check_vma" in params else "check_rep"
+    return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     **{kw: False})
+
+
 # -- active mesh -------------------------------------------------------------
 
 # Mesh visible to model code at trace time.  Models are mesh-agnostic (flax

@@ -203,19 +203,12 @@ def make_sharded_attention(mesh, causal: bool = False, impl: str = "ring"):
 
 
 def _shard_map(f, mesh, *, in_specs, out_specs):
-    """``shard_map`` with replication checking off, across jax versions
-    (the kwarg was renamed ``check_rep`` → ``check_vma``)."""
-    import inspect
+    """``shard_map`` with replication checking off — now a thin alias of
+    :func:`mesh.shard_map_compat` (shared with the bucketed gradient
+    collectives and the ICI roofline probe); kept for existing callers."""
+    from tensorflowonspark_tpu.parallel.mesh import shard_map_compat
 
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
-
-    params = inspect.signature(shard_map).parameters
-    kw = "check_vma" if "check_vma" in params else "check_rep"
-    return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                     **{kw: False})
+    return shard_map_compat(f, mesh, in_specs=in_specs, out_specs=out_specs)
 
 
 def local_attention(q, k, v, causal: bool = False, scale: float | None = None,

@@ -17,6 +17,12 @@ setter), collective DP (``MultiWorkerMirroredStrategy`` built from the
 The factory returns a step that is compiled ONCE (static shapes, no Python
 control flow inside) and donates the state buffers so params update in-place
 in HBM.
+
+On data-parallel-only meshes the gradient exchange is no longer left to
+GSPMD: :func:`make_train_step` dispatches to the bucketed, overlapped
+collective step (``parallel/collectives.py`` — explicit per-bucket ``psum``
+issued as backward produces gradients) unless ``TFOS_BUCKETED_ALLREDUCE=0``
+or the mesh/model combination requires the monolithic path.
 """
 
 from __future__ import annotations
@@ -274,6 +280,7 @@ def make_train_step(
     sequence_axes: dict[str, int] | None = None,
     donate: bool = True,
     collection_shardings=None,
+    bucketed: bool | None = None,
 ):
     """Compile ``state, batch -> state, loss`` over the mesh.
 
@@ -284,8 +291,24 @@ def make_train_step(
     ``loss_fn(params, collections, batch) -> (loss, new_collections)``)
     additionally threads non-param variable collections — the BatchNorm
     path; running stats update inside the same compiled step.
+
+    ``bucketed`` selects the gradient-exchange structure:
+
+    - ``None`` (default): the bucketed, overlapped collective step
+      (``parallel/collectives.py``) when ``TFOS_BUCKETED_ALLREDUCE`` is on
+      (default) and the mesh is data-parallel-only
+      (``collectives.mesh_eligibility``); otherwise the monolithic GSPMD
+      step below.
+    - ``True``: force the bucketed step (raises with the reason when the
+      mesh/model combination cannot support it) — the bench A/B path.
+    - ``False``: force the monolithic step.
+
+    The returned step always carries ``.bucketed`` so callers (trainer
+    flight attribution, bench) can see which structure compiled.
     """
     import jax
+
+    from tensorflowonspark_tpu.parallel import collectives
 
     stateful = bool(getattr(loss_fn, "stateful", False))
     if getattr(loss_fn, "tables_frozen", False):
@@ -296,6 +319,19 @@ def make_train_step(
             "Use the model's make_sharded_train_step (the Trainer picks it "
             "up automatically) to train the tables."
         )
+
+    if bucketed is not False:
+        ok, reason = collectives.mesh_eligibility(mesh, collection_shardings)
+        if bucketed is None and not collectives.bucketing_enabled():
+            ok, reason = False, "TFOS_BUCKETED_ALLREDUCE=0"
+        if ok:
+            return collectives.make_bucketed_train_step(
+                loss_fn, optimizer, mesh, param_shardings, state,
+                batch_example, sequence_axes=sequence_axes, donate=donate,
+                collection_shardings=collection_shardings)
+        if bucketed:
+            raise ValueError(f"bucketed train step unavailable: {reason}")
+        logger.debug("monolithic train step (%s)", reason)
 
     def _step(st: TrainState, batch):
         if stateful:
@@ -311,9 +347,11 @@ def make_train_step(
         params = optax.apply_updates(st.params, updates)
         return TrainState(params, opt_state, st.step + 1, new_cols), loss
 
-    return compile_step(_step, mesh, param_shardings, state, batch_example,
+    step = compile_step(_step, mesh, param_shardings, state, batch_example,
                         sequence_axes=sequence_axes, donate=donate,
                         collection_shardings=collection_shardings)
+    step.bucketed = False
+    return step
 
 
 def make_eval_step(forward_fn, mesh, param_shardings, batch_example,

@@ -960,11 +960,12 @@ def test_mesh_p99_over_slo_fails(tmp_path):
 
 
 def test_mesh_regression_within_geometry_only(tmp_path):
-    # same geometry: a halved aggregate rate is a regression
+    # same geometry: a halved aggregate rate is a regression (r14+
+    # artifacts additionally owe the step-collectives fields — _r14)
     paths = [
         _write(tmp_path, "BENCH_r13.json", _r13()),
         _write(tmp_path, "BENCH_r14.json",
-               _r13(**_mesh_fields(rps=2500.0))),
+               _r14(**_mesh_fields(rps=2500.0))),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "fail"
@@ -974,7 +975,7 @@ def test_mesh_regression_within_geometry_only(tmp_path):
     paths = [
         _write(tmp_path, "BENCH_r13.json", _r13()),
         _write(tmp_path, "BENCH_r14.json",
-               _r13(**_mesh_fields(rps=2500.0, mesh_host_cpus=8))),
+               _r14(**_mesh_fields(rps=2500.0, mesh_host_cpus=8))),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "pass", verdict["reasons"]
@@ -986,9 +987,137 @@ def test_mesh_judged_even_on_degraded_newest(tmp_path):
     paths = [
         _write(tmp_path, "BENCH_r13.json", _r13()),
         _write(tmp_path, "BENCH_r14.json",
-               _r13(**_mesh_fields(rps=2500.0),
+               _r14(**_mesh_fields(rps=2500.0),
                     degraded="accelerator unavailable: probe timeout")),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "fail"
     assert any("mesh tier regressed" in r for r in verdict["reasons"])
+
+
+# -- bucketed step collectives (ISSUE 12) ------------------------------------
+
+
+def _step_fields(rps=58000.0, mono=52000.0, overlap=0.41, **extra):
+    fields = {"step_rows_per_sec": rps,
+              "step_rows_per_sec_monolithic": mono,
+              "allreduce_overlap_frac": overlap,
+              "step_output_equality": "pass",
+              "step_platform": "cpu", "step_devices": 8,
+              "step_model": "mlp_h128x6", "step_batch_size": 512,
+              "step_bucket_mb": 0.095, "step_grad_mb": 0.38,
+              "step_n_buckets": 6, "step_steps": 8}
+    fields.update(extra)
+    return fields
+
+
+def _r14(**extra):
+    """A round-14-complete primary half: r13 + the step-collectives A/B."""
+    half = _r13(**_step_fields())
+    half.update(extra)
+    return half
+
+
+def test_step_field_required_on_primary_from_round_14(tmp_path):
+    # round 13: grandfathered — no step A/B owed
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r13.json", _r13())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # round 14+: the primary must carry it (or explicit null + reason)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r14.json", _r13())])
+    assert verdict["verdict"] == "fail"
+    assert any("step_rows_per_sec" in r for r in verdict["reasons"])
+    # complete round 14 passes
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r14.json", _r14())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # explicit null + reason satisfies (this 1-core box: single device,
+    # no cross-replica exchange to bucket)
+    half = _r13(step_rows_per_sec=None,
+                step_reason="single device: no cross-replica gradient "
+                            "exchange to bucket or overlap")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r14.json", half)])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # bare null does not
+    half = _r13(step_rows_per_sec=None)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r14.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("step_reason" in r for r in verdict["reasons"])
+
+
+def test_step_output_equality_failed_fails_artifact(tmp_path):
+    """A bucketed step whose losses diverged from the monolithic step is
+    broken, not fast — even though it stamps null throughput + reason,
+    the artifact must FAIL, not pass as a legitimate null."""
+    half = _r13(step_rows_per_sec=None,
+                step_output_equality="fail",
+                step_reason="bucketed step diverged from the monolithic "
+                            "step: throughput not stamped")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r14.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("broken, not fast" in r for r in verdict["reasons"])
+    # numeric throughput without ANY equality verdict is also unverified
+    half = _r14()
+    del half["step_output_equality"]
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r14.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("step_output_equality" in r for r in verdict["reasons"])
+
+
+def test_step_value_without_config_identity_fails(tmp_path):
+    half = _r14()
+    del half["step_devices"]  # the all-reduce world: part of identity
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r14.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("config identity" in r and "step_devices" in r
+               for r in verdict["reasons"])
+
+
+def test_step_value_without_monolithic_partner_fails(tmp_path):
+    half = _r14()
+    del half["step_rows_per_sec_monolithic"]
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r14.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("step_rows_per_sec_monolithic" in r
+               for r in verdict["reasons"])
+
+
+def test_step_overlap_frac_range_and_null_reason(tmp_path):
+    # overlap outside [-1, 1] is a unit mistake
+    verdict = bench_gate.gate(
+        [_write(tmp_path, "BENCH_r14.json",
+                _r14(allreduce_overlap_frac=3.0))])
+    assert verdict["verdict"] == "fail"
+    assert any("not a fraction" in r for r in verdict["reasons"])
+    # null overlap with a reason is legitimate (ICI unmeasurable) even
+    # when the throughput A/B itself is numeric
+    half = _r14(allreduce_overlap_frac=None,
+                allreduce_overlap_reason="delivered ICI bandwidth "
+                                         "unmeasurable: probe dominated "
+                                         "by dispatch overhead")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r14.json", half)])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # bare null overlap does not satisfy
+    half = _r14(allreduce_overlap_frac=None)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r14.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("allreduce_overlap_reason" in r for r in verdict["reasons"])
+
+
+def test_step_regression_within_device_count_identity_only(tmp_path):
+    # same identity: a halved bucketed throughput is a regression
+    paths = [
+        _write(tmp_path, "BENCH_r14.json", _r14()),
+        _write(tmp_path, "BENCH_r15.json",
+               _r14(**_step_fields(rps=20000.0))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("step path regressed" in r for r in verdict["reasons"])
+    # a different device count is a different experiment — no comparison
+    # in either direction (like mesh_host_cpus in r13)
+    paths = [
+        _write(tmp_path, "BENCH_r14.json", _r14()),
+        _write(tmp_path, "BENCH_r15.json",
+               _r14(**_step_fields(rps=20000.0, step_devices=2))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass", verdict["reasons"]

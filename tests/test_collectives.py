@@ -1,0 +1,401 @@
+"""Bucketed, overlapped gradient collectives (``parallel/collectives.py``):
+partitioner units, bucketed-vs-monolithic numerical equivalence across mesh
+layouts, opt-outs, and the trainer/elastic composition — on the virtual
+8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu.parallel import (
+    MeshConfig,
+    apply_zero_sharding,
+    build_mesh,
+    collectives,
+    create_train_state,
+    ideal_serial_allreduce_seconds,
+    infer_param_sharding,
+    make_bucketed_train_step,
+    make_train_step,
+    partition_buckets,
+    shard_batch,
+)
+
+TOL = dict(rtol=5e-5, atol=1e-7)  # the test_parallel f32 tolerances
+
+
+class _Leaf:
+    """Fake leaf with a size/dtype for partitioner units (no device)."""
+
+    def __init__(self, nbytes):
+        self.size = nbytes // 4
+        self.dtype = np.dtype(np.float32)
+
+
+# -- partitioner units --------------------------------------------------------
+
+
+def test_partition_oversize_leaf_stands_alone():
+    kb = 1024
+    leaves = [_Leaf(2 * kb), _Leaf(100 * kb), _Leaf(2 * kb)]
+    buckets = partition_buckets(leaves, bucket_bytes=10 * kb)
+    assert buckets == [[0], [1], [2]]
+    # oversize leaves are never split, even back to back
+    buckets = partition_buckets([_Leaf(100 * kb), _Leaf(100 * kb)],
+                                bucket_bytes=10 * kb)
+    assert buckets == [[0], [1]]
+
+
+def test_partition_coalesces_small_leaves():
+    kb = 1024
+    leaves = [_Leaf(3 * kb)] * 5
+    buckets = partition_buckets(leaves, bucket_bytes=10 * kb)
+    assert buckets == [[0, 1, 2], [3, 4]]
+    # an oversize leaf mid-stream flushes the open bucket
+    leaves = [_Leaf(3 * kb), _Leaf(100 * kb), _Leaf(3 * kb), _Leaf(3 * kb)]
+    assert partition_buckets(leaves, 10 * kb) == [[0], [1], [2, 3]]
+
+
+def test_partition_deterministic_and_total():
+    rng = np.random.RandomState(0)
+    leaves = [_Leaf(int(rng.randint(1, 64)) * 1024) for _ in range(40)]
+    a = partition_buckets(leaves, 64 * 1024)
+    b = partition_buckets(leaves, 64 * 1024)
+    assert a == b  # pure function of order + sizes
+    flat = [i for bucket in a for i in bucket]
+    assert flat == list(range(len(leaves)))  # total, in flatten order
+
+
+def test_bucket_bytes_default_env_override(monkeypatch):
+    monkeypatch.setenv("TFOS_ALLREDUCE_BUCKET_MB", "2.5")
+    assert collectives.bucket_bytes_default() == int(2.5 * 1024 * 1024)
+    monkeypatch.setenv("TFOS_ALLREDUCE_BUCKET_MB", "garbage")
+    assert collectives.bucket_bytes_default() == int(
+        collectives.DEFAULT_BUCKET_MB * 1024 * 1024)
+
+
+# -- eligibility / opt-out ----------------------------------------------------
+
+
+def test_model_parallel_meshes_keep_monolithic_step():
+    for mc, axis in ((MeshConfig(dp=4, tp=2), "tp"),
+                     (MeshConfig(dp=4, sp=2), "sp"),
+                     (MeshConfig(dp=4, pp=2), "pp"),
+                     (MeshConfig(dp=4, ep=2), "ep")):
+        ok, reason = collectives.mesh_eligibility(build_mesh(mc))
+        assert not ok and axis in reason, (mc, reason)
+    ok, reason = collectives.mesh_eligibility(build_mesh(MeshConfig(dp=8)))
+    assert ok
+    ok, reason = collectives.mesh_eligibility(
+        build_mesh(MeshConfig(dp=2, fsdp=4)))
+    assert ok
+
+
+def test_env_opt_out_and_force(monkeypatch):
+    mesh = build_mesh(MeshConfig(dp=8))
+    state, opt, shardings, loss_fn, batch = _toy_setup(mesh)
+    monkeypatch.setenv("TFOS_BUCKETED_ALLREDUCE", "0")
+    step = make_train_step(loss_fn, opt, mesh, shardings, state, batch)
+    assert step.bucketed is False
+    monkeypatch.delenv("TFOS_BUCKETED_ALLREDUCE")
+    step = make_train_step(loss_fn, opt, mesh, shardings, state, batch)
+    assert step.bucketed is True and step.n_buckets >= 1
+    # forcing bucketed on an ineligible mesh names the reason
+    mesh_tp = build_mesh(MeshConfig(dp=4, tp=2))
+    state, opt, shardings, loss_fn, batch = _toy_setup(mesh_tp)
+    with pytest.raises(ValueError, match="tp"):
+        make_train_step(loss_fn, opt, mesh_tp, shardings, state, batch,
+                        bucketed=True)
+
+
+def test_single_data_shard_keeps_monolithic_step():
+    mesh = build_mesh(MeshConfig(dp=1, tp=1), devices=jax.devices()[:1])
+    ok, reason = collectives.mesh_eligibility(mesh)
+    assert not ok and "single data shard" in reason
+
+
+# -- numerical equivalence ----------------------------------------------------
+
+
+def _toy_setup(mesh, zero=False, stateful=False, n_leaves=6):
+    """Toy multi-leaf model so the bucket partitioner has real work."""
+    import optax
+
+    rng = np.random.RandomState(0)
+    params = {"emb": jnp.asarray(rng.randn(16, 8) * 0.1, jnp.float32)}
+    for i in range(n_leaves - 2):
+        params[f"w{i}"] = jnp.asarray(rng.randn(8, 8) * 0.3, jnp.float32)
+    params["head"] = jnp.asarray(rng.randn(8, 4) * 0.3, jnp.float32)
+    optimizer = optax.adamw(5e-2)
+    cols = ({"stats": {"mean": jnp.zeros((8,), jnp.float32),
+                       "count": jnp.zeros((), jnp.int32)}}
+            if stateful else None)
+    state = create_train_state(params, optimizer, cols)
+    shardings = infer_param_sharding(params, mesh, min_dim=1)
+    if zero:
+        shardings = apply_zero_sharding(shardings, mesh, params, min_size=1)
+
+    n_body = n_leaves - 2
+
+    if stateful:
+        # BatchNorm-style stateful loss: normalization reads the RUNNING
+        # statistics collection, whose update is the batch mean of the
+        # activations — the linear statistic the bucketed step's
+        # cross-replica pmean reproduces exactly
+        def loss_fn(p, c, batch):
+            h = p["emb"][batch["ids"]]
+            for i in range(n_body):
+                h = jnp.tanh(h @ p[f"w{i}"])
+            h = h - c["stats"]["mean"]
+            pred = h @ p["head"]
+            new = {"stats": {
+                "mean": 0.9 * c["stats"]["mean"]
+                + 0.1 * jnp.mean(h, axis=0),
+                "count": c["stats"]["count"] + 1}}
+            return jnp.mean((pred - batch["y"]) ** 2), new
+
+        loss_fn.stateful = True
+    else:
+        def loss_fn(p, batch):
+            h = p["emb"][batch["ids"]]
+            for i in range(n_body):
+                h = jnp.tanh(h @ p[f"w{i}"])
+            pred = h @ p["head"]
+            return jnp.mean((pred - batch["y"]) ** 2)
+
+    batch = {"ids": rng.randint(0, 16, (16,)).astype(np.int32),
+             "y": rng.randn(16, 4).astype(np.float32)}
+    return state, optimizer, shardings, loss_fn, batch
+
+
+def _assert_steps_match(mesh, zero=False, stateful=False, steps=5,
+                        bucket_bytes=200):
+    state_m, opt, shardings, loss_fn, batch = _toy_setup(
+        mesh, zero=zero, stateful=stateful)
+    state_b, *_ = _toy_setup(mesh, zero=zero, stateful=stateful)
+    mono = make_train_step(loss_fn, opt, mesh, shardings, state_m, batch,
+                           bucketed=False)
+    buck = make_bucketed_train_step(loss_fn, opt, mesh, shardings, state_b,
+                                    batch, bucket_bytes=bucket_bytes)
+    assert buck.bucketed and buck.n_buckets > 1  # a real multi-bucket plan
+    sharded = shard_batch(mesh, batch)
+    for _ in range(steps):
+        state_m, loss_m = mono(state_m, sharded)
+        state_b, loss_b = buck(state_b, sharded)
+        np.testing.assert_allclose(float(loss_m), float(loss_b), **TOL)
+    for key in state_m.params:
+        np.testing.assert_allclose(np.asarray(state_m.params[key]),
+                                   np.asarray(state_b.params[key]),
+                                   err_msg=key, **TOL)
+    if stateful:
+        np.testing.assert_allclose(
+            np.asarray(state_m.collections["stats"]["mean"]),
+            np.asarray(state_b.collections["stats"]["mean"]), **TOL)
+        assert int(state_b.collections["stats"]["count"]) == steps
+    return state_b
+
+
+def test_bucketed_matches_monolithic_dp_only():
+    _assert_steps_match(build_mesh(MeshConfig(dp=8)))
+
+
+def test_bucketed_matches_monolithic_dp_fsdp_zero():
+    state = _assert_steps_match(build_mesh(MeshConfig(dp=2, fsdp=4)),
+                                zero=True)
+    # ZeRO storage sharding survives the bucketed step
+    assert any(jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+        lambda p: "fsdp" in str(p.sharding.spec), state.params)))
+
+
+def test_bucketed_matches_monolithic_stateful_batchnorm():
+    _assert_steps_match(build_mesh(MeshConfig(dp=8)), stateful=True)
+
+
+def test_bucketed_matches_monolithic_stateful_zero():
+    _assert_steps_match(build_mesh(MeshConfig(dp=4, fsdp=2)), zero=True,
+                        stateful=True)
+
+
+def test_bucketed_step_emits_one_collective_per_bucket():
+    """The structural claim itself: the lowered HLO carries one explicit
+    all-reduce per gradient bucket (plus the scalar loss pmean), instead
+    of whatever the GSPMD combiner felt like."""
+    mesh = build_mesh(MeshConfig(dp=8))
+    state, opt, shardings, loss_fn, batch = _toy_setup(mesh)
+    buck = make_bucketed_train_step(loss_fn, opt, mesh, shardings, state,
+                                    batch, bucket_bytes=200)
+    hlo = buck.lower(state, shard_batch(mesh, batch)).compile().as_text()
+    n_allreduce = hlo.count("all-reduce(") + hlo.count("all-reduce-start(")
+    assert n_allreduce == buck.n_buckets + 1, (n_allreduce, buck.n_buckets)
+
+
+def test_no_reduce_twin_diverges():
+    """The bench's compute-only twin must really skip the gradient
+    exchange (otherwise the exposed-comm subtraction measures nothing)."""
+    mesh = build_mesh(MeshConfig(dp=8))
+    state, opt, shardings, loss_fn, batch = _toy_setup(mesh)
+    state2, *_ = _toy_setup(mesh)
+    buck = make_bucketed_train_step(loss_fn, opt, mesh, shardings, state,
+                                    batch, bucket_bytes=200)
+    nored = make_bucketed_train_step(loss_fn, opt, mesh, shardings, state2,
+                                     batch, bucket_bytes=200, reduce=False)
+    hlo_b = buck.lower(state, shard_batch(mesh, batch)).compile().as_text()
+    hlo_n = nored.lower(state2,
+                        shard_batch(mesh, batch)).compile().as_text()
+    count = lambda h: h.count("all-reduce(") + h.count("all-reduce-start(")  # noqa: E731
+    assert count(hlo_n) < count(hlo_b)
+
+
+def test_indivisible_batch_fails_like_monolithic():
+    """Batch-leading-dim divisibility by the data world is a PRE-EXISTING
+    repo constraint (device_put with a NamedSharding enforces it before
+    either step runs); the bucketed step must not change that contract in
+    either direction."""
+    mesh = build_mesh(MeshConfig(dp=8))
+    state_m, opt, shardings, loss_fn, batch = _toy_setup(mesh)
+    state_b, *_ = _toy_setup(mesh)
+    short = {"ids": batch["ids"][:12], "y": batch["y"][:12]}  # 12 % 8 != 0
+    mono = make_train_step(loss_fn, opt, mesh, shardings, state_m, batch,
+                           bucketed=False)
+    buck = make_bucketed_train_step(loss_fn, opt, mesh, shardings, state_b,
+                                    batch, bucket_bytes=200)
+    for step, state in ((mono, state_m), (buck, state_b)):
+        with pytest.raises(ValueError):
+            step(state, shard_batch(mesh, short))
+
+
+# -- comm model ---------------------------------------------------------------
+
+
+def test_ideal_serial_allreduce_seconds():
+    # 8 devices, 100 MB grads, 10 GB/s delivered: 2*S*(n-1)/n / bw
+    s = ideal_serial_allreduce_seconds(100e6, 8, 10.0)
+    np.testing.assert_allclose(s, 2 * 100e6 * 7 / 8 / 10e9)
+    assert ideal_serial_allreduce_seconds(100e6, 1, 10.0) is None
+    assert ideal_serial_allreduce_seconds(100e6, 8, None) is None
+    assert ideal_serial_allreduce_seconds(0, 8, 10.0) is None
+
+
+def test_flight_allreduce_stage_classifies_comm_bound():
+    from tensorflowonspark_tpu.obs import flight
+
+    assert flight.classify({"allreduce": 0.8, "compute": 0.1}) == \
+        "comm_bound"
+    assert "comm_bound" in flight.VERDICTS
+
+
+def test_trainer_allreduce_attribution_is_context_not_verdict():
+    """The trainer's modelled comm cost rides as an overlapped (_bg)
+    stage on BOTH step paths: an upper bound on exposed comm must not
+    name the bottleneck, so verdicts stay e.g. device_bound even when
+    the model dwarfs the wall (the measured comm_bound verdict is the
+    bench A/B's job)."""
+    from tensorflowonspark_tpu import obs
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    # an absurdly slow "delivered" bandwidth: the modelled cost would
+    # dominate any additive record it were allowed into
+    obs.gauge("roofline_ici_bw_gbps").set(1e-6)
+    try:
+        batch_kw = {}
+        for timeout, tag in ((None, "async"), (60.0, "watchdogged")):
+            t = Trainer("mnist_mlp", mesh_config=MeshConfig(dp=8),
+                        step_timeout_s=timeout, **batch_kw)
+            assert t.train_step.bucketed is True
+            t._flight.reset()
+            batch = t.module_lib.example_batch(t.config, batch_size=16)
+            for _ in range(2):
+                t.step(batch)
+            snap = t._flight.snapshot()
+            assert "allreduce" in snap["overlapped_stages_s"], (tag, snap)
+            assert "allreduce" not in snap["stages_s"], (tag, snap)
+            assert snap["verdict"] != "comm_bound", (tag, snap)
+    finally:
+        obs.get_registry().remove("roofline_ici_bw_gbps")
+
+
+# -- trainer / elastic composition --------------------------------------------
+
+
+def test_trainer_uses_bucketed_step_by_default(monkeypatch):
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    t = Trainer("mnist_mlp", mesh_config=MeshConfig(dp=8))
+    assert getattr(t.train_step, "bucketed", False) is True
+    assert t.train_step.comm_bytes > 0
+    assert t.train_step.data_world == 8
+    batch = t.module_lib.example_batch(t.config, batch_size=16)
+    losses = [float(t.step(batch)) for _ in range(3)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    # env opt-out restores the monolithic step
+    monkeypatch.setenv("TFOS_BUCKETED_ALLREDUCE", "0")
+    t2 = Trainer("mnist_mlp", mesh_config=MeshConfig(dp=8))
+    assert getattr(t2.train_step, "bucketed", True) is False
+
+
+def test_trainer_widedeep_custom_step_keeps_its_own_path():
+    """A model-prescribed sharded step (wide&deep's sparse embedding
+    update) opts out of the generic dispatch entirely."""
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    t = Trainer("wide_deep", mesh_config=MeshConfig(dp=8))
+    assert getattr(t.train_step, "bucketed", False) is False
+    batch = t.module_lib.example_batch(t.config, batch_size=16)
+    assert np.isfinite(float(t.step(batch)))
+
+
+def test_elastic_regroup_at_step_boundary_through_bucketed_step():
+    """``Trainer.attach_elastic``'s between-steps regroup check rides the
+    bucketed step unchanged: the step that observes the pending flag
+    completes (metrics + callbacks included) before RegroupSignal."""
+    from tensorflowonspark_tpu import elastic
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    t = Trainer("mnist_mlp", mesh_config=MeshConfig(dp=8))
+    assert t.train_step.bucketed is True
+
+    class _Worker:
+        pending = False
+
+        def regroup_pending(self):
+            return self.pending
+
+        def command(self):
+            return {"generation": 1, "reason": "test"}
+
+    worker = _Worker()
+    t.attach_elastic(worker)
+    batch = t.module_lib.example_batch(t.config, batch_size=16)
+    seen = []
+    t.add_step_callback(lambda loss, n, dt: seen.append(n))
+    assert np.isfinite(float(t.step(batch)))
+    worker.pending = True
+    with pytest.raises(elastic.RegroupSignal) as ei:
+        t.step(batch)
+    assert ei.value.command["generation"] == 1
+    assert len(seen) == 2  # the interrupted step's callbacks still ran
+
+
+def test_trainer_resnet_batchnorm_trains_through_bucketed_step():
+    """Real flax BatchNorm (train-mode batch stats) composes with the
+    bucketed step: per-replica statistics with cross-replica-averaged
+    running stats — the DDP discipline — still trains to decreasing
+    loss, and the running stats still update."""
+    from tensorflowonspark_tpu.models import resnet
+    from tensorflowonspark_tpu.trainer import Trainer
+
+    config = resnet.Config.tiny(norm="batch")
+    t = Trainer("resnet50", config=config, mesh_config=MeshConfig(dp=8),
+                learning_rate=1e-2)
+    assert t.train_step.bucketed is True
+    stats0 = jax.tree_util.tree_map(
+        np.asarray, t.state.collections["batch_stats"])
+    batch = t.module_lib.example_batch(config, batch_size=16)
+    losses = [float(t.step(batch)) for _ in range(4)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    changed = jax.tree_util.tree_map(
+        lambda a, b: not np.allclose(a, np.asarray(b)),
+        stats0, t.state.collections["batch_stats"])
+    assert any(jax.tree_util.tree_leaves(changed))

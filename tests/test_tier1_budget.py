@@ -1,0 +1,82 @@
+"""tools/tier1.py ``--budget``: the slowest-first budget planner that turns
+the 870 s tier-1 overrun into a visible, machine-readable split."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import tier1  # noqa: E402
+
+
+def _records(**wall):
+    return {name: {"wall_s": s, "rc": 0} for name, s in wall.items()}
+
+
+def test_plan_orders_slowest_first_and_reports_misfits():
+    records = _records(**{"tests/a.py": 100.0, "tests/b.py": 50.0,
+                          "tests/c.py": 30.0, "tests/d.py": 40.0})
+    run, not_fit, planned = tier1.plan_budget(
+        sorted(records), records, budget_s=150.0)
+    assert run == ["tests/a.py", "tests/b.py"]  # 100 + 50 fits exactly
+    assert not_fit == {"tests/d.py": 40.0, "tests/c.py": 30.0}
+    assert planned == 150.0
+
+
+def test_plan_admits_smaller_files_after_a_misfit():
+    """Slowest-first is a greedy fit, not a prefix cut: a file that does
+    not fit must not shadow smaller later files that still do."""
+    records = _records(**{"tests/big.py": 90.0, "tests/mid.py": 60.0,
+                          "tests/small.py": 5.0})
+    run, not_fit, planned = tier1.plan_budget(
+        sorted(records), records, budget_s=100.0)
+    assert run == ["tests/big.py", "tests/small.py"]
+    assert not_fit == {"tests/mid.py": 60.0}
+    assert planned == 95.0
+
+
+def test_plan_is_deterministic_with_ties():
+    records = _records(**{"tests/a.py": 10.0, "tests/b.py": 10.0,
+                          "tests/c.py": 10.0})
+    runs = {tuple(tier1.plan_budget(sorted(records), records, 20.0)[0])
+            for _ in range(5)}
+    assert runs == {("tests/a.py", "tests/b.py")}  # name-ordered tie-break
+
+
+def test_plan_admits_unknown_files_unconditionally():
+    """A file with no committed record is exactly the file whose cost the
+    database cannot predict — it must run so the NEXT plan can account
+    for it, and its zero estimate displaces nothing."""
+    records = _records(**{"tests/known.py": 100.0})
+    files = ["tests/known.py", "tests/new.py"]
+    run, not_fit, planned = tier1.plan_budget(files, records, budget_s=10.0)
+    assert "tests/new.py" in run
+    assert not_fit == {"tests/known.py": 100.0}
+    assert planned == 0.0
+
+
+def test_load_times_tolerates_missing_and_garbage(tmp_path):
+    assert tier1.load_times(str(tmp_path / "nope.json")) == {}
+    bad = tmp_path / "bad.json"
+    bad.write_text("not json")
+    assert tier1.load_times(str(bad)) == {}
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({"files": {"tests/x.py": {"wall_s": 3.0}}}))
+    assert tier1.load_times(str(good)) == {"tests/x.py": {"wall_s": 3.0}}
+
+
+def test_committed_times_cover_the_suite():
+    """The committed TIER1_TIMES.json must know (almost) every test file,
+    or budget mode plans blind; new files are admitted unconditionally so
+    a few unknowns are fine, a majority is a stale database."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    records = tier1.load_times(os.path.join(repo, "TIER1_TIMES.json"))
+    import glob
+
+    files = [os.path.relpath(p, repo)
+             for p in glob.glob(os.path.join(repo, "tests", "test_*.py"))]
+    known = [f for f in files if f in records]
+    assert len(known) >= len(files) * 0.6, (
+        f"TIER1_TIMES.json knows only {len(known)}/{len(files)} files")

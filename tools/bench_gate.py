@@ -68,6 +68,21 @@ scale past the cores the box has, so scale efficiency is only comparable
 at one CPU count), its ``mesh_scale_efficiency`` (mesh ÷ replicas ×
 single-process baseline), and a ``mesh_p99_ms`` within ``mesh_slo_ms``;
 healthy numbers are regression-compared only within one mesh geometry.
+From round ``--require-step-from`` (default 14, the round that introduced
+bucketed, overlapped gradient collectives on the train-step path) the
+primary half must carry ``step_rows_per_sec`` — the bucketed step's
+closed-loop training throughput, A/B'd against the monolithic step in the
+same run — or an explicit ``null`` + ``step_reason`` (a single-device box
+has no cross-replica exchange to bucket); a numeric value must ship its
+``step_rows_per_sec_monolithic`` partner, its config identity (platform,
+device count, model, batch, bucket_mb: a different device count is a
+different experiment, like ``mesh_host_cpus`` in r13), a
+``step_output_equality`` of ``"pass"`` (a bucketed step whose losses
+diverged from the monolithic step is broken, not fast — the artifact
+FAILS), and ``allreduce_overlap_frac`` as a fraction in [-1, 1] (or
+explicit ``null`` + ``allreduce_overlap_reason`` when the delivered ICI
+bandwidth is unmeasurable); healthy numbers are regression-compared only
+within one step config identity.
 
 Usage::
 
@@ -117,6 +132,10 @@ DEFAULT_REQUIRE_TRACE_FROM = 12
 #: first round whose primary half must carry the serving-mesh microbench
 #: (``mesh_rows_per_sec``, introduced with the multi-host serving mesh)
 DEFAULT_REQUIRE_MESH_FROM = 13
+#: first round whose primary half must carry the step-collectives A/B
+#: (``step_rows_per_sec``, introduced with bucketed, overlapped gradient
+#: collectives on the train-step path)
+DEFAULT_REQUIRE_STEP_FROM = 14
 #: |stage_sum / wall - 1| beyond this fails the artifact: a breakdown that
 #: does not add up is decoration, not attribution
 DEFAULT_FLIGHT_TOLERANCE = 0.15
@@ -136,6 +155,13 @@ _RECOVERY_IDENT_KEYS = ("recovery_num_executors",
 _ONLINE_KEY = "online_rows_per_sec"
 _TRACE_OVERHEAD_KEY = "trace_overhead_frac"
 _MESH_KEY = "mesh_rows_per_sec"
+_STEP_KEY = "step_rows_per_sec"
+#: the step-collectives A/B's config identity: bucketed-step rows/sec is
+#: only comparable at the same platform, DEVICE COUNT (the all-reduce
+#: world — a number with no interconnect to hide is a different
+#: experiment), model geometry, global batch and bucket size
+_STEP_IDENT_KEYS = ("step_platform", "step_devices", "step_model",
+                    "step_batch_size", "step_bucket_mb")
 #: the mesh microbench's config identity: aggregate rows/sec is only
 #: comparable at the same replica/client counts, request volume, model
 #: geometry, bucket ladder, SLO AND host CPU count — N processes cannot
@@ -271,7 +297,8 @@ def validate_half(half: dict[str, Any], *,
                   require_recovery: bool = False,
                   require_online: bool = False,
                   require_trace: bool = False,
-                  require_mesh: bool = False) -> list[str]:
+                  require_mesh: bool = False,
+                  require_step: bool = False) -> list[str]:
     """Schema problems of one measured result (a wrapper's half)."""
     problems = []
     for key in _REQUIRED_HALF_KEYS:
@@ -421,6 +448,63 @@ def validate_half(half: dict[str, Any], *,
                     f"mesh_p99_ms {p99} exceeds mesh_slo_ms {slo}: a "
                     "throughput claimed at an SLO it missed is not a "
                     "measurement")
+    # step-collectives A/B (bucketed gradient exchange): runs on the local
+    # device set, so a degraded-accelerator round still owes it (its CPU
+    # devices measured the same step structure); null + 'step_reason'
+    # always satisfies (a single-device box has nothing to bucket).  A
+    # numeric value must carry its monolithic A/B partner, its config
+    # identity, a PASSING output-equality check, and its overlap fraction
+    # (or that fraction's explicit null + reason)
+    if require_step or _STEP_KEY in half:
+        if half.get("step_output_equality") == "fail":
+            # judged FIRST: a diverged bucketed step also stamps null
+            # throughput + reason, and that legitimate-looking null must
+            # not launder a broken step into a passing artifact
+            problems.append(
+                "step_output_equality is 'fail': the bucketed step "
+                "produced different losses than the monolithic step — "
+                "broken, not fast; the artifact fails")
+        if _STEP_KEY not in half:
+            problems.append(
+                f"missing {_STEP_KEY!r} (step-collectives A/B is part of "
+                "the schema from r14: measure it or stamp an explicit "
+                "null + 'step_reason')")
+        elif half[_STEP_KEY] is None and "step_reason" not in half:
+            problems.append(
+                f"{_STEP_KEY!r} is null without a 'step_reason'")
+        elif isinstance(half.get(_STEP_KEY), (int, float)):
+            missing = [k for k in _STEP_IDENT_KEYS if k not in half]
+            if missing:
+                problems.append(
+                    f"{_STEP_KEY!r} without its config identity "
+                    f"({', '.join(missing)}) — bucketed-step rows/sec is "
+                    "only comparable within one platform/device-count/"
+                    "model/batch/bucket config")
+            if not isinstance(half.get("step_rows_per_sec_monolithic"),
+                              (int, float)):
+                problems.append(
+                    f"{_STEP_KEY!r} without a numeric "
+                    "'step_rows_per_sec_monolithic' — the bucketed number "
+                    "is only meaningful against the monolithic step "
+                    "A/B'd in the same run")
+            if half.get("step_output_equality") != "pass":
+                problems.append(
+                    "step_output_equality is "
+                    f"{half.get('step_output_equality')!r}: a bucketed "
+                    "step whose losses were not verified equal to the "
+                    "monolithic step's is broken, not fast")
+            ovf = half.get("allreduce_overlap_frac")
+            if ovf is None:
+                if "allreduce_overlap_reason" not in half:
+                    problems.append(
+                        "'allreduce_overlap_frac' is null without an "
+                        "'allreduce_overlap_reason'")
+            elif not isinstance(ovf, (int, float)) \
+                    or not -1.0 <= ovf <= 1.0:
+                problems.append(
+                    f"'allreduce_overlap_frac' {ovf!r} is not a fraction "
+                    "in [-1, 1] — it is 1 - exposed/ideal-serial comm "
+                    "time")
     # request-tracing overhead: A/B-measured on the online path, so a
     # degraded-accelerator round still owes it; null + reason always
     # satisfies (e.g. TFOS_TRACE_REQUESTS=0 runs have no A to B against)
@@ -518,6 +602,16 @@ def _comparable_prior_mesh(artifacts: list[dict], newest: dict,
                                       _MESH_KEY, _MESH_IDENT_KEYS)
 
 
+def _comparable_prior_step(artifacts: list[dict], newest: dict,
+                           half: dict) -> tuple[float, str] | None:
+    """Best prior ``step_rows_per_sec`` under the same platform, device
+    count, model geometry, batch and bucket size (``_STEP_IDENT_KEYS``).
+    Judged like the other microbenches even on degraded rounds: the local
+    device set measured the same step structure."""
+    return _comparable_prior_hostside(artifacts, newest, half,
+                                      _STEP_KEY, _STEP_IDENT_KEYS)
+
+
 def _comparable_prior_recovery(artifacts: list[dict], newest: dict,
                                half: dict) -> tuple[float, str] | None:
     """Best (i.e. LOWEST — recovery is a latency) prior
@@ -564,7 +658,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
          require_recovery_from: int = DEFAULT_REQUIRE_RECOVERY_FROM,
          require_online_from: int = DEFAULT_REQUIRE_ONLINE_FROM,
          require_trace_from: int = DEFAULT_REQUIRE_TRACE_FROM,
-         require_mesh_from: int = DEFAULT_REQUIRE_MESH_FROM
+         require_mesh_from: int = DEFAULT_REQUIRE_MESH_FROM,
+         require_step_from: int = DEFAULT_REQUIRE_STEP_FROM
          ) -> dict[str, Any]:
     """Run the gate over a trajectory; returns the verdict document."""
     checks: list[dict[str, Any]] = []
@@ -612,13 +707,16 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                           and art["n"] >= require_trace_from)
             require_ms = (label == "primary"
                           and art["n"] >= require_mesh_from)
+            require_st = (label == "primary"
+                          and art["n"] >= require_step_from)
             for problem in validate_half(half, require_roofline=require_rf,
                                          require_feed=require_fd,
                                          require_serving=require_sv,
                                          require_recovery=require_rc,
                                          require_online=require_on,
                                          require_trace=require_tr,
-                                         require_mesh=require_ms):
+                                         require_mesh=require_ms,
+                                         require_step=require_st):
                 check(f"schema:{name}:{label}",
                       "fail" if is_newest else "warn", problem)
             # flight breakdowns ride the primary half with the microbench
@@ -720,6 +818,28 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                           f"{mval} is {round(mval / mprior[0], 4)}× best "
                           f"prior {mprior[0]} ({mprior[1]}) — the mesh "
                           f"tier regressed below {threshold}")
+            # step-collectives A/B: judged before the degraded skip like
+            # the others (the local device set measured the same step
+            # structure either way)
+            if isinstance(half.get(_STEP_KEY), (int, float)):
+                stprior = _comparable_prior_step(artifacts, newest, half)
+                stname = f"regression:{_STEP_KEY}"
+                stval = float(half[_STEP_KEY])
+                if stprior is None:
+                    check(stname, "pass",
+                          "no comparable prior step measurement (same "
+                          "platform + device count + geometry + bucket) "
+                          "— nothing to regress against")
+                elif stval >= threshold * stprior[0]:
+                    check(stname, "pass",
+                          f"{stval} vs best prior {stprior[0]} "
+                          f"({stprior[1]}): ratio "
+                          f"{round(stval / stprior[0], 4)} ≥ {threshold}")
+                else:
+                    check(stname, "fail",
+                          f"{stval} is {round(stval / stprior[0], 4)}× "
+                          f"best prior {stprior[0]} ({stprior[1]}) — the "
+                          f"step path regressed below {threshold}")
             # recovery microbench: host-side, judged before the degraded
             # skip too.  LOWER is better (it is a latency): the newest run
             # fails when it exceeds the best comparable prior by more than
@@ -830,6 +950,8 @@ def main(argv: list[str] | None = None) -> int:
                    default=DEFAULT_REQUIRE_TRACE_FROM)
     p.add_argument("--require-mesh-from", type=int,
                    default=DEFAULT_REQUIRE_MESH_FROM)
+    p.add_argument("--require-step-from", type=int,
+                   default=DEFAULT_REQUIRE_STEP_FROM)
     args = p.parse_args(argv)
     paths = args.paths or discover(args.repo)
     if not paths:
@@ -846,7 +968,8 @@ def main(argv: list[str] | None = None) -> int:
                require_recovery_from=args.require_recovery_from,
                require_online_from=args.require_online_from,
                require_trace_from=args.require_trace_from,
-               require_mesh_from=args.require_mesh_from)
+               require_mesh_from=args.require_mesh_from,
+               require_step_from=args.require_step_from)
     print(json.dumps(doc))
     return 1 if doc["verdict"] == "fail" else 0
 

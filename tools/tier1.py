@@ -15,11 +15,29 @@ Usage::
     python tools/tier1.py                    # all tests/test_*.py
     python tools/tier1.py tests/test_shm.py  # a subset
     python tools/tier1.py --timeout 300      # per-FILE timeout (default 600)
+    python tools/tier1.py --budget 870       # fit a wall-clock budget
+
+``--budget <seconds>`` turns the known-debt 870 s overrun on this box into
+a visible, machine-readable split instead of a blanket rc=124: files are
+ordered slowest-first by their committed ``TIER1_TIMES.json`` wall times
+(files with no record are admitted unconditionally — they are exactly the
+files the committed times cannot predict), admitted greedily while the
+estimated total fits the budget, and every file that did NOT fit is
+reported — on stdout and under ``"not_run"`` in the JSON.  Records for
+not-run files are carried over from the existing JSON so the timing
+database stays total.
 
 Exit code: 0 when every file passed, 1 when any failed/timed out, 2 on
-usage error.  The JSON schema::
+usage error.  (A file that did not fit the budget is reported, not
+failed — the split is the information.)  The JSON schema::
 
     {"generated_at": iso8601, "total_s": float, "python": "...",
+     "files_wall_s_sum": float,        # merged whole-suite estimate —
+                                       # size budgets from THIS, not
+                                       # total_s (a partial run's wall)
+     "ran_files": [...],               # which records this run refreshed
+     "budget_s": float | absent, "planned_s": float | absent,
+     "not_run": {"tests/test_x.py": estimated_wall_s} | absent,
      "files": {"tests/test_x.py": {"wall_s": float, "rc": int,
                "passed": int, "failed": int, "errors": int,
                "skipped": int, "timeout": bool}}}
@@ -105,12 +123,68 @@ def run_file(path: str, timeout_s: float) -> dict:
     return record
 
 
+def load_times(path: str) -> dict[str, dict]:
+    """Per-file records from a committed ``TIER1_TIMES.json`` (empty when
+    missing/unreadable — budget mode then admits everything)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        files = doc.get("files")
+        return files if isinstance(files, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def plan_budget(files: list[str], records: dict[str, dict],
+                budget_s: float) -> tuple[list[str], dict[str, float],
+                                          float]:
+    """Slowest-first budget plan over committed wall times.
+
+    Returns ``(run, not_fit, planned_s)``: ``run`` is the admitted files
+    in execution (slowest-first) order, ``not_fit`` maps each skipped
+    file to the estimated wall time that did not fit, ``planned_s`` is
+    the estimated cost of the admitted set.  Deterministic: a pure
+    function of the file list and the committed estimates (name-ordered
+    tie-break), so the same commit always plans the same split.
+
+    Files without a committed record estimate 0 — always admitted, run
+    where their (unknown) cost displaces nothing in the plan: they are
+    precisely the files whose cost must be measured before the NEXT plan
+    can account for them.
+    """
+
+    def est(rel: str) -> float:
+        rec = records.get(rel) or {}
+        try:
+            return float(rec.get("wall_s") or 0.0)
+        except (TypeError, ValueError):
+            return 0.0
+
+    ordered = sorted(files, key=lambda rel: (-est(rel), rel))
+    run: list[str] = []
+    not_fit: dict[str, float] = {}
+    planned = 0.0
+    for rel in ordered:
+        cost = est(rel)
+        if planned + cost <= budget_s:
+            run.append(rel)
+            planned += cost
+        else:
+            not_fit[rel] = cost
+    return run, not_fit, planned
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("files", nargs="*",
                    help="test files (default: tests/test_*.py)")
     p.add_argument("--timeout", type=float, default=600.0,
                    help="per-file timeout in seconds (default 600)")
+    p.add_argument("--budget", type=float, default=None,
+                   help="wall-clock budget in seconds: run slowest-first "
+                        "by committed TIER1_TIMES.json estimates, report "
+                        "the files that did not fit instead of timing out "
+                        "the whole suite")
     p.add_argument("--out", default=os.path.join(REPO, "TIER1_TIMES.json"))
     args = p.parse_args(argv)
     files = args.files or sorted(
@@ -118,12 +192,33 @@ def main(argv: list[str] | None = None) -> int:
     if not files:
         print("tier1: no test files found", file=sys.stderr)
         return 2
+    if args.budget is not None and args.budget <= 0:
+        print("tier1: --budget must be positive", file=sys.stderr)
+        return 2
+
+    def _rel(path: str) -> str:
+        """Repo-relative key for a (possibly relative) CLI path — the one
+        normalization used by planning, running and the JSON records."""
+        if not os.path.isabs(path):
+            path = os.path.join(REPO, path)
+        return os.path.relpath(path, REPO)
+
+    prior = load_times(args.out)
+    not_fit: dict[str, float] = {}
+    planned_s = 0.0
+    if args.budget is not None:
+        rels = [_rel(path) for path in files]
+        run_rels, not_fit, planned_s = plan_budget(rels, prior, args.budget)
+        files = run_rels
+        print(f"tier1: budget {args.budget:.0f}s fits {len(files)} of "
+              f"{len(rels)} files (estimated {planned_s:.0f}s); "
+              f"{len(not_fit)} did not fit", flush=True)
 
     records: dict[str, dict] = {}
     t0 = time.perf_counter()
     for path in files:
-        rel = os.path.relpath(path, REPO)
-        record = run_file(path, args.timeout)
+        rel = _rel(path)
+        record = run_file(os.path.join(REPO, rel), args.timeout)
         records[rel] = record
         status = ("TIMEOUT" if record["timeout"]
                   else "ok" if record["rc"] == 0 else f"rc={record['rc']}")
@@ -132,13 +227,31 @@ def main(argv: list[str] | None = None) -> int:
               f"{rel}", flush=True)
     total = time.perf_counter() - t0
 
+    # the timing database stays total: files not run this invocation
+    # (budget split or explicit subset) keep their committed records.  A
+    # full unbudgeted run still rewrites from scratch so deleted test
+    # files don't leave immortal stale entries
+    partial = args.budget is not None or bool(args.files)
+    merged = dict(prior) if partial else {}
+    merged.update(records)
     doc = {
         "generated_at": datetime.datetime.now(
             datetime.timezone.utc).isoformat(timespec="seconds"),
+        # this invocation's wall only — after a partial (subset/budget)
+        # run the files map also carries merged prior records, so budget
+        # sizing must use files_wall_s_sum, the whole-suite estimate
         "total_s": round(total, 1),
+        "files_wall_s_sum": round(sum(
+            float(r.get("wall_s") or 0.0) for r in merged.values()), 1),
+        "ran_files": sorted(records),
         "python": sys.version.split()[0],
-        "files": records,
+        "files": merged,
     }
+    if args.budget is not None:
+        doc["budget_s"] = args.budget
+        doc["planned_s"] = round(planned_s, 1)
+        doc["not_run"] = {rel: round(est, 1)
+                          for rel, est in sorted(not_fit.items())}
     with open(args.out, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)
         f.write("\n")
@@ -149,6 +262,11 @@ def main(argv: list[str] | None = None) -> int:
     print("slowest:")
     for rel, r in slowest:
         print(f"  {r['wall_s']:8.1f}s  {rel}")
+    if not_fit:
+        print(f"did not fit the {args.budget:.0f}s budget "
+              f"({sum(not_fit.values()):.0f}s estimated):")
+        for rel, est in sorted(not_fit.items(), key=lambda kv: -kv[1]):
+            print(f"  {est:8.1f}s  {rel}")
     failed = [rel for rel, r in records.items() if r["rc"] != 0]
     if failed:
         print(f"failing files: {failed}", file=sys.stderr)
