@@ -273,9 +273,18 @@ def _parse_args(argv=None):
                         "first post-restore step, through the real elastic "
                         "regroup + checkpoint-restore path (host-side, "
                         "local substrate)")
+    p.add_argument("--compile-cache", action="store_true",
+                   help="measure second-process cold start A/B'd against "
+                        "the persistent compile cache: spawn a fresh "
+                        "process, load + warm the same tenant/ladder "
+                        "through the real OnlineServer path, time to "
+                        "first served request — once reading a seeded "
+                        "TFOS_COMPILE_CACHE_DIR and once cache-off "
+                        "(host-side, CPU children)")
     p.add_argument("--_measure", action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--_probe", action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--_force-cpu", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--_coldstart", default=None, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
     if args.feed and args.model is not None:
         p.error("--feed measures the resnet50 input pipeline; "
@@ -2165,6 +2174,259 @@ def measure_step_collectives(steps: int = 8, batch_per_device: int = 64,
     return out
 
 
+def _coldstart_child(cfg_path: str) -> None:
+    """Child half of ``measure_compile_cache``: ONE fleet cold start.
+
+    Timed from handler entry (before any jax / framework import — those
+    ARE the cold start) through the REAL tenant load path — ``ckpt`` +
+    serialized-forward restore via ``pipeline._RunModel._load``,
+    ``OnlineServer.add_tenant(warmup=True)`` warming every ladder bucket
+    (``compile_cache.ensure()`` runs inside, so the warm compiles
+    read/write the configured cache), server start, one submitted request
+    served — and reported as ONE JSON line.  The parent controls the
+    cache arm via the config's ``cache_dir`` (null = cache off)."""
+    t0 = time.perf_counter()
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    if cfg.get("cache_dir"):
+        os.environ["TFOS_COMPILE_CACHE_DIR"] = cfg["cache_dir"]
+    else:
+        os.environ.pop("TFOS_COMPILE_CACHE_DIR", None)
+    import numpy as np
+
+    from tensorflowonspark_tpu import compile_cache, obs, online
+
+    srv = online.OnlineServer()
+    try:
+        srv.add_tenant(
+            "coldstart", export_dir=cfg["export_dir"],
+            batch_size=int(cfg["batch_size"]),
+            bucket_sizes=list(cfg["bucket_sizes"]),
+            input_mapping={"features": "features"}, warmup=True)
+        srv.start()
+        reply = srv.submit("coldstart", {
+            "features": np.zeros((1, int(cfg["width"])), np.float32)},
+            timeout=120.0)
+        if not reply:
+            raise RuntimeError("empty reply from warmed tenant")
+        cold = time.perf_counter() - t0
+    finally:
+        try:
+            srv.stop()
+        except Exception:
+            pass
+    import jax
+
+    st = compile_cache.stats()
+    print(json.dumps({
+        "coldstart_s": round(cold, 4),
+        "disk_hits": st["disk_hits"],
+        "disk_writes": st["disk_writes"],
+        "compiles": int(obs.counter("serving_compiles_total").value),
+        "platform": jax.default_backend(),
+    }), flush=True)
+
+
+def _run_coldstart_child(cfg: dict, tmpdir: str, tag: str,
+                         timeout_s: float) -> dict:
+    """Spawn one ``--_coldstart`` child; returns its JSON (or _error)."""
+    cfg_path = os.path.join(tmpdir, f"coldstart_{tag}.json")
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+    env = dict(os.environ)
+    # the cold-start children are host-side CPU processes (like the mesh
+    # replicas): they must not contend with a parent's accelerator, and
+    # the per-process XLA compile they measure is backend-independent
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TFOS_JAX_PLATFORM"] = "cpu"
+    env.pop("TFOS_COMPILE_CACHE_DIR", None)  # the config decides the arm
+    env.pop("TFOS_COMPILE_CACHE", None)      # ...not an ambient opt-out
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--_coldstart", cfg_path],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return {"_error": f"coldstart child timeout after {timeout_s}s"}
+    sys.stderr.write(proc.stderr[-2000:])
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    tail = (proc.stderr.strip().splitlines() or ["no output"])[-1]
+    return {"_error": f"rc={proc.returncode}: {tail[:300]}"}
+
+
+def measure_compile_cache(layers: int = 96, width: int = 256,
+                          batch_size: int = 128,
+                          bucket_sizes: "list | None" = None,
+                          child_timeout_s: float = 120.0,
+                          deadline: "_Deadline | None" = None) -> dict:
+    """Fleet cold-start microbench: second-process time-to-first-served-
+    request, A/B'd against the persistent compile cache.
+
+    The scenario is ROADMAP item 4's proof obligation: a mesh replica (or
+    re-launched trainer) joining a fleet whose shapes are already
+    compiled should load executables from the shared cache dir instead of
+    re-paying XLA per process.  Three REAL subprocesses, each running the
+    full tenant load path (checkpoint restore + serialized-forward
+    deserialize + ``add_tenant(warmup=True)`` over the ladder + one
+    served request):
+
+    1. **seed** (cache on, empty dir): populates the cache — the "one
+       replica compiles" half; also warms OS page caches so the measured
+       arms run under equal ambient state;
+    2. **cached** (cache on): the claim — ``coldstart_seconds``, which
+       must take one disk hit per ladder bucket or the measurement nulls
+       itself (a cached number that never touched disk is not evidence);
+    3. **nocache** (cache off): the baseline — ``coldstart_seconds_nocache``
+       — run LAST, in the warmest slot, so ambient drift biases against
+       the cache's claim, not for it.
+
+    The model is a deep narrow MLP (``layers`` × ``width``) exported
+    self-describing: compile-heavy relative to its weight bytes, which is
+    the regime the cache targets (checkpoint I/O is identical in both
+    arms and dilutes the ratio honestly).  Host-side and CPU-capable;
+    gated from r15 LOWER-is-better within the
+    platform/geometry/ladder/CPU-count config identity.
+    """
+    import shutil
+    import tempfile as _tempfile
+
+    import numpy as np
+
+    from tensorflowonspark_tpu import compat, shapes
+
+    buckets = list(shapes.resolve_buckets(
+        batch_size, bucket_sizes or [batch_size // 8, batch_size // 4,
+                                     batch_size // 2, batch_size]))
+
+    def remaining() -> float:
+        return deadline.remaining() if deadline is not None else 1e9
+
+    tmpdir = _tempfile.mkdtemp(prefix="tfos_coldstart_")
+    out: dict = {
+        "coldstart_platform": "cpu",
+        "coldstart_layers": int(layers),
+        "coldstart_width": int(width),
+        "coldstart_batch_size": int(batch_size),
+        "coldstart_buckets": buckets,
+        "coldstart_host_cpus": os.cpu_count(),
+    }
+
+    def null(reason: str) -> dict:
+        out["coldstart_seconds"] = None
+        out["coldstart_reason"] = reason[:300]
+        return out
+
+    try:
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        params = {"layers": [
+            (rng.standard_normal((width, width)).astype(np.float32)
+             * (1.0 / width) ** 0.5) for _ in range(layers)]}
+
+        def fwd(state, batch):
+            x = batch["features"]
+            for w in state["params"]["layers"]:
+                x = jnp.tanh(x @ w)
+            return {"emb": x}
+
+        export_dir = os.path.join(tmpdir, "export")
+        compat.export_saved_model(
+            {"params": params}, export_dir, forward_fn=fwd,
+            example_batch={"features": np.zeros((2, width), np.float32)})
+
+        cache_dir = os.path.join(tmpdir, "cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        cfg = {"export_dir": export_dir, "batch_size": batch_size,
+               "bucket_sizes": buckets, "width": width,
+               "cache_dir": cache_dir}
+
+        def child_timeout() -> "float | None":
+            # re-checked before EVERY child: a slow earlier child must
+            # null as "budget exhausted", not spawn the next child with a
+            # zero/negative subprocess timeout and blame it
+            left = remaining()
+            return min(child_timeout_s, left) if left >= 30 else None
+
+        t = child_timeout()
+        if t is None:
+            return null("wall budget exhausted before cold-start children")
+        seed = _run_coldstart_child(cfg, tmpdir, "seed", t)
+        if "_error" in seed:
+            return null(f"seed child failed: {seed['_error']}")
+        if not seed.get("disk_writes"):
+            return null(
+                "seed process wrote no persistent-cache entries (backend "
+                "ineligible for executable serialization?) — nothing for "
+                "a second process to hit")
+
+        t = child_timeout()
+        if t is None:
+            return null("wall budget exhausted before the cached arm")
+        cached = _run_coldstart_child(cfg, tmpdir, "cached", t)
+        if "_error" in cached:
+            return null(f"cached child failed: {cached['_error']}")
+        if int(cached.get("disk_hits") or 0) < len(buckets):
+            return null(
+                f"second process took {cached.get('disk_hits')} disk hits "
+                f"for a {len(buckets)}-bucket ladder — the cached arm did "
+                "not actually serve its warm compiles from disk")
+
+        t = child_timeout()
+        if t is None:
+            return null("wall budget exhausted before the cache-off arm")
+        nocache = _run_coldstart_child(
+            dict(cfg, cache_dir=None), tmpdir, "nocache", t)
+        if "_error" in nocache:
+            return null(f"nocache child failed: {nocache['_error']}")
+
+        out["coldstart_platform"] = cached.get("platform", "cpu")
+        out["coldstart_seconds"] = float(cached["coldstart_s"])
+        out["coldstart_seconds_nocache"] = float(nocache["coldstart_s"])
+        out["coldstart_speedup"] = round(
+            float(nocache["coldstart_s"]) / float(cached["coldstart_s"]), 3)
+        out["coldstart_disk_hits"] = int(cached["disk_hits"])
+        out["coldstart_disk_writes"] = int(seed["disk_writes"])
+        out["coldstart_compiles"] = int(cached.get("compiles") or 0)
+        return out
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def _stamp_compile_cache(result: dict, deadline: _Deadline) -> None:
+    """Stamp the compile-cache cold-start A/B into the headline result.
+
+    Host-side (CPU subprocesses) like the feed/serving/recovery
+    microbenches, so it runs on accelerator-degraded rounds too.  The
+    schema is total from r15: failure or an exhausted wall budget stamps
+    an explicit null + ``coldstart_reason``
+    (``tools/bench_gate.py --require-coldstart-from``)."""
+    from tensorflowonspark_tpu import obs
+
+    if deadline.remaining() < 120:
+        result["coldstart_seconds"] = None
+        result["coldstart_reason"] = ("wall budget exhausted before "
+                                      "compile-cache microbench")
+        return
+    with obs.span("bench.compile_cache") as sp:
+        try:
+            result.update(measure_compile_cache(deadline=deadline))
+            sp.set(ok=True, seconds=result.get("coldstart_seconds"),
+                   speedup=result.get("coldstart_speedup"))
+        except Exception as e:
+            result["coldstart_seconds"] = None
+            result["coldstart_reason"] = (
+                f"compile-cache microbench failed: {e!r}"[:200])
+            sp.set(ok=False, error=str(e)[:200])
+
+
 def _stamp_step_collectives(result: dict, deadline: _Deadline) -> None:
     """Stamp the train-step collectives A/B into the headline result.
 
@@ -2445,6 +2707,12 @@ def _write_trace_artifact(result: dict) -> None:
 
 def main() -> None:
     args = _parse_args()
+    if args._coldstart:
+        # fleet cold-start child: timed from HERE (the imports it is about
+        # to pay are the cold start) — dispatched before any obs/framework
+        # setup the parent path does
+        _coldstart_child(args._coldstart)
+        return
     if args._probe or args._measure:
         # accelerator-path children honor the outage-simulation knob by
         # hanging BEFORE touching any backend — exactly what the wedged
@@ -2514,6 +2782,16 @@ def main() -> None:
         result = {"metric": "recovery_seconds", "unit": "seconds"}
         _stamp_recovery(result, deadline)
         result["value"] = result.get("recovery_seconds")
+        _write_trace_artifact(result)
+        print(json.dumps(result))
+        return
+
+    if args.compile_cache:
+        # host-side compile-cache cold-start A/B (CPU subprocesses): no
+        # accelerator, no probe
+        result = {"metric": "coldstart_seconds", "unit": "seconds"}
+        _stamp_compile_cache(result, deadline)
+        result["value"] = result.get("coldstart_seconds")
         _write_trace_artifact(result)
         print(json.dumps(result))
         return
@@ -2612,6 +2890,7 @@ def main() -> None:
     _stamp_recovery(result, deadline)
     _stamp_mesh(result, deadline)
     _stamp_step_collectives(result, deadline)
+    _stamp_compile_cache(result, deadline)
     if not probe.get("ok"):
         result["probe"] = probe
     _ensure_roofline_fields(

@@ -1,0 +1,525 @@
+"""Persistent, fleet-shared XLA compile cache on the ``fs.py`` seam.
+
+At mesh scale (many replicas × many tenants × bucket ladders) every
+process pays its own XLA compiles — the dominant cold-start cost.  The
+pre-warm half (``TFModel.warmup``, online warm-on-load) moves compiles
+off the first request's critical path but still pays them once per
+process; this module makes the *second* process (and the rest of the
+fleet) load executables from disk instead:
+
+- **Backing store**: JAX's persistent compilation cache, pointed at a
+  directory resolved through :mod:`tensorflowonspark_tpu.fs` — plain
+  local paths and ``file://`` work with zero dependencies; any remote
+  scheme (``gs://``, ``hdfs://``, ``memory://`` in tests) rides the
+  ``LocalFS``/``FsspecFS`` abstraction via a local **spool**: entries are
+  pulled from the remote namespace at configure time and pushed as new
+  compiles land, so one replica compiles and the fleet loads.
+- **Content-addressed, topology-fenced keys**: JAX's own cache key is a
+  content hash of the lowered computation + compile options + backend +
+  jax version, so a changed model or flag can never collide.  On top of
+  that every entry lives under a *topology namespace*
+  (``jax<ver>-<platform>-<device kind>-d<devices>-p<processes>``): a
+  stale or cross-device entry is not merely unlikely to load — it is
+  never even listed.  Remote entries additionally carry a ``.sha256``
+  sidecar written *after* the payload; the pull path verifies it and
+  **rejects corrupt or half-written entries** (counted in
+  ``serving_compile_cache_disk_writes_total``'s corrupt sibling) instead
+  of handing XLA a truncated executable.
+- **Observability**: disk hits / writes / corrupt-rejections counters and
+  a ``serving_compile_disk_seconds`` retrieval-time histogram, split out
+  of the in-process compile metrics (``serving_compile_cache_{hits,
+  misses}_total`` keep meaning "jit executable cache" — a disk hit is
+  neither an in-process hit nor a true miss).  Attribution is
+  thread-exact: JAX's monitoring events fire synchronously on the
+  compiling thread, so ``serving.note_compile``'s settle logic can tell
+  *this* forward's disk hit from a concurrent one.
+
+Configuration: ``TFOS_COMPILE_CACHE_DIR=<path-or-uri>`` enables;
+``TFOS_COMPILE_CACHE=0`` force-disables even when a dir is set;
+``TFOS_COMPILE_CACHE_MIN_COMPILE_S`` (default 0 — serving forwards are
+small and the whole point is the fleet's long tail of them) bounds which
+compiles are worth writing; ``TFOS_COMPILE_CACHE_SPOOL`` overrides the
+local spool root for remote namespaces.  :func:`ensure` is called by
+every compile-adjacent path (trainer construction, serving model load,
+warmup, the JNI shim's ``load``) and is an unconditional no-op when
+unconfigured — zero behavior change unless opted in.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import re
+import threading
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+#: JAX monitoring event names (jax/_src/compiler.py, compilation_cache.py).
+#: Note the naming skew: jax's "cache_misses" event fires when an entry is
+#: WRITTEN — for us that is the disk-write counter, not a miss.
+_EV_HIT = "/jax/compilation_cache/cache_hits"
+_EV_WRITE = "/jax/compilation_cache/cache_misses"
+_DUR_RETRIEVAL = "/jax/compilation_cache/cache_retrieval_time_sec"
+
+#: retrieval-time histogram bounds: a disk hit is mmap+deserialize —
+#: sub-ms local, tens of ms on shared fs, seconds only when something is
+#: wrong
+_DISK_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                 float("inf"))
+
+_LOCK = threading.Lock()
+_SYNC_LOCK = threading.Lock()
+_TLS = threading.local()
+_INSTRUMENTS = None
+_LISTENING = False
+
+_STATE: dict[str, Any] = {
+    "attempted": False,     # one configure attempt per process
+    "namespace": None,      # logical cache namespace (root/topology), or None
+    "active_dir": None,     # the local dir jax actually reads/writes
+    "remote_ns": None,      # set only for remote roots
+    "spool": None,          # local spool backing a remote namespace
+    "pushed": set(),        # spool entry names verified to exist remotely
+    "sync_scheduled": False,  # a delayed background push is pending
+    "error": None,          # why configuration failed, if it did
+}
+
+
+# ---------------------------------------------------------------------------
+# Configuration
+# ---------------------------------------------------------------------------
+
+
+def cache_root() -> str | None:
+    """The configured cache root (path or URI), or None when disabled."""
+    if os.environ.get("TFOS_COMPILE_CACHE", "1").strip().lower() in (
+            "0", "false"):
+        return None
+    root = os.environ.get("TFOS_COMPILE_CACHE_DIR", "").strip()
+    if not root or root.lower() in ("0", "off", "none"):
+        return None
+    return root
+
+
+def enabled() -> bool:
+    return cache_root() is not None
+
+
+def active() -> bool:
+    """True once :func:`ensure` has successfully configured the cache in
+    this process — the gate for the hit/miss/disk settlement in
+    ``serving.note_compile`` (with no cache, a fresh signature is simply
+    a true miss and settles immediately)."""
+    return _STATE["namespace"] is not None
+
+
+def min_compile_seconds() -> float:
+    try:
+        return float(os.environ.get("TFOS_COMPILE_CACHE_MIN_COMPILE_S",
+                                    "0"))
+    except ValueError:
+        return 0.0
+
+
+def topology_key() -> str:
+    """The topology namespace an entry set is valid for.
+
+    JAX's cache key already content-addresses the computation, backend
+    and jax version; the namespace exists so a cross-device or
+    cross-version entry is never even LISTED for this process — shared-fs
+    roots serve heterogeneous fleets (a v5e pod and a CPU CI box can
+    share one bucket), and the failure mode "wrong executable silently
+    considered" must be structurally impossible, not just improbable.
+    Requires an initialized backend (callers are about to compile
+    anyway)."""
+    import jax
+
+    devices = jax.devices()
+    kind = devices[0].device_kind if devices else "unknown"
+    try:
+        processes = jax.process_count()
+    except Exception:
+        processes = 1
+    raw = (f"jax{jax.__version__}-{jax.default_backend()}-{kind}"
+           f"-d{len(devices)}-p{processes}")
+    return re.sub(r"[^A-Za-z0-9_.+-]+", "-", raw)
+
+
+def ensure() -> str | None:
+    """Configure the persistent compile cache for this process (idempotent).
+
+    Returns the logical namespace in use, or None when disabled or
+    unconfigurable.  Never raises: a cache problem must not take down a
+    training step or a tenant load — the process just compiles like it
+    always did, and the reason lands in :func:`stats` (and so on
+    ``/healthz``)."""
+    with _LOCK:
+        if _STATE["attempted"]:
+            return _STATE["namespace"]
+        root = cache_root()
+        if root is None:
+            return None
+        _STATE["attempted"] = True
+        try:
+            _configure(root)
+        except Exception as e:  # pragma: no cover - env-specific failures
+            _STATE["error"] = f"{type(e).__name__}: {e}"[:300]
+            _STATE["namespace"] = None
+            logger.warning("persistent compile cache disabled: cannot "
+                           "configure %r: %s", root, e)
+        return _STATE["namespace"]
+
+
+def _configure(root: str) -> None:
+    from tensorflowonspark_tpu import fs, util
+
+    util.ensure_jax_platform()
+    import jax
+
+    namespace = fs.join(root, topology_key())
+    local = fs.local_path(namespace)
+    if local is not None:
+        os.makedirs(local, exist_ok=True)
+        active = local
+    else:
+        fs.makedirs(namespace)
+        spool = _spool_dir(namespace)
+        os.makedirs(spool, exist_ok=True)
+        _STATE["remote_ns"] = namespace
+        _STATE["spool"] = spool
+        active = spool
+        pulled = pull_entries(namespace, spool, pushed=_STATE["pushed"])
+        logger.info("compile cache %s: pulled %d entries to spool %s "
+                    "(%d corrupt rejected)", namespace, pulled["pulled"],
+                    spool, pulled["corrupt"])
+    _install_listeners()
+    jax.config.update("jax_compilation_cache_dir", active)
+    # serving forwards compile in well under jax's 1s default; the fleet
+    # amortizes even tiny compiles, so cache everything unless the
+    # operator said otherwise via jax's own env knobs
+    if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_seconds())
+    if "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES" not in os.environ:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _unlatch_jax_cache()
+    _STATE["namespace"] = namespace
+    _STATE["active_dir"] = active
+    logger.info("persistent compile cache at %s (local dir %s)",
+                namespace, active)
+
+
+def _spool_dir(namespace: str) -> str:
+    root = os.environ.get("TFOS_COMPILE_CACHE_SPOOL")
+    if not root:
+        import tempfile
+
+        root = os.path.join(tempfile.gettempdir(), "tfos-compile-spool")
+    tag = hashlib.sha256(namespace.encode()).hexdigest()[:16]
+    return os.path.join(root, tag)
+
+
+def _unlatch_jax_cache() -> None:
+    """Re-evaluate jax's once-per-process cache decision.
+
+    jax latches "is a cache configured?" at the first compile; a process
+    that compiled anything before :func:`ensure` ran (a health probe, an
+    unrelated jit) would otherwise ignore the directory forever.  Best
+    effort against jax internals: if the seam moves, the cache silently
+    stays off for such processes — never an error."""
+    try:  # pragma: no cover - depends on jax internals
+        from jax._src import compilation_cache as _cc
+
+        if getattr(_cc, "_cache_checked", False) or \
+                getattr(_cc, "_cache_initialized", False):
+            _cc.reset_cache()
+    except Exception:
+        pass
+
+
+def disable() -> None:
+    """Tear the configuration down (tests, A/B benches): jax stops
+    consulting the directory and the next :func:`ensure` re-reads env."""
+    with _LOCK:
+        _STATE.update(attempted=False, namespace=None, active_dir=None,
+                      remote_ns=None, spool=None, error=None,
+                      sync_scheduled=False)
+        _STATE["pushed"] = set()
+        try:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", None)
+        except Exception:
+            pass
+        _unlatch_jax_cache()
+
+
+# ---------------------------------------------------------------------------
+# Remote sync (the fs.py seam)
+# ---------------------------------------------------------------------------
+
+
+def _digest(payload: bytes) -> str:
+    return hashlib.sha256(payload).hexdigest()
+
+
+def pull_entries(remote_ns: str, spool: str,
+                 pushed: set | None = None) -> dict:
+    """Copy remote cache entries into the local spool, digest-verified.
+
+    Only ``*-cache`` entry files WITH a matching ``.sha256`` sidecar are
+    accepted: the sidecar is written after the payload (see
+    :func:`push_entries`), so a half-written entry on NFS/object storage
+    simply has no sidecar yet and is skipped — and a corrupt payload
+    (truncated write, bit rot) fails the digest and is **rejected
+    loudly** (warning + ``serving_compile_cache_disk_corrupt_total``)
+    instead of being handed to XLA.  Returns ``{"pulled", "corrupt",
+    "skipped"}``."""
+    from tensorflowonspark_tpu import fs
+
+    pulled = corrupt = skipped = 0
+    try:
+        names = fs.listdir(remote_ns)
+    except Exception as e:
+        logger.warning("compile cache: cannot list %s: %s", remote_ns, e)
+        return {"pulled": 0, "corrupt": 0, "skipped": 0}
+    have = set(os.listdir(spool)) if os.path.isdir(spool) else set()
+    for name in sorted(names):
+        if not name.endswith("-cache"):
+            continue
+        src = fs.join(remote_ns, name)
+        if name in have:
+            # already spooled: mark pushed only when the remote SIDECAR
+            # digest matches our local bytes — a half-written (no
+            # sidecar) or sidecar-divergent remote entry stays
+            # un-"pushed" so the next sync() overwrites it with the good
+            # local copy (repair).  Payload-only bit rot under an intact
+            # sidecar is the fresh puller's full verification to catch;
+            # the first process to RECOMPILE that entry repairs it, since
+            # a rejected pull never marks the name pushed.
+            if pushed is not None:
+                try:
+                    with fs.open(src + ".sha256", "rb") as f:
+                        want = f.read().decode("ascii", "replace").strip()
+                    with open(os.path.join(spool, name), "rb") as f:
+                        if _digest(f.read()) == want:
+                            pushed.add(name)
+                except Exception:
+                    pass
+            continue
+        try:
+            # sidecar FIRST: the writer's order is payload-then-sidecar,
+            # so a readable sidecar proves the payload write finished —
+            # reading in the opposite order would race a mid-write into
+            # a false "corrupt" alarm instead of a benign skip
+            with fs.open(src + ".sha256", "rb") as f:
+                want = f.read().decode("ascii", "replace").strip()
+            with fs.open(src, "rb") as f:
+                payload = f.read()
+        except Exception:
+            # no sidecar (mid-write by another replica) or transient read
+            # failure: not an error, just not loadable yet — and not
+            # marked pushed, so a local copy of it would re-push
+            skipped += 1
+            continue
+        if _digest(payload) != want:
+            corrupt += 1
+            _instruments()[2].inc()
+            logger.warning(
+                "compile cache: REJECTED corrupt entry %s (digest "
+                "mismatch) — recompiling locally instead of loading a "
+                "damaged executable (a locally-compiled replacement will "
+                "overwrite it on the next sync)", src)
+            continue
+        tmp = os.path.join(spool, f".{name}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, os.path.join(spool, name))
+        if pushed is not None:
+            pushed.add(name)  # verified remote copy: never echo it back
+        pulled += 1
+    return {"pulled": pulled, "corrupt": corrupt, "skipped": skipped}
+
+
+def push_entries(spool: str, remote_ns: str, pushed: set) -> int:
+    """Copy new spool entries to the remote namespace through fs.py.
+
+    Payload first, digest sidecar second — a reader accepts an entry only
+    once its sidecar matches, so the non-atomic remote write can never be
+    *loaded* half-done (the NFS caveat is documented in DEPLOY.md: the
+    window costs a skipped pull, never a bad load)."""
+    from tensorflowonspark_tpu import fs
+
+    n = 0
+    if not os.path.isdir(spool):
+        return 0
+    for name in sorted(os.listdir(spool)):
+        if not name.endswith("-cache") or name in pushed:
+            continue
+        with open(os.path.join(spool, name), "rb") as f:
+            payload = f.read()
+        dst = fs.join(remote_ns, name)
+        try:
+            with fs.open(dst, "wb") as f:
+                f.write(payload)
+            with fs.open(dst + ".sha256", "wb") as f:
+                f.write(_digest(payload).encode("ascii"))
+        except Exception as e:
+            logger.warning("compile cache: cannot push %s: %s", dst, e)
+            continue
+        pushed.add(name)
+        n += 1
+    return n
+
+
+def sync() -> int:
+    """Push spool entries that are not yet remote; no-op for local roots.
+
+    Called synchronously after warmup (the warm loop just produced the
+    exact entry set the fleet wants) and asynchronously after data-plane
+    first-compiles (:func:`sync_async`)."""
+    with _SYNC_LOCK:
+        if not _STATE["remote_ns"]:
+            return 0
+        n = push_entries(_STATE["spool"], _STATE["remote_ns"],
+                         _STATE["pushed"])
+        if n:
+            logger.info("compile cache: pushed %d new entries to %s", n,
+                        _STATE["remote_ns"])
+        return n
+
+
+def sync_async(delay_s: float = 2.0) -> None:
+    """Schedule a :func:`sync` off the compute thread, slightly delayed.
+
+    The trigger is jax's write event, which fires just BEFORE the entry
+    file lands in the spool — the delay lets the write (and the rest of
+    a warm burst) finish so the last compile of a burst is never left
+    unpushed.  At most one sync is scheduled at a time; the scheduled
+    flag clears before the push runs, so a write landing mid-push
+    schedules a fresh pass that picks it up."""
+    if not _STATE["remote_ns"]:
+        return
+    with _LOCK:
+        if _STATE.get("sync_scheduled"):
+            return
+        _STATE["sync_scheduled"] = True
+
+    def _run():
+        import time
+
+        time.sleep(delay_s)
+        with _LOCK:
+            _STATE["sync_scheduled"] = False
+        try:
+            sync()
+        except Exception:  # pragma: no cover - never fail a compile path
+            logger.warning("compile cache: background sync failed",
+                           exc_info=True)
+
+    threading.Thread(target=_run, name="tfos-compile-cache-sync",
+                     daemon=True).start()
+
+
+# ---------------------------------------------------------------------------
+# Counters + event attribution
+# ---------------------------------------------------------------------------
+
+
+def _instruments():
+    global _INSTRUMENTS
+    if _INSTRUMENTS is None:
+        from tensorflowonspark_tpu import obs
+
+        _INSTRUMENTS = (
+            obs.counter(
+                "serving_compile_cache_disk_hits_total",
+                "compiles served from the persistent compile cache (an "
+                "XLA executable loaded from disk instead of compiled — "
+                "neither an in-process jit hit nor a true miss)"),
+            obs.counter(
+                "serving_compile_cache_disk_writes_total",
+                "XLA executables written to the persistent compile cache "
+                "(each one is a compile some other process can now skip)"),
+            obs.counter(
+                "serving_compile_cache_disk_corrupt_total",
+                "persistent-cache entries REJECTED on pull (digest "
+                "mismatch: truncated or damaged remote entry)"),
+            obs.histogram(
+                "serving_compile_disk_seconds",
+                "wall time to retrieve one executable from the "
+                "persistent compile cache (the disk half split out of "
+                "serving_compile_seconds)", buckets=_DISK_BUCKETS))
+    return _INSTRUMENTS
+
+
+def _install_listeners() -> None:
+    global _LISTENING
+    if _LISTENING:
+        return
+    from jax._src import monitoring
+
+    monitoring.register_event_listener(_on_event)
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    _LISTENING = True
+
+
+def _on_event(event: str, **kw) -> None:
+    # runs inside jax's compile path: must never raise
+    try:
+        if event == _EV_HIT:
+            _instruments()[0].inc()
+            _TLS.hits = getattr(_TLS, "hits", 0) + 1
+        elif event == _EV_WRITE:
+            _instruments()[1].inc()
+            sync_async()
+    except Exception:  # pragma: no cover
+        pass
+
+
+def _on_duration(event: str, duration: float, **kw) -> None:
+    try:
+        if event == _DUR_RETRIEVAL:
+            _instruments()[3].observe(float(duration))
+    except Exception:  # pragma: no cover
+        pass
+
+
+def thread_disk_hits() -> int:
+    """Disk hits observed ON THIS THREAD since process start.
+
+    jax's monitoring events fire synchronously on the compiling thread,
+    so a caller that snapshots this before a forward and compares after
+    knows whether *its own* compile was served from disk — the exact
+    attribution ``serving.note_compile``'s hit/miss/disk split needs,
+    immune to concurrent compiles on other threads."""
+    return getattr(_TLS, "hits", 0)
+
+
+def stats() -> dict[str, Any]:
+    """JSON-able cache state for ``/healthz`` and the bench child.
+
+    Reads counters via ``Registry.peek`` — the instruments are minted by
+    the cache's own event listeners, and a /healthz scrape on a
+    cache-off process must not publish phantom 0 disk series on
+    /metrics (the ``Registry.peek`` discipline)."""
+    from tensorflowonspark_tpu import obs
+
+    reg = obs.get_registry()
+
+    def val(name: str) -> int:
+        inst = reg.peek(name)
+        return int(inst.value) if inst is not None else 0
+
+    return {
+        "enabled": enabled(),
+        "dir": cache_root(),
+        "namespace": _STATE["namespace"],
+        "remote": bool(_STATE["remote_ns"]),
+        "error": _STATE["error"],
+        "disk_hits": val("serving_compile_cache_disk_hits_total"),
+        "disk_writes": val("serving_compile_cache_disk_writes_total"),
+        "disk_corrupt": val("serving_compile_cache_disk_corrupt_total"),
+    }

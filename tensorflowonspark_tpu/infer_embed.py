@@ -62,7 +62,12 @@ def load(export_dir: str, model_name: str = "") -> int:
 
     import jax
 
-    from tensorflowonspark_tpu import ckpt, saved_model
+    from tensorflowonspark_tpu import ckpt, compile_cache, saved_model
+
+    # a JVM-embedded interpreter cold-starts like any other fleet member:
+    # point the jit compiles below at the persistent cache (no-op when
+    # TFOS_COMPILE_CACHE_DIR is unset)
+    compile_cache.ensure()
 
     path = export_dir
     model_sub = os.path.join(path, "model")
@@ -96,10 +101,12 @@ def load(export_dir: str, model_name: str = "") -> int:
         else:
             fn = jax.jit(forward)
 
-        # input names come from the zoo's example batch (labels stripped)
+        # input names come from the zoo's example batch (labels stripped —
+        # the shape-policy module's convention, shapes.LABEL_KEYS)
+        from tensorflowonspark_tpu import shapes
+
         example = lib.example_batch(config, batch_size=1)
-        label_keys = {"label", "start_positions", "end_positions"}
-        input_names = [k for k in example if k not in label_keys]
+        input_names = [k for k in example if k not in shapes.LABEL_KEYS]
 
     with _LOCK:
         h = next(_NEXT)
@@ -183,15 +190,19 @@ def run(handle: int) -> None:
     # execution and exact results.  Opt out entirely with
     # TFOS_INFER_BUCKETS=0.
     import os
+    import time as _time
 
-    from tensorflowonspark_tpu import serving
+    from tensorflowonspark_tpu import serving, shapes
 
     bucketed = os.environ.get("TFOS_INFER_BUCKETS", "1").strip().lower() \
         not in ("0", "false")
     n_real = bucket = 0
+    fresh = False
     if bucketed:
-        n_real = serving.batch_rows(batch)
-        bucket = serving.pow2_bucket(n_real) if n_real > 0 else 0
+        # ladder policy from the ONE shape-policy module: implicit pow-2
+        # buckets for callers with no configured geometry
+        n_real = shapes.batch_rows(batch)
+        bucket = shapes.pow2_bucket(n_real) if n_real > 0 else 0
         if bucket > n_real and (st.get("per_example") is not False
                                 and len(st.get("per_example_sizes",
                                                ())) >= 2):
@@ -201,9 +212,15 @@ def run(handle: int) -> None:
             # true shape — no pad copy is made; this call compiles at its
             # own size and its output shapes feed the evidence
             bucket = n_real
-        serving.note_compile(("infer_embed", handle), batch)
+        fresh = serving.note_compile(("infer_embed", handle), batch)
+    t0 = _time.perf_counter()
     out = st["fn"](st["params"], batch)
     named = _flatten_named(out)
+    if fresh:
+        # _flatten_named forced every output, so this wall carries the
+        # first-call compile (or its persistent-cache load — the settle
+        # in observe_compile_seconds tells them apart)
+        serving.observe_compile_seconds(_time.perf_counter() - t0)
     if bucketed and n_real > 0:
         padded = bucket > n_real
         per_example = all(v.ndim >= 1 and v.shape[0] == bucket
@@ -220,8 +237,12 @@ def run(handle: int) -> None:
             true_batch = dict(st["inputs"])
             # the rerun is a genuine fresh compile at the true shape —
             # keep serving_compiles_total == jit compilation keys honest
-            serving.note_compile(("infer_embed", handle), true_batch)
+            refresh = serving.note_compile(("infer_embed", handle),
+                                           true_batch)
+            t1 = _time.perf_counter()
             named = _flatten_named(st["fn"](st["params"], true_batch))
+            if refresh:
+                serving.observe_compile_seconds(_time.perf_counter() - t1)
         elif padded:
             # mask half of pad-and-mask: slice every output back to the
             # true row count (all carry the batch axis — just verified)

@@ -189,10 +189,10 @@ def placement_key(cfg: Mapping[str, Any]) -> tuple:
     one replica coalesce into shared batches there; placing them apart
     forfeits exactly that sharing, which is why the router only spills
     same-key tenants to another replica when the byte bound saturates."""
-    from tensorflowonspark_tpu import pipeline, serving
+    from tensorflowonspark_tpu import pipeline, shapes
 
-    buckets = tuple(serving.resolve_buckets(cfg["batch_size"],
-                                            cfg.get("bucket_sizes")))
+    buckets = tuple(shapes.resolve_buckets(cfg["batch_size"],
+                                           cfg.get("bucket_sizes")))
     return (pipeline.model_cache_key(cfg["export_dir"],
                                      cfg.get("model_name")),
             buckets,

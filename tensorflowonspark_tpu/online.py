@@ -617,10 +617,13 @@ class OnlineServer:
         complete span tree in the trace store (tail-based sampling).
         ``warmup``: ``True`` forces (raises when input shapes are
         unknowable), ``None`` warms when shapes are known
-        (``warmup_example`` or a self-describing export's signature),
+        (``warmup_example``, a self-describing export's signature, or —
+        for ``model_name`` tenants — the zoo's own example batch via
+        ``shapes.model_specs``, the policy-derived fallback),
         ``False`` skips.
         """
-        from tensorflowonspark_tpu import pipeline, saved_model, serving
+        from tensorflowonspark_tpu import (pipeline, saved_model, serving,
+                                           shapes)
 
         if self._stopped:
             raise RuntimeError("OnlineServer is stopped")
@@ -640,16 +643,31 @@ class OnlineServer:
             columns=list(in_map), backend="sparkapi",
             bucket_sizes=list(bucket_sizes) if bucket_sizes else None)
         fn, params = runner._load()
-        buckets = serving.resolve_buckets(batch_size, bucket_sizes)
+        buckets = shapes.resolve_buckets(batch_size, bucket_sizes)
 
         specs = None
         if warmup_example is not None:
-            specs = serving.input_specs(example=warmup_example)
+            specs = shapes.input_specs(example=warmup_example)
         else:
             try:
-                specs = serving.input_specs(
+                specs = shapes.input_specs(
                     signature=saved_model.read_signature(export_dir))
             except (FileNotFoundError, ValueError):
+                specs = None
+        if specs is None and model_name and predict_fn is None:
+            # policy-derived fallback (shapes.model_specs): a weights-only
+            # zoo export still warms — the zoo's example batch is the
+            # model's input-shape policy, at the loaded params' geometry
+            try:
+                specs = shapes.policy_specs(model_name, params)
+                if any(f not in specs for f in in_map.values()):
+                    # the operator's mapping names inputs the zoo's policy
+                    # doesn't know: fall back to unwarmed, not an error —
+                    # explicit sources (example/signature) still raise
+                    specs = None
+            except Exception as e:
+                logger.info("tenant %r: no policy-derived input specs "
+                            "for model %r (%s)", name, model_name, e)
                 specs = None
         if specs is not None:
             missing = [f for f in in_map.values() if f not in specs]
@@ -661,8 +679,10 @@ class OnlineServer:
         if warmup is True and specs is None:
             raise ValueError(
                 f"tenant {name!r}: warmup requested but input shapes are "
-                "unknowable — pass warmup_example= or serve a "
-                "self-describing export")
+                "unknowable — pass warmup_example=, serve a "
+                "self-describing export, or use a model_name the "
+                "shape-policy module (tensorflowonspark_tpu/shapes.py: "
+                "model_specs) can derive specs from")
 
         # output_mapping is part of the coalescing identity too: the
         # compute thread names the WHOLE batch's outputs via the group's
@@ -1042,7 +1062,7 @@ class OnlineServer:
         return out, rows
 
     def _coalesce_loop(self) -> None:
-        from tensorflowonspark_tpu import serving
+        from tensorflowonspark_tpu import serving, shapes
         from tensorflowonspark_tpu.obs import flight
 
         rec = flight.recorder("online")
@@ -1076,7 +1096,7 @@ class OnlineServer:
                 t0 = perf()
                 cols = self._concat(reqs)
                 t1 = perf()
-                bucket = serving.choose_bucket(n, group.buckets)
+                bucket = shapes.choose_bucket(n, group.buckets)
                 if bucket > n:
                     cols = serving.pad_columns(cols, bucket)
                 serving.note_rows(n, bucket)
@@ -1305,9 +1325,19 @@ class OnlineServer:
                 "latency_p50_ms": ts.quantile_ms(0.50),
                 "latency_p99_ms": ts.quantile_ms(0.99),
             }
+        from tensorflowonspark_tpu import serving as _serving
+
         return {
             "state": self.state,
             "tenants": tenants,
+            # compile-cache visibility: ``warm_ratio`` (in-process + disk
+            # hits over all shape requests) is how the mesh router can see
+            # a COLD replica — a freshly joined process that will pay
+            # compile walls (or disk loads) on its first requests — and
+            # ``dir``/``namespace`` say where the persistent cache lives.
+            # Outside the versioned ``admission`` block: additive field,
+            # admission_schema semantics unchanged.
+            "compile_cache": _serving.cache_health(),
             "admission": {
                 "admission_schema": 1,
                 "pending_bytes": agg_pending_bytes,

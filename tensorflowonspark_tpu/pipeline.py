@@ -302,7 +302,7 @@ class TFModel(TFParams, HasBatchSize, HasInputMapping, HasOutputMapping,
         cold-start dominates (ROADMAP item 4).  ``warmup`` loads the model
         through the same ``_MODEL_CACHE`` path ``transform`` uses and runs
         one all-zeros forward per bucket of the ladder
-        (``serving.resolve_buckets(batch_size, buckets or bucket_sizes)``),
+        (``shapes.resolve_buckets(batch_size, buckets or bucket_sizes)``),
         so the jit executable cache already holds every shape the data
         plane will request.  Row shapes/dtypes come from ``example`` (a
         dict of model-input name → ONE example row) or, for
@@ -312,29 +312,46 @@ class TFModel(TFParams, HasBatchSize, HasInputMapping, HasOutputMapping,
         invariant *``serving_compiles_total`` == distinct jit keys* holds,
         warmup just moves them off the first request's critical path.
         Returns the list of bucket sizes warmed.
+
+        Shape sources, in precedence order: ``example=``, a
+        self-describing export's signature, and — new with the
+        shape-policy module — the model zoo's own example batch when the
+        model serves by ``model_name`` (``shapes.model_specs``: the
+        policy-derived fallback, so a weights-only zoo export no longer
+        needs a hand-built example just to warm).
         """
-        from tensorflowonspark_tpu import saved_model, serving, sql_compat
+        from tensorflowonspark_tpu import (saved_model, serving, shapes,
+                                           sql_compat)
 
         export_dir = self.getOrDefault("export_dir") or self.getOrDefault(
             "model_dir")
         if not export_dir:
             raise ValueError("TFModel needs export_dir or model_dir")
-        if example is not None:
-            specs = serving.input_specs(example=example)
-        else:
-            try:
-                specs = serving.input_specs(
-                    signature=saved_model.read_signature(export_dir))
-            except FileNotFoundError:
-                raise ValueError(
-                    "warmup needs input shapes: pass example= (model "
-                    "input name → one example row) or serve a "
-                    "self-describing export whose signature records "
-                    "them") from None
         bucket_sizes = (list(buckets) if buckets
                         else self.getOrDefault("bucket_sizes"))
-        ladder = serving.resolve_buckets(self.getOrDefault("batch_size"),
-                                         bucket_sizes)
+        ladder = shapes.resolve_buckets(self.getOrDefault("batch_size"),
+                                        bucket_sizes)
+        # resolve the shape source BEFORE paying the model load: with no
+        # example=, no self-describing signature and no model_name there
+        # is nothing to warm, and the error must not cost a multi-GB
+        # checkpoint restore (nor leave the model cached) first
+        specs = None
+        if example is not None:
+            specs = shapes.input_specs(example=example)
+        else:
+            try:
+                specs = shapes.input_specs(
+                    signature=saved_model.read_signature(export_dir))
+            except FileNotFoundError:
+                if not self.getOrDefault("model_name"):
+                    raise ValueError(
+                        "warmup needs input shapes: pass example= (model "
+                        "input name → one example row), serve a "
+                        "self-describing export whose signature records "
+                        "them, or set model_name so the shape-policy "
+                        "module (tensorflowonspark_tpu/shapes.py: "
+                        "model_specs) can derive them from the model "
+                        "zoo") from None
         run_model = _RunModel(
             export_dir=export_dir,
             model_name=self.getOrDefault("model_name"),
@@ -342,9 +359,16 @@ class TFModel(TFParams, HasBatchSize, HasInputMapping, HasOutputMapping,
             batch_size=self.getOrDefault("batch_size"),
             input_mapping=self.getOrDefault("input_mapping"),
             output_mapping=self.getOrDefault("output_mapping"),
-            columns=list(specs), backend=sql_compat.SPARKAPI,
+            columns=[], backend=sql_compat.SPARKAPI,
             bucket_sizes=bucket_sizes)
         fn, params = run_model._load()
+        if specs is None:
+            # policy-derived fallback: the zoo's example batch IS the
+            # model's input-shape policy (labels stripped), at the
+            # geometry the loaded params imply — needs params, so it
+            # runs after _load()
+            specs = shapes.policy_specs(self.getOrDefault("model_name"),
+                                        params)
         serving.warm_buckets(fn, params, specs, ladder,
                              run_model._cache_key)
         logger.info("warmed %s for buckets %s", export_dir, list(ladder))
@@ -565,8 +589,12 @@ class _RunModel:
         ``serving.model_load`` — the restore+jit cost the first partition
         on an executor pays)."""
         single_node_env()
-        from tensorflowonspark_tpu import ckpt, saved_model
+        from tensorflowonspark_tpu import ckpt, compile_cache, saved_model
 
+        # the jit executables this load is about to mint are exactly what
+        # the persistent compile cache amortizes across the fleet —
+        # configure it before the first compile (no-op when unconfigured)
+        compile_cache.ensure()
         state = ckpt.load_pytree(path)
         params = state.get("params", state) if isinstance(state, dict) else state
         collections = state.get("collections") if isinstance(state, dict) else None
@@ -610,7 +638,7 @@ class _RunModel:
     def __call__(self, iterator):
         import itertools
 
-        from tensorflowonspark_tpu import readers, serving
+        from tensorflowonspark_tpu import readers, serving, shapes
 
         fn, params = self._load()
         in_map = self.input_mapping or {c: c for c in self.columns}
@@ -629,8 +657,8 @@ class _RunModel:
             # per-example outputs depend on the whole batch
             buckets = ()
         else:
-            buckets = serving.resolve_buckets(self.batch_size,
-                                              self.bucket_sizes)
+            buckets = shapes.resolve_buckets(self.batch_size,
+                                             self.bucket_sizes)
         stage = serving.stager()
         from time import perf_counter as _perf
 
@@ -657,7 +685,7 @@ class _RunModel:
                 except StopIteration:
                     return
                 t1 = _perf()
-                bucket = serving.choose_bucket(n, buckets)
+                bucket = shapes.choose_bucket(n, buckets)
                 if bucket > n:
                     cols = serving.pad_columns(cols, bucket)
                 serving.note_rows(n, bucket)

@@ -36,16 +36,26 @@ ingest + pad + ``device_put`` stage (:func:`stager`) inside a
 the device while batch N computes.
 
 Registry counters (exported with every metrics snapshot): ``serving_compiles_total``,
-``serving_rows_total``, ``serving_padded_rows_total``.
+``serving_rows_total``, ``serving_padded_rows_total``, and the compile
+hit/miss family ``serving_compile_cache_{hits,misses}_total`` — whose disk
+dimension (``serving_compile_cache_disk_{hits,writes}_total``,
+``serving_compile_disk_seconds``) lives in
+:mod:`tensorflowonspark_tpu.compile_cache`.  Shape POLICY (buckets,
+signatures, warmup enumeration) lives in
+:mod:`tensorflowonspark_tpu.shapes`; this module re-exports the
+historical names.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import threading
 from typing import Any, Iterator, Mapping, Sequence
 
 import numpy as np
+
+from tensorflowonspark_tpu import shapes
 
 logger = logging.getLogger(__name__)
 
@@ -56,45 +66,17 @@ _SEEN_SHAPES: dict[Any, set] = {}
 
 
 # ---------------------------------------------------------------------------
-# Buckets
+# Buckets — POLICY LIVES IN shapes.py (the one shape-policy module); these
+# are this module's historical names, kept so the wide existing call
+# surface (tests, notebooks, the JNI shim's env contract) stays stable.
 # ---------------------------------------------------------------------------
 
-
-def resolve_buckets(batch_size: int,
-                    bucket_sizes: Sequence[int] | None = None
-                    ) -> tuple[int, ...]:
-    """The effective bucket set: sorted, deduplicated, positive.
-
-    Default (``bucket_sizes`` unset/empty) is the single bucket
-    ``(batch_size,)`` — every batch, ragged tails included, pads to the one
-    compiled shape.  Extra buckets trade padding waste for compile count:
-    ``[batch_size // 4, batch_size]`` wastes at most 75% on a tiny tail
-    while compiling twice.  Two normalizations keep the set sane: buckets
-    larger than ``batch_size`` are DROPPED (with a warning — chunking
-    never produces a batch bigger than ``batch_size``, so an oversize
-    bucket would only ever make :func:`choose_bucket` pad full batches up
-    past their own size), and the terminal ``batch_size`` bucket is always
-    included (a set whose largest bucket is smaller than ``batch_size``
-    would compile every tail above it at its own shape — the per-tail
-    compile explosion buckets exist to prevent).
-    """
-    if bucket_sizes:
-        out = sorted({int(b) for b in bucket_sizes if int(b) > 0})
-        kept = [b for b in out if b <= int(batch_size)]
-        if len(kept) != len(out):
-            logger.warning(
-                "dropping bucket size(s) %s > batch_size %d: a batch never "
-                "exceeds batch_size, so an oversize bucket would only pad "
-                "full batches past their own size",
-                [b for b in out if b > int(batch_size)], int(batch_size))
-        if kept:
-            if kept[-1] < int(batch_size):
-                # the terminal bucket must cover batch_size-row chunks, or
-                # every tail above it compiles at its own shape — the
-                # per-tail compile explosion buckets exist to prevent
-                kept.append(int(batch_size))
-            return tuple(kept)
-    return (int(batch_size),)
+resolve_buckets = shapes.resolve_buckets
+choose_bucket = shapes.choose_bucket
+pow2_bucket = shapes.pow2_bucket
+batch_rows = shapes.batch_rows
+input_specs = shapes.input_specs
+zero_batch = shapes.zero_batch
 
 
 def bucketing_enabled() -> bool:
@@ -112,25 +94,6 @@ def bucketing_enabled() -> bool:
         not in ("0", "false")
 
 
-def choose_bucket(n: int, buckets: Sequence[int]) -> int:
-    """Smallest bucket that fits ``n`` rows; ``n`` itself when none does
-    (only reachable when the caller's chunk size exceeds every bucket —
-    the batch then compiles at its own shape, exactly the legacy cost)."""
-    for b in buckets:
-        if b >= n:
-            return int(b)
-    return int(n)
-
-
-def pow2_bucket(n: int) -> int:
-    """Next power-of-two ≥ n — the implicit bucket ladder used by callers
-    with no configured geometry (``infer_embed``'s JVM batches)."""
-    b = 1
-    while b < n:
-        b <<= 1
-    return b
-
-
 def pad_columns(cols: Mapping[str, Any], target: int) -> dict:
     """Zero-pad every column's leading axis to ``target`` rows.
 
@@ -142,68 +105,9 @@ def pad_columns(cols: Mapping[str, Any], target: int) -> dict:
     return saved_model.pad_batch(cols, target)
 
 
-def batch_rows(batch: Mapping[str, Any]) -> int:
-    """The batch's paddable row count: the leading dimension EVERY
-    ``ndim >= 1`` input shares — that shared dimension is what makes it a
-    batch axis.  0 when there is no leading axis anywhere or the leading
-    dims disagree (e.g. a per-call side input of shape ``(k,)`` riding
-    along with ``(n, d)`` features — zero-extending *that* would feed the
-    model wrong values, not padding)."""
-    dims = {int(np.shape(v)[0]) for v in batch.values()
-            if np.asarray(v).ndim >= 1}
-    if len(dims) != 1:
-        return 0
-    n = dims.pop()
-    return n if n > 0 else 0
-
-
 # ---------------------------------------------------------------------------
 # Warmup shapes
 # ---------------------------------------------------------------------------
-
-
-def input_specs(example: Mapping[str, Any] | None = None,
-                signature: Mapping[str, Any] | None = None
-                ) -> dict[str, tuple[tuple, Any]]:
-    """Per-input row templates: ``{input_name: (row_shape, dtype)}``.
-
-    The shape source for :func:`zero_batch` — what a warmup path needs to
-    build a representative batch at any bucket size.  From ``example`` (a
-    dict of input name → ONE example row, no batch axis) the template is
-    the row's own shape/dtype; from a self-describing export's
-    ``signature`` (``saved_model.read_signature``) it is each input
-    entry's shape minus the leading batch dim.  Exactly one source must
-    be given.
-    """
-    if (example is None) == (signature is None):
-        raise ValueError("input_specs needs exactly one of example= / "
-                         "signature=")
-    specs: dict[str, tuple[tuple, Any]] = {}
-    if example is not None:
-        for name, row in example.items():
-            a = np.asarray(row)
-            specs[str(name)] = (tuple(a.shape), a.dtype)
-        return specs
-    for entry in signature.get("inputs", []):
-        shape = entry.get("shape") or []
-        if any(d is None for d in shape[1:]):
-            raise ValueError(
-                f"input {entry.get('name')!r} has a polymorphic non-batch "
-                f"dim {shape}: warmup needs concrete row shapes — pass "
-                "example= instead")
-        tail = tuple(int(d) for d in shape[1:])
-        specs[str(entry["name"])] = (tail, np.dtype(entry["dtype"]))
-    if not specs:
-        raise ValueError("signature carries no inputs")
-    return specs
-
-
-def zero_batch(specs: Mapping[str, tuple[tuple, Any]], rows: int) -> dict:
-    """An all-zeros batch of ``rows`` rows shaped by :func:`input_specs` —
-    the shape/dtype signature is what jit keys on, so a zero batch warms
-    exactly the compile a real batch of the same geometry would pay."""
-    return {name: np.zeros((int(rows), *tail), dtype)
-            for name, (tail, dtype) in specs.items()}
 
 
 def warm_buckets(fn, params, specs: Mapping[str, tuple[tuple, Any]],
@@ -215,13 +119,23 @@ def warm_buckets(fn, params, specs: Mapping[str, tuple[tuple, Any]],
     ``cache_key`` (the model-cache key the data plane will use), so the
     invariant *``serving_compiles_total`` == distinct jit keys* holds —
     warmup only moves the compiles off the first request's critical path.
-    Every warm forward is FORCED (leaves materialized): jax dispatch is
-    async, and an unforced warm would leave the compile racing the first
-    real batch."""
-    from tensorflowonspark_tpu import obs
+    The shapes warmed are exactly ``shapes.enumerate_signatures(specs,
+    buckets)`` — the one shape policy, so the data plane can add zero new
+    jit keys afterwards.  Every warm forward is FORCED (leaves
+    materialized): jax dispatch is async, and an unforced warm would
+    leave the compile racing the first real batch.
+
+    Warmup is also the persistent compile cache's designated seeding
+    path: :func:`compile_cache.ensure` runs first (so the warm compiles
+    read/write the configured cache dir) and a synchronous
+    :func:`compile_cache.sync` pushes the fresh entries to a shared-fs
+    namespace before the method returns — one replica warms, the fleet
+    loads."""
+    from tensorflowonspark_tpu import compile_cache, obs
 
     import time as _time
 
+    compile_cache.ensure()
     with obs.span("serving.warmup", buckets=list(buckets)):
         for b in buckets:
             batch = zero_batch(specs, b)
@@ -234,6 +148,7 @@ def warm_buckets(fn, params, specs: Mapping[str, tuple[tuple, Any]],
                 # forced forward: this wall is the real compile cost the
                 # warmup moved off the first request's critical path
                 observe_compile_seconds(_time.perf_counter() - t0)
+    compile_cache.sync()
 
 
 def _tree_leaves(tree):
@@ -274,10 +189,10 @@ def _compile_instruments():
                 "forward (jit compilation keys)"),
             obs.counter(
                 "serving_compile_cache_misses_total",
-                "shape signatures NEW to their forward — each one is a "
-                "fresh XLA compile (== serving_compiles_total today; the "
-                "persistent compile cache will split disk hits out of "
-                "these)"),
+                "shape signatures that paid a TRUE XLA compile (new to "
+                "their forward AND not served from the persistent "
+                "compile cache — disk hits ride "
+                "serving_compile_cache_disk_hits_total instead)"),
             obs.counter(
                 "serving_compile_cache_hits_total",
                 "batches whose shape signature was already compiled for "
@@ -290,23 +205,38 @@ def _compile_instruments():
     return _COMPILE_INSTRUMENTS
 
 
+#: per-thread pending first-call settlement: the disk-hit count snapshot
+#: taken when note_compile reported a fresh signature, resolved by
+#: observe_compile_seconds (or the next note_compile on the thread)
+_PENDING = threading.local()
+
+
 def note_compile(key: Any, batch: Mapping[str, Any]) -> bool:
     """Record the batch's shape signature; True when it is new for ``key``.
 
-    The signature — sorted ``(name, shape, dtype)`` per input — is exactly
-    what ``jax.jit`` keys its executable cache on, so for a jitted forward
-    "new signature" == "fresh XLA compile".  Every new signature increments
-    ``serving_compiles_total`` (and the hit/miss-shaped pair
-    ``serving_compile_cache_{hits,misses}_total`` — the counter groundwork
-    for the persistent compile cache, ROADMAP item 4), making the
+    The signature (``shapes.signature`` — the one policy module's
+    canonical (structure, shape, dtype) fingerprint) is exactly what
+    ``jax.jit`` keys its executable cache on, so for a jitted forward
+    "new signature" == "fresh XLA compile *or* persistent-cache load".
+    Every new signature increments ``serving_compiles_total``, making the
     bucketing claim ("compiles == buckets, not distinct tail sizes")
     measurable in tests, in ``bench.py --serving``, and on a live
-    ``/metrics`` endpoint.  Callers that can time the ensuing first-call
-    forward report its wall via :func:`observe_compile_seconds`."""
-    sig = tuple(sorted(
-        (str(name), tuple(np.shape(v)),
-         str(getattr(v, "dtype", type(v).__name__)))
-        for name, v in batch.items()))
+    ``/metrics`` endpoint.
+
+    The hit/miss split has a **disk dimension**: a first-call forward
+    served from the persistent compile cache is neither an in-process hit
+    (the signature WAS new to this process) nor a true miss (no XLA
+    compile ran) — it counts in ``serving_compile_cache_disk_hits_total``
+    and NOT in ``serving_compile_cache_misses_total``.  Since the disk
+    outcome is only known after the forward runs, a fresh signature
+    leaves a thread-local pending settlement that
+    :func:`observe_compile_seconds` (called by every data plane after the
+    first-call forward) resolves against ``compile_cache``'s thread-exact
+    disk-hit count; an abandoned pending (the forward raised, or a legacy
+    caller never timed it) settles conservatively as a true miss at the
+    thread's next ``note_compile``."""
+    _settle_pending(None)
+    sig = shapes.signature(batch)
     compiles, misses, hits, _ = _compile_instruments()
     seen = _SEEN_SHAPES.setdefault(key, set())
     if sig in seen:
@@ -314,15 +244,73 @@ def note_compile(key: Any, batch: Mapping[str, Any]) -> bool:
         return False
     seen.add(sig)
     compiles.inc()
-    misses.inc()
+    from tensorflowonspark_tpu import compile_cache
+
+    if compile_cache.active():
+        # the disk outcome is only knowable after the forward: leave a
+        # pending settlement for observe_compile_seconds
+        _PENDING.snapshot = compile_cache.thread_disk_hits()
+    else:
+        # no persistent cache in this process: a fresh signature IS a
+        # true miss, settled immediately (counter deltas stay exact for
+        # callers that never time their forwards)
+        misses.inc()
     return True
 
 
+def _settle_pending(observed: float | None) -> None:
+    """Resolve a thread's pending first-call as disk hit or true miss.
+
+    The comparison is thread-exact: jax's cache-hit monitoring event
+    fires synchronously on the compiling thread, so a disk-hit delta
+    since the snapshot means THIS thread's compile loaded from disk.
+    Only a true miss observes ``serving_compile_seconds`` — the disk
+    half is ``serving_compile_disk_seconds``, fed by the cache layer's
+    retrieval-time events."""
+    snap = getattr(_PENDING, "snapshot", None)
+    compiles, misses, hits, hist = _compile_instruments()
+    if snap is None:
+        if observed is not None:
+            # a timed wall with no pending note: legacy caller — keep the
+            # histogram observation (old observe_compile_seconds contract)
+            hist.observe(float(observed))
+        return
+    _PENDING.snapshot = None
+    from tensorflowonspark_tpu import compile_cache
+
+    if compile_cache.thread_disk_hits() > snap:
+        return  # disk hit: counted by the cache layer's event listener
+    misses.inc()
+    if observed is not None:
+        hist.observe(float(observed))
+
+
 def observe_compile_seconds(seconds: float) -> None:
-    """Record one compile's wall time (the first-call forward of a shape
-    signature :func:`note_compile` reported as new) into the
-    ``serving_compile_seconds`` histogram."""
-    _compile_instruments()[3].observe(float(seconds))
+    """Record one first-call forward's wall (a shape signature
+    :func:`note_compile` reported as new) and settle its pending
+    hit/miss/disk classification."""
+    _settle_pending(float(seconds))
+
+
+def cache_health() -> dict[str, Any]:
+    """The compile-cache block ``/healthz`` surfaces: persistent-cache
+    state + the in-process counters + a ``warm_ratio`` so a router can
+    see a cold replica (low ratio = shape requests are still paying
+    compiles; 1.0 = every request hit a warm executable).  ``warm_ratio``
+    counts disk hits as warm — that is the fleet cache doing its job."""
+    from tensorflowonspark_tpu import compile_cache
+
+    compiles, misses, hits, _ = _compile_instruments()
+    doc = compile_cache.stats()
+    warm = int(hits.value) + doc["disk_hits"]
+    total = warm + int(misses.value)
+    doc.update({
+        "compiles_total": int(compiles.value),
+        "in_process_hits": int(hits.value),
+        "true_misses": int(misses.value),
+        "warm_ratio": round(warm / total, 4) if total else None,
+    })
+    return doc
 
 
 #: padded-row fraction above which the bucket ladder is called bad

@@ -69,6 +69,14 @@ class Trainer:
         # whole constructor
         _t0_wall, _t0 = time.time(), time.perf_counter()
 
+        # persistent compile cache (TFOS_COMPILE_CACHE_DIR): configured
+        # BEFORE the init/step jit compiles below so a re-launched trainer
+        # fleet loads its executables from shared fs instead of re-paying
+        # XLA per process; an unconditional no-op when unconfigured
+        from tensorflowonspark_tpu import compile_cache
+
+        compile_cache.ensure()
+
         if isinstance(model, str):
             self.module_lib = model_zoo.get_model(model)
             self.model_name = model
@@ -360,19 +368,21 @@ class Trainer:
     @staticmethod
     def _batch_signature(batch):
         """Hashable fingerprint of a batch's full (structure, shape, dtype)
-        tree — the watchdog's warm-shape key.  Leaf dtypes are included and
-        non-dict batches key by their whole pytree (ADVICE r5: a dtype-only
-        change with identical shapes, or any reshape of a non-dict batch —
-        which the old key collapsed to one ``None`` — recompiles, and an
-        armed window across that compile would read minutes of XLA as a
-        wedge and ``os._exit`` a healthy trainer)."""
-        import jax
+        tree — the watchdog's warm-shape key, delegated to
+        ``shapes.signature`` (the ONE compile-triggering shape policy, so
+        the trainer's notion of "same compiled shape" can never drift
+        from the serving planes' or the warmup enumeration's).  Leaf
+        dtypes are included and non-dict batches key by their whole
+        pytree (ADVICE r5: a dtype-only change with identical shapes, or
+        any reshape of a non-dict batch — which the old key collapsed to
+        one ``None`` — recompiles, and an armed window across that
+        compile would read minutes of XLA as a wedge and ``os._exit`` a
+        healthy trainer).  ``portable=False``: the watchdog key is
+        in-process only, so it keys on the treedef OBJECT — type-exact
+        even for same-named custom pytree nodes."""
+        from tensorflowonspark_tpu import shapes
 
-        leaves, treedef = jax.tree_util.tree_flatten(batch)
-        return (treedef, tuple(
-            (tuple(getattr(leaf, "shape", ())),
-             str(getattr(leaf, "dtype", type(leaf).__name__)))
-            for leaf in leaves))
+        return shapes.signature(batch, portable=False)
 
     def _watchdogged_step(self, batch) -> float:
         """step() under the mid-run wedge watchdog: the loss is forced to
@@ -580,9 +590,11 @@ class Trainer:
 
 
 def _model_inputs(batch: dict) -> tuple:
-    """Positional model inputs from an example batch (labels stripped)."""
-    label_keys = {"label", "start_positions", "end_positions"}
-    return tuple(v for k, v in batch.items() if k not in label_keys)
+    """Positional model inputs from an example batch (labels stripped —
+    the shape-policy module's one label-key convention)."""
+    from tensorflowonspark_tpu import shapes
+
+    return tuple(v for k, v in batch.items() if k not in shapes.LABEL_KEYS)
 
 
 def _batch_examples(batch) -> int:

@@ -384,6 +384,42 @@ class TestOutageProofing(unittest.TestCase):
         self.assertIsNone(result["step_rows_per_sec"])
         self.assertIn("wall budget", result["step_reason"])
 
+    @pytest.mark.slow  # spawns 3 cold-start subprocesses
+    def test_compile_cache_microbench_small_config(self):
+        # ISSUE 13: second-process cold start through the REAL tenant
+        # load path (subprocess: OnlineServer.add_tenant(warmup=True) +
+        # one served request), A/B'd against a seeded cache dir.  Small
+        # model to stay affordable — no speedup floor here: at this size
+        # process startup dominates and the ratio is noise; the ≥2x
+        # claim is measured at the default geometry and judged in the
+        # artifact gate (BENCH_NOTES.md "Round 15").  What IS asserted:
+        # the seed wrote entries, the cached arm actually hit disk once
+        # per ladder bucket, and the schema is total.
+        sys.path.insert(0, os.path.dirname(BENCH))
+        import bench
+
+        out = bench.measure_compile_cache(layers=4, width=16,
+                                          batch_size=8,
+                                          bucket_sizes=[4, 8])
+        if out.get("coldstart_seconds") is None:
+            self.fail(f"coldstart nulled: {out.get('coldstart_reason')}")
+        self.assertGreater(out["coldstart_seconds"], 0.0)
+        self.assertGreater(out["coldstart_seconds_nocache"], 0.0)
+        self.assertEqual(out["coldstart_buckets"], [4, 8])
+        self.assertGreaterEqual(out["coldstart_disk_hits"], 2)
+        self.assertGreaterEqual(out["coldstart_disk_writes"], 2)
+        self.assertEqual(out["coldstart_host_cpus"], os.cpu_count())
+        self.assertEqual(out["coldstart_platform"], "cpu")
+
+    def test_compile_cache_stamp_is_total_on_exhausted_budget(self):
+        sys.path.insert(0, os.path.dirname(BENCH))
+        import bench
+
+        result = {}
+        bench._stamp_compile_cache(result, bench._Deadline(0.0))
+        self.assertIsNone(result["coldstart_seconds"])
+        self.assertIn("wall budget", result["coldstart_reason"])
+
     def test_mesh_stamp_is_total_on_exhausted_budget(self):
         sys.path.insert(0, os.path.dirname(BENCH))
         import bench

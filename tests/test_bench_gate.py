@@ -1103,11 +1103,12 @@ def test_step_overlap_frac_range_and_null_reason(tmp_path):
 
 
 def test_step_regression_within_device_count_identity_only(tmp_path):
-    # same identity: a halved bucketed throughput is a regression
+    # same identity: a halved bucketed throughput is a regression (r15+
+    # artifacts additionally owe the compile-cache fields — _r15)
     paths = [
         _write(tmp_path, "BENCH_r14.json", _r14()),
         _write(tmp_path, "BENCH_r15.json",
-               _r14(**_step_fields(rps=20000.0))),
+               _r15(**_step_fields(rps=20000.0))),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "fail"
@@ -1117,7 +1118,145 @@ def test_step_regression_within_device_count_identity_only(tmp_path):
     paths = [
         _write(tmp_path, "BENCH_r14.json", _r14()),
         _write(tmp_path, "BENCH_r15.json",
-               _r14(**_step_fields(rps=20000.0, step_devices=2))),
+               _r15(**_step_fields(rps=20000.0, step_devices=2))),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "pass", verdict["reasons"]
+
+
+# -- persistent compile cache cold-start (ISSUE 13) --------------------------
+
+
+def _coldstart_fields(seconds=1.28, nocache=4.11, **extra):
+    fields = {"coldstart_seconds": seconds,
+              "coldstart_seconds_nocache": nocache,
+              "coldstart_speedup": (round(nocache / seconds, 3)
+                                    if seconds and nocache else None),
+              "coldstart_disk_hits": 4, "coldstart_disk_writes": 4,
+              "coldstart_compiles": 4,
+              "coldstart_platform": "cpu", "coldstart_layers": 96,
+              "coldstart_width": 256, "coldstart_batch_size": 128,
+              "coldstart_buckets": [16, 32, 64, 128],
+              "coldstart_host_cpus": 1}
+    fields.update(extra)
+    return fields
+
+
+def _r15(**extra):
+    """A round-15-complete primary half: r14 + the compile-cache A/B."""
+    half = _r14(**_coldstart_fields())
+    half.update(extra)
+    return half
+
+
+def test_coldstart_field_required_on_primary_from_round_15(tmp_path):
+    # round 14: grandfathered — no cold-start A/B owed
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r14.json", _r14())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # round 15+: the primary must carry it (or explicit null + reason)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r15.json", _r14())])
+    assert verdict["verdict"] == "fail"
+    assert any("coldstart_seconds" in r for r in verdict["reasons"])
+    # complete round 15 passes
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r15.json", _r15())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # explicit null + reason satisfies (e.g. ineligible backend)
+    half = _r14(coldstart_seconds=None,
+                coldstart_reason="seed process wrote no persistent-cache "
+                                 "entries: backend cannot serialize "
+                                 "executables")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r15.json", half)])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # bare null does not
+    half = _r14(coldstart_seconds=None)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r15.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("coldstart_reason" in r for r in verdict["reasons"])
+
+
+def test_coldstart_value_without_config_identity_fails(tmp_path):
+    half = _r15()
+    del half["coldstart_buckets"]  # the ladder: number of warm compiles
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r15.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("config identity" in r and "coldstart_buckets" in r
+               for r in verdict["reasons"])
+
+
+def test_coldstart_value_without_nocache_partner_fails(tmp_path):
+    half = _r15()
+    del half["coldstart_seconds_nocache"]
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r15.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("coldstart_seconds_nocache" in r
+               for r in verdict["reasons"])
+
+
+def test_coldstart_value_without_disk_hits_fails(tmp_path):
+    """A 'cached' arm that took no disk hits measured process overhead,
+    not the cache — numeric seconds with zero hits fail the artifact."""
+    half = _r15(coldstart_disk_hits=0)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r15.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("took no disk hits" in r for r in verdict["reasons"])
+    half = _r15()
+    del half["coldstart_disk_hits"]
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r15.json", half)])
+    assert verdict["verdict"] == "fail"
+
+
+def test_coldstart_regression_is_lower_is_better(tmp_path):
+    # cold start DOUBLED within one config identity: that is the
+    # regression this gate exists to catch (a broken cache reads as a
+    # slower second process, not an error)
+    paths = [
+        _write(tmp_path, "BENCH_r15.json", _r15()),
+        _write(tmp_path, "BENCH_r16.json",
+               _r15(**_coldstart_fields(seconds=2.9))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("cold start slowed" in r for r in verdict["reasons"])
+    # and a FASTER cold start passes (lower is better, not different)
+    paths = [
+        _write(tmp_path, "BENCH_r15.json", _r15()),
+        _write(tmp_path, "BENCH_r16.json",
+               _r15(**_coldstart_fields(seconds=0.9))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+
+
+def test_coldstart_not_compared_across_configs(tmp_path):
+    # a different ladder (more warm compiles) is a different experiment
+    paths = [
+        _write(tmp_path, "BENCH_r15.json", _r15()),
+        _write(tmp_path, "BENCH_r16.json",
+               _r15(**_coldstart_fields(seconds=2.9,
+                                        coldstart_buckets=[128]))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # so is a different host CPU count (XLA compile is CPU-bound)
+    paths = [
+        _write(tmp_path, "BENCH_r15.json", _r15()),
+        _write(tmp_path, "BENCH_r16.json",
+               _r15(**_coldstart_fields(seconds=2.9,
+                                        coldstart_host_cpus=8))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+
+
+def test_coldstart_judged_even_on_degraded_newest(tmp_path):
+    """Host-side CPU subprocesses: a degraded accelerator half still
+    measured the real cold-start path, so its number stays gated."""
+    paths = [
+        _write(tmp_path, "BENCH_r15.json", _r15()),
+        _write(tmp_path, "BENCH_r16.json",
+               _r15(**_coldstart_fields(seconds=2.9),
+                    degraded="accelerator unavailable: probe timeout")),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("cold start slowed" in r for r in verdict["reasons"])

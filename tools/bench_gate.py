@@ -82,7 +82,18 @@ diverged from the monolithic step is broken, not fast — the artifact
 FAILS), and ``allreduce_overlap_frac`` as a fraction in [-1, 1] (or
 explicit ``null`` + ``allreduce_overlap_reason`` when the delivered ICI
 bandwidth is unmeasurable); healthy numbers are regression-compared only
-within one step config identity.
+within one step config identity.  From round ``--require-coldstart-from``
+(default 15, the round that introduced the persistent compile cache) the
+primary half must carry ``coldstart_seconds`` — second-process cold
+start (fresh subprocess, real tenant load + ladder warmup, time to first
+served request) measured against a seeded ``TFOS_COMPILE_CACHE_DIR`` —
+or an explicit ``null`` + ``coldstart_reason``; a numeric value must
+ship its cache-off A/B partner ``coldstart_seconds_nocache``, a numeric
+``coldstart_disk_hits`` (a "cached" arm that never touched disk measured
+nothing), and its config identity (platform, model geometry, bucket
+ladder, host CPU count); cold start is a latency, so healthy numbers are
+regression-judged LOWER-is-better within one config identity, like
+``recovery_seconds``.
 
 Usage::
 
@@ -136,6 +147,10 @@ DEFAULT_REQUIRE_MESH_FROM = 13
 #: (``step_rows_per_sec``, introduced with bucketed, overlapped gradient
 #: collectives on the train-step path)
 DEFAULT_REQUIRE_STEP_FROM = 14
+#: first round whose primary half must carry the compile-cache cold-start
+#: A/B (``coldstart_seconds``, introduced with the persistent compile
+#: cache + shape-policy unification)
+DEFAULT_REQUIRE_COLDSTART_FROM = 15
 #: |stage_sum / wall - 1| beyond this fails the artifact: a breakdown that
 #: does not add up is decoration, not attribution
 DEFAULT_FLIGHT_TOLERANCE = 0.15
@@ -156,6 +171,14 @@ _ONLINE_KEY = "online_rows_per_sec"
 _TRACE_OVERHEAD_KEY = "trace_overhead_frac"
 _MESH_KEY = "mesh_rows_per_sec"
 _STEP_KEY = "step_rows_per_sec"
+_COLDSTART_KEY = "coldstart_seconds"
+#: the compile-cache cold-start's config identity: seconds to first
+#: served request are only comparable at the same platform, model
+#: geometry (compile cost), bucket ladder (number of warm compiles) and
+#: host CPU count (XLA compile is CPU-bound)
+_COLDSTART_IDENT_KEYS = ("coldstart_platform", "coldstart_layers",
+                         "coldstart_width", "coldstart_batch_size",
+                         "coldstart_buckets", "coldstart_host_cpus")
 #: the step-collectives A/B's config identity: bucketed-step rows/sec is
 #: only comparable at the same platform, DEVICE COUNT (the all-reduce
 #: world — a number with no interconnect to hide is a different
@@ -298,7 +321,8 @@ def validate_half(half: dict[str, Any], *,
                   require_online: bool = False,
                   require_trace: bool = False,
                   require_mesh: bool = False,
-                  require_step: bool = False) -> list[str]:
+                  require_step: bool = False,
+                  require_coldstart: bool = False) -> list[str]:
     """Schema problems of one measured result (a wrapper's half)."""
     problems = []
     for key in _REQUIRED_HALF_KEYS:
@@ -505,6 +529,41 @@ def validate_half(half: dict[str, Any], *,
                     f"'allreduce_overlap_frac' {ovf!r} is not a fraction "
                     "in [-1, 1] — it is 1 - exposed/ideal-serial comm "
                     "time")
+    # compile-cache cold-start A/B: host-side CPU subprocesses like the
+    # recovery microbench, so a degraded-accelerator round still owes it;
+    # null + 'coldstart_reason' always satisfies.  A numeric value must
+    # carry its cache-off partner, proof the cached arm actually hit disk,
+    # and its config identity
+    if require_coldstart or _COLDSTART_KEY in half:
+        if _COLDSTART_KEY not in half:
+            problems.append(
+                f"missing {_COLDSTART_KEY!r} (compile-cache cold-start "
+                "A/B is part of the schema from r15: measure it or stamp "
+                "an explicit null + 'coldstart_reason')")
+        elif half[_COLDSTART_KEY] is None and "coldstart_reason" not in half:
+            problems.append(
+                f"{_COLDSTART_KEY!r} is null without a 'coldstart_reason'")
+        elif isinstance(half.get(_COLDSTART_KEY), (int, float)):
+            missing = [k for k in _COLDSTART_IDENT_KEYS if k not in half]
+            if missing:
+                problems.append(
+                    f"{_COLDSTART_KEY!r} without its config identity "
+                    f"({', '.join(missing)}) — cold-start seconds are "
+                    "only comparable within one platform/geometry/"
+                    "ladder/CPU-count config")
+            if not isinstance(half.get("coldstart_seconds_nocache"),
+                              (int, float)):
+                problems.append(
+                    f"{_COLDSTART_KEY!r} without a numeric "
+                    "'coldstart_seconds_nocache' — the cached number is "
+                    "only meaningful against the cache-off cold start "
+                    "A/B'd in the same run")
+            hits = half.get("coldstart_disk_hits")
+            if not isinstance(hits, (int, float)) or hits <= 0:
+                problems.append(
+                    f"{_COLDSTART_KEY!r} with coldstart_disk_hits "
+                    f"{hits!r}: a 'cached' cold start that took no disk "
+                    "hits did not measure the cache")
     # request-tracing overhead: A/B-measured on the online path, so a
     # degraded-accelerator round still owes it; null + reason always
     # satisfies (e.g. TFOS_TRACE_REQUESTS=0 runs have no A to B against)
@@ -612,6 +671,17 @@ def _comparable_prior_step(artifacts: list[dict], newest: dict,
                                       _STEP_KEY, _STEP_IDENT_KEYS)
 
 
+def _comparable_prior_coldstart(artifacts: list[dict], newest: dict,
+                                half: dict) -> tuple[float, str] | None:
+    """Best (LOWEST — cold start is a latency) prior
+    ``coldstart_seconds`` under the same platform/geometry/ladder/CPU
+    config.  Host-side like the other microbenches: degraded-accelerator
+    priors still count."""
+    return _comparable_prior_hostside(artifacts, newest, half,
+                                      _COLDSTART_KEY,
+                                      _COLDSTART_IDENT_KEYS, better=min)
+
+
 def _comparable_prior_recovery(artifacts: list[dict], newest: dict,
                                half: dict) -> tuple[float, str] | None:
     """Best (i.e. LOWEST — recovery is a latency) prior
@@ -659,7 +729,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
          require_online_from: int = DEFAULT_REQUIRE_ONLINE_FROM,
          require_trace_from: int = DEFAULT_REQUIRE_TRACE_FROM,
          require_mesh_from: int = DEFAULT_REQUIRE_MESH_FROM,
-         require_step_from: int = DEFAULT_REQUIRE_STEP_FROM
+         require_step_from: int = DEFAULT_REQUIRE_STEP_FROM,
+         require_coldstart_from: int = DEFAULT_REQUIRE_COLDSTART_FROM
          ) -> dict[str, Any]:
     """Run the gate over a trajectory; returns the verdict document."""
     checks: list[dict[str, Any]] = []
@@ -709,6 +780,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                           and art["n"] >= require_mesh_from)
             require_st = (label == "primary"
                           and art["n"] >= require_step_from)
+            require_cs = (label == "primary"
+                          and art["n"] >= require_coldstart_from)
             for problem in validate_half(half, require_roofline=require_rf,
                                          require_feed=require_fd,
                                          require_serving=require_sv,
@@ -716,7 +789,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                                          require_online=require_on,
                                          require_trace=require_tr,
                                          require_mesh=require_ms,
-                                         require_step=require_st):
+                                         require_step=require_st,
+                                         require_coldstart=require_cs):
                 check(f"schema:{name}:{label}",
                       "fail" if is_newest else "warn", problem)
             # flight breakdowns ride the primary half with the microbench
@@ -840,6 +914,30 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                           f"{stval} is {round(stval / stprior[0], 4)}× "
                           f"best prior {stprior[0]} ({stprior[1]}) — the "
                           f"step path regressed below {threshold}")
+            # compile-cache cold start: host-side, judged before the
+            # degraded skip; LOWER is better (it is a latency), same
+            # contract as recovery_seconds
+            if isinstance(half.get(_COLDSTART_KEY), (int, float)):
+                cprior = _comparable_prior_coldstart(artifacts, newest,
+                                                     half)
+                csname = f"regression:{_COLDSTART_KEY}"
+                csval = float(half[_COLDSTART_KEY])
+                if cprior is None:
+                    check(csname, "pass",
+                          "no comparable prior cold-start measurement "
+                          "(same platform/geometry/ladder/CPU config) — "
+                          "nothing to regress against")
+                elif csval * threshold <= cprior[0]:
+                    check(csname, "pass",
+                          f"{csval}s vs best prior {cprior[0]}s "
+                          f"({cprior[1]}): ratio "
+                          f"{round(csval / cprior[0], 4)} ≤ "
+                          f"{round(1 / threshold, 4)}")
+                else:
+                    check(csname, "fail",
+                          f"{csval}s is {round(csval / cprior[0], 4)}× "
+                          f"the best prior {cprior[0]}s ({cprior[1]}) — "
+                          f"fleet cold start slowed beyond 1/{threshold}")
             # recovery microbench: host-side, judged before the degraded
             # skip too.  LOWER is better (it is a latency): the newest run
             # fails when it exceeds the best comparable prior by more than
@@ -952,6 +1050,8 @@ def main(argv: list[str] | None = None) -> int:
                    default=DEFAULT_REQUIRE_MESH_FROM)
     p.add_argument("--require-step-from", type=int,
                    default=DEFAULT_REQUIRE_STEP_FROM)
+    p.add_argument("--require-coldstart-from", type=int,
+                   default=DEFAULT_REQUIRE_COLDSTART_FROM)
     args = p.parse_args(argv)
     paths = args.paths or discover(args.repo)
     if not paths:
@@ -969,7 +1069,8 @@ def main(argv: list[str] | None = None) -> int:
                require_online_from=args.require_online_from,
                require_trace_from=args.require_trace_from,
                require_mesh_from=args.require_mesh_from,
-               require_step_from=args.require_step_from)
+               require_step_from=args.require_step_from,
+               require_coldstart_from=args.require_coldstart_from)
     print(json.dumps(doc))
     return 1 if doc["verdict"] == "fail" else 0
 

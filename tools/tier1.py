@@ -123,16 +123,22 @@ def run_file(path: str, timeout_s: float) -> dict:
     return record
 
 
-def load_times(path: str) -> dict[str, dict]:
-    """Per-file records from a committed ``TIER1_TIMES.json`` (empty when
-    missing/unreadable — budget mode then admits everything)."""
+def load_doc(path: str) -> dict:
+    """The committed ``TIER1_TIMES.json`` document (empty when missing or
+    unreadable)."""
     try:
         with open(path) as f:
             doc = json.load(f)
-        files = doc.get("files")
-        return files if isinstance(files, dict) else {}
+        return doc if isinstance(doc, dict) else {}
     except (OSError, ValueError):
         return {}
+
+
+def load_times(path: str) -> dict[str, dict]:
+    """Per-file records from a committed ``TIER1_TIMES.json`` (empty when
+    missing/unreadable — budget mode then admits everything)."""
+    files = load_doc(path).get("files")
+    return files if isinstance(files, dict) else {}
 
 
 def plan_budget(files: list[str], records: dict[str, dict],
@@ -203,7 +209,13 @@ def main(argv: list[str] | None = None) -> int:
             path = os.path.join(REPO, path)
         return os.path.relpath(path, REPO)
 
-    prior = load_times(args.out)
+    prior_doc = load_doc(args.out)
+    prior = prior_doc.get("files")
+    prior = prior if isinstance(prior, dict) else {}
+    # hand-recorded context (e.g. the infer_native startup-flake retry
+    # rate) survives re-sweeps: the timing DB is regenerated, the notes
+    # are curated
+    notes = prior_doc.get("notes") or {}
     not_fit: dict[str, float] = {}
     planned_s = 0.0
     if args.budget is not None:
@@ -247,6 +259,8 @@ def main(argv: list[str] | None = None) -> int:
         "python": sys.version.split()[0],
         "files": merged,
     }
+    if notes:
+        doc["notes"] = notes
     if args.budget is not None:
         doc["budget_s"] = args.budget
         doc["planned_s"] = round(planned_s, 1)
