@@ -253,6 +253,12 @@ def _parse_args(argv=None):
                         "scatter path vs N independent single-request "
                         "callers at the same p99 SLO (host-side, no "
                         "accelerator involved)")
+    p.add_argument("--serving-decode", action="store_true",
+                   help="measure the generative-decode tier: closed-loop "
+                        "aggregate tokens/sec through the continuous-"
+                        "batching engine (paged KV pool) vs sequential "
+                        "per-request decode, token-level output equality "
+                        "checked, TTFT/ITL p99 SLO-bound")
     p.add_argument("--serving-mesh", action="store_true",
                    help="measure the multi-host serving mesh: aggregate "
                         "closed-loop rows/sec of N replica PROCESSES "
@@ -1257,6 +1263,221 @@ def measure_serving_online(clients: int = 32, reqs_per_client: int = 100,
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def measure_serving_decode(clients: int = 6, reqs_per_client: int = 6,
+                           max_new_tokens: int = 24,
+                           prompt_len_lo: int = 8, prompt_len_hi: int = 24,
+                           max_seqs: int = 8, page_size: int = 8,
+                           ttft_slo_ms: float = 5000.0,
+                           itl_slo_ms: float = 1000.0,
+                           deadline: "_Deadline | None" = None) -> dict:
+    """Generative-decode microbench: closed-loop aggregate tokens/sec
+    through the REAL continuous-batching engine (admit/retire between
+    decode steps, paged KV pool) vs sequential per-request decode.
+
+    ``clients`` threads each run ``reqs_per_client`` generations
+    back-to-back (closed loop) against one live
+    :class:`tensorflowonspark_tpu.decode.DecodeEngine` — varied prompt
+    lengths (the ladder exercises more than one prefill bucket), greedy
+    decoding, tokens consumed as they stream.  The BASELINE is the same
+    requests run strictly one at a time through the same engine: same
+    jitted prefill/decode executables, same pool — isolating exactly the
+    scheduling claim (a decode step over S active slots costs ~one slot's
+    step on a dispatch-bound box, so interleaving S sequences multiplies
+    tokens per step-wall).  The baseline runs LAST so ambient drift (a
+    box warming up) biases against the claim.
+
+    Refused-to-stamp conditions: any token-level output mismatch between
+    the concurrent and sequential passes (``decode_output_equality:
+    "fail"`` + null numbers — the gate fails the artifact), a TTFT or
+    inter-token p99 over its SLO, any shed during a loop sized inside
+    the admission bound, leaked KV pages after either pass, or any jit
+    signature minted after warmup (the zero-new-signatures invariant —
+    a decode that recompiles mid-stream is the failure mode this tier
+    exists to prevent).
+
+    Host-side and CPU-capable like the other microbenches.  Also stamps
+    the ``"decode"`` flight plane's stage breakdown (``wait`` /
+    ``prefill`` / ``decode`` reconciling with the concurrent wall) and
+    the peak KV-pool occupancy.
+    """
+    import threading
+
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu import decode as decode_lib
+    from tensorflowonspark_tpu import serving
+    from tensorflowonspark_tpu.models import tinylm
+    from tensorflowonspark_tpu.obs import flight
+
+    config = tinylm.Config.tiny()
+    engine = decode_lib.DecodeEngine(
+        config, max_seqs=max_seqs, page_size=page_size,
+        max_len=config.max_len, max_prompt_len=prompt_len_hi,
+        ttft_slo_ms=ttft_slo_ms, itl_slo_ms=itl_slo_ms)
+    try:
+        engine.warmup()
+        engine.start()
+        enumerated = set(engine.enumerate_signatures())
+        n = clients * reqs_per_client
+        rng = np.random.default_rng(7)
+        lengths = [prompt_len_lo
+                   + int(i * (prompt_len_hi - prompt_len_lo)
+                         / max(1, n - 1)) for i in range(n)]
+        prompts = [rng.integers(0, config.vocab_size, size=(ln,)
+                                ).astype(np.int32) for ln in lengths]
+
+        def run_one(i: int) -> tuple[list[int], float, list[float]]:
+            t0 = time.perf_counter()
+            toks: list[int] = []
+            times: list[float] = []
+            for tok in engine.submit(prompts[i],
+                                     max_new_tokens=max_new_tokens
+                                     ).tokens(timeout=120.0):
+                toks.append(tok)
+                times.append(time.perf_counter())
+            ttft = times[0] - t0 if times else float("inf")
+            itls = [b - a for a, b in zip(times, times[1:])]
+            return toks, ttft, itls
+
+        shed_before = int(engine._shed_total.value)
+        rec = flight.recorder("decode")
+        rec.reset()
+
+        # concurrent pass FIRST (the baseline runs last so drift biases
+        # against the speedup claim)
+        conc: list = [None] * n
+        errs: list[str] = []
+
+        def client(ci: int) -> None:
+            try:
+                for k in range(reqs_per_client):
+                    i = ci * reqs_per_client + k
+                    conc[i] = run_one(i)
+            except Exception as e:
+                errs.append(f"client {ci}: {e!r}")
+
+        threads = [threading.Thread(target=client, args=(ci,), daemon=True)
+                   for ci in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300.0)
+        wall = time.perf_counter() - t0
+        if errs or any(t.is_alive() for t in threads):
+            raise RuntimeError("; ".join(errs[:3]) or
+                               "client thread(s) wedged past 300s")
+        breakdown = rec.breakdown(wall)
+        if engine.pool.used_pages:
+            raise RuntimeError(
+                f"{engine.pool.used_pages} KV pages leaked after the "
+                "concurrent pass")
+        shed = int(engine._shed_total.value) - shed_before
+        if shed:
+            raise RuntimeError(
+                f"{shed} request(s) shed during a closed loop sized "
+                "inside the admission bound — refusing to stamp")
+        peak_occupancy = round(
+            engine.pool.peak_used / (engine.num_pages - 1), 4)
+
+        ident = {
+            "decode_clients": clients,
+            "decode_requests": n,
+            "decode_max_new_tokens": max_new_tokens,
+            "decode_prompt_lens": [prompt_len_lo, prompt_len_hi],
+            "decode_model": (f"tiny_lm_d{config.dim}"
+                             f"L{config.n_layers}H{config.n_heads}"
+                             f"v{config.vocab_size}"),
+            "decode_page_size": page_size,
+            "decode_max_seqs": max_seqs,
+            "decode_num_pages": engine.num_pages,
+            "decode_prefill_buckets": list(engine.prefill_buckets),
+            "decode_ttft_slo_ms": ttft_slo_ms,
+            "decode_itl_slo_ms": itl_slo_ms,
+            "decode_devices": len(jax.devices()),
+            "decode_host_cpus": os.cpu_count(),
+        }
+
+        # sequential baseline: the same requests, one at a time, through
+        # the same engine (same executables, same pool).  Budget check
+        # first (the sibling microbenches' discipline): the baseline
+        # costs ~max_seqs× the concurrent wall, and a half-measured A/B
+        # stamped late delays every stamp after it
+        if deadline is not None \
+                and deadline.remaining() < max(30.0, 2 * max_seqs * wall):
+            return {
+                "decode_tokens_per_sec": None,
+                "decode_reason": (
+                    "wall budget exhausted after the concurrent pass "
+                    f"({deadline.remaining():.0f}s left); sequential "
+                    "baseline unmeasured"),
+                **ident,
+            }
+        t0 = time.perf_counter()
+        seq = [run_one(i) for i in range(n)]
+        uwall = time.perf_counter() - t0
+        if engine.pool.used_pages:
+            raise RuntimeError(
+                f"{engine.pool.used_pages} KV pages leaked after the "
+                "sequential pass")
+
+        seen = serving._SEEN_SHAPES.get(engine.cache_key, set())
+        if seen != enumerated:
+            raise RuntimeError(
+                f"steady-state decode minted {len(seen - enumerated)} jit "
+                "signature(s) beyond the warmup enumeration — sequence "
+                "growth is recompiling")
+
+        if [t for t, _, _ in conc] != [t for t, _, _ in seq]:
+            bad = sum(1 for a, b in zip(conc, seq) if a[0] != b[0])
+            return {
+                "decode_tokens_per_sec": None,
+                "decode_output_equality": "fail",
+                "decode_reason": (
+                    f"{bad}/{n} request(s) decoded different tokens "
+                    "concurrently vs sequentially: broken, not fast"),
+                **ident,
+            }
+        total_tokens = sum(len(t) for t, _, _ in conc)
+        ttfts = [ttft for _, ttft, _ in conc]
+        itls = [g for _, _, gs in conc for g in gs]
+        ttft_p99 = float(np.percentile(ttfts, 99)) * 1000
+        itl_p99 = (float(np.percentile(itls, 99)) * 1000 if itls else 0.0)
+        for name, p99, slo in (("TTFT", ttft_p99, ttft_slo_ms),
+                               ("inter-token", itl_p99, itl_slo_ms)):
+            if p99 > slo:
+                raise RuntimeError(
+                    f"{name} p99 {p99:.1f}ms misses the {slo}ms SLO — a "
+                    "tokens/sec claimed at an SLO it missed is not a "
+                    "measurement")
+        tps = total_tokens / wall
+        utps = total_tokens / uwall
+        return {
+            "decode_tokens_per_sec": round(tps, 1),
+            "decode_tokens_per_sec_sequential": round(utps, 1),
+            "decode_speedup": round(tps / utps, 2),
+            "decode_output_equality": "pass",
+            "decode_tokens_total": total_tokens,
+            "decode_ttft_ms_p50": round(
+                float(np.percentile(ttfts, 50)) * 1000, 3),
+            "decode_ttft_ms_p99": round(ttft_p99, 3),
+            "decode_itl_ms_p50": round(
+                (float(np.percentile(itls, 50)) * 1000 if itls else 0.0),
+                3),
+            "decode_itl_ms_p99": round(itl_p99, 3),
+            "decode_kv_occupancy_peak": peak_occupancy,
+            "decode_stage_breakdown": (breakdown if flight.enabled()
+                                       else None),
+            **({} if flight.enabled() else {
+                "decode_stage_breakdown_reason":
+                    "flight recorder disabled (TFOS_FLIGHT=0)"}),
+            **ident,
+        }
+    finally:
+        engine.stop()
+
+
 def measure_serving_mesh(replicas: int = 3, clients: int = 16,
                          reqs_per_client: int = 40,
                          feature_dim: int = 256, hidden_dim: int = 1024,
@@ -1803,6 +2024,33 @@ def _stamp_online(result: dict, deadline: _Deadline) -> None:
                 f"online serving microbench failed: {e!r}"[:200])
             result["trace_overhead_frac"] = None
             result["trace_overhead_reason"] = result["online_reason"]
+            sp.set(ok=False, error=str(e)[:200])
+
+
+def _stamp_decode(result: dict, deadline: _Deadline) -> None:
+    """Stamp the generative-decode microbench into the headline result.
+
+    Host-side like the other serving microbenches, so it runs on
+    accelerator-degraded rounds too.  The schema is total from r16:
+    failure or an exhausted wall budget stamps an explicit null +
+    ``decode_reason`` (``tools/bench_gate.py --require-decode-from``)."""
+    from tensorflowonspark_tpu import obs
+
+    if deadline.remaining() < 90:
+        result["decode_tokens_per_sec"] = None
+        result["decode_reason"] = ("wall budget exhausted before the "
+                                   "generative decode microbench")
+        return
+    with obs.span("bench.serving_decode") as sp:
+        try:
+            result.update(measure_serving_decode(deadline=deadline))
+            sp.set(ok=result.get("decode_tokens_per_sec") is not None,
+                   tokens_per_sec=result.get("decode_tokens_per_sec"),
+                   speedup=result.get("decode_speedup"))
+        except Exception as e:
+            result["decode_tokens_per_sec"] = None
+            result["decode_reason"] = (
+                f"generative decode microbench failed: {e!r}"[:200])
             sp.set(ok=False, error=str(e)[:200])
 
 
@@ -2767,6 +3015,16 @@ def main() -> None:
         print(json.dumps(result))
         return
 
+    if args.serving_decode:
+        # host-side generative-decode measurement: no accelerator, no
+        # probe
+        result = {"metric": "decode_tokens_per_sec", "unit": "tokens/sec"}
+        _stamp_decode(result, deadline)
+        result["value"] = result.get("decode_tokens_per_sec")
+        _write_trace_artifact(result)
+        print(json.dumps(result))
+        return
+
     if args.serving_mesh:
         # host-side multi-process mesh measurement: no accelerator, no
         # probe
@@ -2887,6 +3145,7 @@ def main() -> None:
     _stamp_feed_transport(result, deadline)
     _stamp_serving(result, deadline)
     _stamp_online(result, deadline)
+    _stamp_decode(result, deadline)
     _stamp_recovery(result, deadline)
     _stamp_mesh(result, deadline)
     _stamp_step_collectives(result, deadline)

@@ -1,0 +1,1082 @@
+"""Token-level continuous batching for generative decode, over a paged
+KV-cache pool.
+
+The online tier (:mod:`tensorflowonspark_tpu.online`) batches at REQUEST
+granularity — right for fixed-cost forwards, wrong for autoregressive
+models whose requests finish at different lengths: a request-batched
+decode holds every sequence until the longest one finishes, padding the
+device with dead slots.  This module schedules at TOKEN granularity (the
+Orca/vLLM discipline, ROADMAP item 3): the engine runs one batched
+decode step at a time over its active slots and the scheduler admits and
+retires requests *between steps* — the same engine-idle instinct the
+online coalescer applies one level up, pushed down into the generation
+loop.
+
+**Paged KV cache.**  Every sequence's K/V live in fixed-size PAGES
+allocated from one pre-sized device pool
+(``(layers, num_pages, page_size, heads, head_dim)`` per side, page 0
+reserved as the trash page); each slot owns a page *table* of physical
+page ids.  Memory is reserved page-granular at admission (worst case
+``ceil((prompt + max_new) / page_size)`` pages) and returned at
+retirement — the pool never grows, fragmentation cannot strand
+capacity, and a mid-stream disconnect frees exactly what it held
+(asserted leak-free in ``tests/test_decode.py``, the ``test_shm``
+pattern).
+
+**One-compile decode.**  All decode-step shapes are fixed by the
+(slot, page) geometry — ``tokens (S,)``, ``seq_lens (S,)``,
+``page_tables (S, P)`` — so sequence growth moves an integer, never a
+shape, and steady-state decode adds ZERO jit signatures after
+:meth:`DecodeEngine.warmup`.  Prefill pads prompts to the
+``shapes.prefill_buckets`` ladder (one compile per bucket), keyed
+through ``serving.note_compile`` like every other serving plane, so
+``compile counters == shapes`` stays assertable (the PR 13 invariant)
+and the fleet compile cache amortizes decode compiles too.
+
+**Phases are separate flight stages.**  ``prefill`` (prompt ingestion,
+one sequence per jit call) and ``decode`` (the batched token step)
+accumulate into the ``"decode"`` flight plane with their own verdicts
+(``prefill_bound`` / ``decode_bound``) — the two phases have different
+remedies (longer ladder / chunked prefill vs more slots per step), so
+one ``compute`` bucket would hide the one fact an operator needs.
+
+**Streaming + SLOs.**  Tokens stream to callers as they are produced
+(:class:`DecodeStream`; chunked HTTP via :class:`DecodeHTTPServer` on
+the keep-alive-safe ``obs/httpd`` streaming support).
+Time-to-first-token and inter-token latency are first-class SLO
+histograms (``decode_ttft_seconds`` / ``decode_itl_seconds``) plus
+tumbling-window p99s surfaced in the ``/healthz`` ``admission`` block's
+``slo`` sub-document — which the mesh router's global admission control
+consumes (a replica whose windowed TTFT/ITL p99 breaches its SLO sheds
+pre-hop, and the window clears when pressure does).  Armed requests
+carry per-token spans on their retained ``/debug/requests`` trace trees.
+
+Proof: ``bench.py --serving-decode`` drives a closed-loop multi-client
+generative workload through this engine vs sequential per-request
+decode, checks token-level output equality, and stamps
+``decode_tokens_per_sec{,_sequential}`` + the TTFT/ITL p99s; gated by
+``tools/bench_gate.py --require-decode-from 16``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json as _json
+import logging
+import queue as _queue_mod
+import threading
+import time
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+from tensorflowonspark_tpu.obs import trace as _trace
+from tensorflowonspark_tpu.online import Rejected, ShedWindow
+
+logger = logging.getLogger(__name__)
+
+#: TTFT histogram bounds (prefill + queueing: ms to seconds)
+TTFT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                1.0, 2.5, 5.0, 10.0, float("inf"))
+#: ITL histogram bounds (one decode step: sub-ms to a second)
+ITL_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+               0.25, 0.5, 1.0, float("inf"))
+
+#: default per-engine pending-request admission bounds (the byte bound
+#: follows the ``_ByteBoundedQueue`` convention: prompt payload bytes
+#: held from enqueue to admission; one oversize request admits when the
+#: queue is byte-empty)
+DEFAULT_MAX_PENDING_REQUESTS = 128
+DEFAULT_MAX_PENDING_MB = 8.0
+#: default latency SLOs (tail-retention + /healthz + the bench gate)
+DEFAULT_TTFT_SLO_MS = 2000.0
+DEFAULT_ITL_SLO_MS = 500.0
+#: tumbling window for the /healthz slo block's p99s — admission
+#: pressure NOW, not the lifetime histogram (the mesh router sheds on
+#: this, so it must clear when pressure clears)
+SLO_WINDOW_S = 60.0
+#: per-token spans listed on a retained trace before truncation
+_MAX_TOKEN_SPANS = 32
+
+_DONE = object()
+_ENGINE_SEQ = itertools.count(1)
+
+
+class PagedKVPool:
+    """Fixed-size page allocator over a pre-sized device buffer pair.
+
+    Page 0 is the TRASH page: never allocated, the target of every
+    unallocated page-table slot, so out-of-range writes (prompt padding,
+    inactive slots) land where nothing is ever read.  Allocation is
+    page-granular with worst-case reservation at admission — no
+    mid-flight preemption, no fragmentation (any free page serves any
+    sequence; the page table is the indirection).
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is the trash page)")
+        self.num_pages = int(num_pages)
+        self._free: list[int] = list(range(1, self.num_pages))
+        self.peak_used = 0
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise RuntimeError(
+                f"KV pool exhausted: need {n} pages, {len(self._free)} free")
+        pages = [self._free.pop() for _ in range(n)]
+        self.peak_used = max(self.peak_used, self.used_pages)
+        return pages
+
+    def free(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if not 1 <= p < self.num_pages:
+                raise ValueError(f"bad page id {p}")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+            self._free.append(p)
+
+
+class _DecodeRequest:
+    """One caller's generation: prompt in, streamed tokens out."""
+
+    __slots__ = ("prompt", "prompt_len", "max_new_tokens", "nbytes",
+                 "queue", "cancelled", "generated", "t_submit",
+                 "t_submit_wall", "t_admit", "t_last", "ttft_s",
+                 "max_itl_s", "error", "rt", "slot", "pages", "done")
+
+    def __init__(self, prompt: np.ndarray, max_new_tokens: int,
+                 rt: "_trace.RequestTrace | None"):
+        self.prompt = prompt
+        self.prompt_len = int(prompt.shape[0])
+        self.max_new_tokens = int(max_new_tokens)
+        self.nbytes = int(prompt.nbytes)
+        self.queue: _queue_mod.Queue = _queue_mod.Queue()
+        self.cancelled = False
+        self.generated = 0
+        self.t_submit = time.perf_counter()
+        self.t_submit_wall = time.time()
+        self.t_admit = 0.0
+        self.t_last = 0.0
+        self.ttft_s: float | None = None
+        self.max_itl_s = 0.0
+        self.error: BaseException | None = None
+        self.rt = rt
+        self.slot: int | None = None
+        self.pages: list[int] = []
+        self.done = False
+
+
+class DecodeStream:
+    """Caller-side handle: iterate tokens as they arrive, or collect.
+
+    ``cancel()`` mid-stream (the client-disconnect path) retires the
+    request at the next step boundary and returns its KV pages to the
+    pool — generation for everyone else is unaffected.
+    """
+
+    def __init__(self, req: _DecodeRequest):
+        self._req = req
+
+    @property
+    def trace_id(self) -> str | None:
+        return self._req.rt.ctx.trace_id if self._req.rt else None
+
+    def cancel(self) -> None:
+        self._req.cancelled = True
+
+    def __iter__(self):
+        return self.tokens()
+
+    def tokens(self, timeout: float = 60.0):
+        """Yield generated token ids; raises the engine's error on
+        failure, ``TimeoutError`` when no token arrives in ``timeout``."""
+        while True:
+            try:
+                item = self._req.queue.get(timeout=timeout)
+            except _queue_mod.Empty:
+                raise TimeoutError(
+                    f"no token within {timeout}s (engine overloaded or "
+                    "stopped?)") from None
+            if item is _DONE:
+                return
+            if isinstance(item, BaseException):
+                raise RuntimeError(f"decode failed: {item!r}") from item
+            yield item
+
+    def result(self, timeout: float = 120.0) -> list[int]:
+        """Block until generation completes; all tokens in order."""
+        deadline = time.perf_counter() + timeout
+        out: list[int] = []
+        for tok in self.tokens(timeout=timeout):
+            out.append(tok)
+            if time.perf_counter() > deadline:
+                self.cancel()
+                raise TimeoutError(f"generation exceeded {timeout}s")
+        return out
+
+
+class _LatencyWindow:
+    """Tumbling time-window latency samples → windowed quantiles.
+
+    The ``/healthz`` ``slo`` block's p99 source: bounded (time + count),
+    so a breach long past cannot keep a replica shed forever — the
+    stale-evidence trap the mesh admission design documents.  Callers
+    hold the engine lock.
+    """
+
+    __slots__ = ("window_s", "maxlen", "_samples")
+
+    def __init__(self, window_s: float = SLO_WINDOW_S, maxlen: int = 4096):
+        self.window_s = float(window_s)
+        self.maxlen = int(maxlen)
+        self._samples: list[tuple[float, float]] = []
+
+    def note(self, seconds: float, now: float | None = None) -> None:
+        now = time.time() if now is None else now
+        self._samples.append((now, float(seconds)))
+        if len(self._samples) > self.maxlen:
+            del self._samples[: len(self._samples) - self.maxlen]
+
+    def _trim(self, now: float) -> None:
+        cut = now - self.window_s
+        i = 0
+        for i, (ts, _) in enumerate(self._samples):
+            if ts >= cut:
+                break
+        else:
+            i = len(self._samples)
+        if i:
+            del self._samples[:i]
+
+    def quantile_ms(self, q: float, now: float | None = None
+                    ) -> float | None:
+        now = time.time() if now is None else now
+        self._trim(now)
+        if not self._samples:
+            return None
+        vals = sorted(v for _, v in self._samples)
+        idx = min(len(vals) - 1, int(q * len(vals)))
+        return round(vals[idx] * 1000, 3)
+
+    def count(self, now: float | None = None) -> int:
+        self._trim(time.time() if now is None else now)
+        return len(self._samples)
+
+
+class DecodeEngine:
+    """Continuous-batching generative decode engine (see module doc).
+
+    Lifecycle: construct (pools + jitted prefill/decode bound to the
+    fixed geometry) → :meth:`warmup` (compile every ladder shape; after
+    this, serving adds zero signatures) → :meth:`start` → concurrent
+    :meth:`submit` → :meth:`stop` (fails every in-flight request loudly;
+    all pages return to the pool).
+
+    Geometry: ``max_seqs`` decode slots per step; pages of ``page_size``
+    tokens; ``max_len`` total positions per sequence (prompt +
+    generation); the pool defaults to worst-case sizing (every slot at
+    ``max_len``) plus the trash page — operators trading memory for
+    admission throughput size ``num_pages`` down and rely on the
+    page-feasibility admission check (DEPLOY "KV-pool and decode
+    sizing").
+    """
+
+    def __init__(self, config=None, params=None, *,
+                 model_name: str = "tiny_lm",
+                 max_seqs: int = 8, page_size: int = 16,
+                 max_len: int | None = None,
+                 num_pages: int | None = None,
+                 max_prompt_len: int | None = None,
+                 prefill_bucket_sizes: Sequence[int] | None = None,
+                 eos_id: int | None = None,
+                 max_pending_requests: int = DEFAULT_MAX_PENDING_REQUESTS,
+                 max_pending_mb: float = DEFAULT_MAX_PENDING_MB,
+                 ttft_slo_ms: float = DEFAULT_TTFT_SLO_MS,
+                 itl_slo_ms: float = DEFAULT_ITL_SLO_MS,
+                 seed: int = 0):
+        import jax
+
+        from tensorflowonspark_tpu import obs, shapes, util
+        from tensorflowonspark_tpu.models import tinylm
+
+        util.ensure_jax_platform()
+        self.config = config or tinylm.Config.tiny()
+        self.model_name = model_name
+        self._params = (params if params is not None
+                        else tinylm.init_params(self.config, seed=seed))
+        self.max_seqs = int(max_seqs)
+        self.page_size = int(page_size)
+        self.max_len = int(max_len or self.config.max_len)
+        if self.max_len > self.config.max_len:
+            raise ValueError(
+                f"max_len {self.max_len} exceeds the model's positional "
+                f"capacity {self.config.max_len}")
+        self.pages_per_seq = -(-self.max_len // self.page_size)
+        self.num_pages = int(num_pages if num_pages is not None
+                             else 1 + self.max_seqs * self.pages_per_seq)
+        self.max_prompt_len = int(max_prompt_len or self.max_len // 2)
+        if self.max_prompt_len >= self.max_len:
+            raise ValueError("max_prompt_len must leave room to generate "
+                             f"({self.max_prompt_len} >= {self.max_len})")
+        self.prefill_buckets = (
+            tuple(sorted({int(b) for b in prefill_bucket_sizes}))
+            if prefill_bucket_sizes else
+            shapes.prefill_buckets(self.max_prompt_len, cap=self.max_len))
+        if self.prefill_buckets[-1] < self.max_prompt_len:
+            raise ValueError("prefill ladder does not cover "
+                             f"max_prompt_len {self.max_prompt_len}")
+        self.eos_id = eos_id
+        self.max_pending_requests = int(max_pending_requests)
+        self.max_pending_bytes = int(max_pending_mb * (1 << 20))
+        self.ttft_slo_s = float(ttft_slo_ms) / 1000.0
+        self.itl_slo_s = float(itl_slo_ms) / 1000.0
+
+        # the note_compile identity: one per engine INSTANCE — the jitted
+        # closures below are per-engine, so two engines with one shared
+        # key would claim compiles==jit-keys while each pays its own
+        self.cache_key = ("decode", model_name, self.max_seqs,
+                          self.page_size, self.pages_per_seq,
+                          self.prefill_buckets, next(_ENGINE_SEQ))
+
+        pool_shape = tinylm.kv_pool_shape(self.config, self.num_pages,
+                                          self.page_size)
+        self._kp = jax.numpy.zeros(pool_shape, jax.numpy.float32)
+        self._vp = jax.numpy.zeros(pool_shape, jax.numpy.float32)
+        #: bytes of the two pre-sized pools — fixed at init; the
+        #: zero-device-buffer-growth tests assert this never moves
+        self.kv_pool_bytes = 2 * int(np.prod(pool_shape)) * 4
+        self.pool = PagedKVPool(self.num_pages)
+
+        import functools
+
+        self._prefill_jit = jax.jit(functools.partial(
+            tinylm.prefill_fn, config=self.config,
+            page_size=self.page_size))
+        self._decode_jit = jax.jit(functools.partial(
+            tinylm.decode_fn, config=self.config,
+            page_size=self.page_size))
+
+        # host-side slot state, mutated between jit calls (fixed shapes:
+        # the arrays are reused, never reallocated)
+        S, P = self.max_seqs, self.pages_per_seq
+        self._tokens = np.zeros((S,), np.int32)
+        self._seq_lens = np.zeros((S,), np.int32)
+        self._ptables = np.zeros((S, P), np.int32)
+        self._slots: list[_DecodeRequest | None] = [None] * S
+        self._active = 0
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: list[_DecodeRequest] = []
+        self._pending_bytes = 0
+        self._started = False
+        self._stopped = False
+        self._thread: threading.Thread | None = None
+        self._warmed = False
+        self.shed_window = ShedWindow()
+        self._ttft_window = _LatencyWindow()
+        self._itl_window = _LatencyWindow()
+
+        self._requests_total = obs.counter(
+            "decode_requests_total", "generation requests admitted")
+        self._tokens_total = obs.counter(
+            "decode_tokens_total", "tokens generated and emitted")
+        self._shed_total = obs.counter(
+            "decode_shed_total",
+            "generation requests shed by admission control (explicit "
+            "429-style rejections, never silent drops)")
+        self._errors_total = obs.counter(
+            "decode_errors_total",
+            "engine step failures (every affected caller got the error)")
+        self._cancelled_total = obs.counter(
+            "decode_cancelled_total",
+            "generations cancelled mid-stream (client disconnects)")
+        self._ttft_hist = obs.histogram(
+            "decode_ttft_seconds",
+            "submit -> first generated token (queueing + prefill)",
+            buckets=TTFT_BUCKETS)
+        self._itl_hist = obs.histogram(
+            "decode_itl_seconds",
+            "gap between consecutive generated tokens (one decode step "
+            "plus scheduling)", buckets=ITL_BUCKETS)
+        self._active_g = obs.gauge(
+            "decode_active_seqs", "sequences occupying decode slots")
+        self._pending_g = obs.gauge(
+            "decode_pending_requests", "requests queued for admission")
+        self._pages_used_g = obs.gauge(
+            "decode_kv_pages_used", "KV pages currently allocated")
+        obs.gauge("decode_kv_pages_total",
+                  "allocatable KV pages (pool size minus the trash "
+                  "page)").set(self.num_pages - 1)
+        obs.gauge("decode_kv_pool_bytes",
+                  "bytes of the pre-sized device KV pools (fixed at "
+                  "engine init)").set(self.kv_pool_bytes)
+
+    # -- shape policy --------------------------------------------------------
+
+    def enumerate_signatures(self) -> list[tuple]:
+        """The complete signature set this engine's runtime requests:
+        one per prefill bucket plus exactly ONE for the decode step —
+        what :meth:`warmup` warms, and what steady-state serving must
+        not grow (asserted in tests via the ``note_compile`` seen-set)."""
+        return enumerate_signatures(
+            max_seqs=self.max_seqs, pages_per_seq=self.pages_per_seq,
+            prefill_buckets=self.prefill_buckets)
+
+    def warmup(self) -> None:
+        """Compile every ladder shape now: each prefill bucket (zero
+        tokens through the trash page — no allocation) and the decode
+        step.  Counted through ``serving.note_compile`` so compiles ==
+        jit keys holds, and run through the persistent compile cache's
+        designated seeding path semantics (first call pays, fleet
+        loads)."""
+        from tensorflowonspark_tpu import serving
+
+        perf = time.perf_counter
+        P = self.pages_per_seq
+        trash_row = np.zeros((P,), np.int32)
+        for b in self.prefill_buckets:
+            tokens = np.zeros((b,), np.int32)
+            plen = np.asarray(1, np.int32)
+            fresh = serving.note_compile(
+                self.cache_key, {"tokens": tokens, "prompt_len": plen})
+            t0 = perf()
+            nt, self._kp, self._vp = self._prefill_jit(
+                self._params, tokens, plen, self._kp, self._vp, trash_row)
+            int(nt)
+            if fresh:
+                serving.observe_compile_seconds(perf() - t0)
+        batch = {"tokens": self._tokens, "seq_lens": self._seq_lens,
+                 "page_tables": self._ptables}
+        fresh = serving.note_compile(self.cache_key, batch)
+        t0 = perf()
+        nts, self._kp, self._vp = self._decode_jit(
+            self._params, self._tokens, self._seq_lens, self._kp,
+            self._vp, self._ptables)
+        np.asarray(nts)
+        if fresh:
+            serving.observe_compile_seconds(perf() - t0)
+        self._warmed = True
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "DecodeEngine":
+        with self._cond:
+            if self._stopped:
+                raise RuntimeError("DecodeEngine is stopped")
+            if self._started:
+                return self
+            self._started = True
+        self._thread = threading.Thread(
+            target=self._loop, name="tfos-decode-engine", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Stop serving: every pending and in-flight generation fails
+        with an explicit error, every page returns to the pool."""
+        with self._cond:
+            if self._stopped:
+                return
+            self._stopped = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+        err = RuntimeError("decode engine stopped")
+        with self._cond:
+            pending, self._pending = self._pending, []
+            self._pending_bytes = 0
+        for req in pending:
+            self._finish(req, "error", err)
+        for s in range(self.max_seqs):
+            req = self._slots[s]
+            if req is not None:
+                self._retire(s, "error", err)
+        self._pending_g.set(0)
+        self._active_g.set(0)
+        self._pages_used_g.set(self.pool.used_pages)
+
+    # -- request path --------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int] | np.ndarray,
+               max_new_tokens: int = 16,
+               trace_ctx: "_trace.TraceContext | None" = None
+               ) -> DecodeStream:
+        """Queue one generation; returns a :class:`DecodeStream` whose
+        tokens arrive as the engine produces them.
+
+        Raises ``ValueError`` for malformed prompts (empty, over the
+        ladder, out-of-vocab ids, no room to generate) and
+        :class:`~tensorflowonspark_tpu.online.Rejected` when admission
+        control sheds (pending queue over its request or byte bound) —
+        shedding is loud by design, callers back off and retry.
+        """
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        plen = int(prompt.shape[0])
+        max_new_tokens = int(max_new_tokens)
+        if plen < 1:
+            raise ValueError("prompt must carry at least one token")
+        if plen > self.max_prompt_len:
+            raise ValueError(
+                f"prompt length {plen} exceeds max_prompt_len "
+                f"{self.max_prompt_len}")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if plen + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {plen} + max_new_tokens {max_new_tokens} "
+                f"exceeds max_len {self.max_len}")
+        need = -(-(plen + max_new_tokens) // self.page_size)
+        if need > self.num_pages - 1:
+            # a request the pool can NEVER satisfy must be refused here:
+            # admission is strict FIFO, so an unsatisfiable head would
+            # wedge the queue forever while /healthz still says serving
+            raise ValueError(
+                f"request needs {need} KV pages worst-case (prompt "
+                f"{plen} + max_new_tokens {max_new_tokens} at page_size "
+                f"{self.page_size}) but the pool holds "
+                f"{self.num_pages - 1} — size num_pages up or the "
+                "request down")
+        if prompt.min() < 0 or prompt.max() >= self.config.vocab_size:
+            raise ValueError(
+                f"prompt token ids must be in [0, "
+                f"{self.config.vocab_size})")
+
+        rt = None
+        if _trace.requests_enabled():
+            armed = trace_ctx is not None or _trace.arm_roll()
+            if armed:
+                rt = _trace.RequestTrace(
+                    "decode.request", ctx=trace_ctx,
+                    prompt_len=plen, max_new_tokens=max_new_tokens)
+        req = _DecodeRequest(prompt, max_new_tokens, rt)
+        with self._cond:
+            if not self._started or self._stopped:
+                raise RuntimeError("DecodeEngine is not serving "
+                                   "(start() it / already stopped)")
+            over_count = len(self._pending) >= self.max_pending_requests
+            over_bytes = (self._pending_bytes > 0
+                          and self._pending_bytes + req.nbytes
+                          > self.max_pending_bytes)
+            if over_count or over_bytes:
+                self.shed_window.note(shed=True)
+                self._shed_total.inc()
+                exc = Rejected(
+                    f"decode pending queue over its "
+                    f"{'request' if over_count else 'byte'} bound "
+                    f"({len(self._pending)} pending, "
+                    f"{self._pending_bytes} bytes); request shed — back "
+                    "off and retry",
+                    retry_after_s=max(0.05, self.itl_slo_s))
+            else:
+                exc = None
+                self._pending.append(req)
+                self._pending_bytes += req.nbytes
+                self.shed_window.note(shed=False)
+                self._requests_total.inc()
+                self._pending_g.inc()
+                self._cond.notify()
+        if exc is not None:
+            if rt is not None:
+                rt.add("admission", time.perf_counter() - req.t_submit,
+                       outcome="shed", pending=len(self._pending))
+                rt.finish(status="shed", error=str(exc)[:300])
+                _trace.get_trace_store().commit(rt, retain="shed")
+            raise exc
+        return DecodeStream(req)
+
+    # -- engine loop ---------------------------------------------------------
+
+    def _loop(self) -> None:
+        from tensorflowonspark_tpu.obs import flight
+
+        rec = flight.recorder("decode")
+        perf = time.perf_counter
+        while True:
+            wait_s = 0.0
+            admits: list[_DecodeRequest] = []
+            with self._cond:
+                if self._stopped:
+                    return
+                self._reap_cancelled_locked()
+                admits = self._admit_locked()
+                if not admits and not self._active:
+                    # idle: wait in SHORT slices, each committed as its
+                    # own flight record — one long accumulated wait
+                    # would commit after a bench recorder reset and
+                    # break the stage-sum/wall reconciliation the gate
+                    # enforces (a submit's notify ends the slice early;
+                    # the timeout bounds how long a pending-side cancel
+                    # can go unreaped)
+                    t0 = perf()
+                    self._cond.wait(timeout=0.05)
+                    wait_s = perf() - t0
+            if wait_s:
+                rec.add(wait=wait_s)
+                rec.commit()
+                continue
+            try:
+                # stage windows cover the WHOLE phase — jit call plus
+                # token delivery and retirement bookkeeping — so the
+                # plane's stage sum reconciles with the wall the gate
+                # checks it against
+                t0 = perf()
+                for req in admits:
+                    self._prefill_one(req)
+                t1 = perf()
+                prefill_s = t1 - t0
+                if self._active:
+                    self._decode_step()
+                decode_s = perf() - t1
+            except Exception as e:  # a broken step must not wedge callers
+                self._errors_total.inc()
+                logger.warning("decode engine step failed: %r", e)
+                self._fail_all(e)
+                continue
+            if prefill_s or decode_s:
+                rec.add(prefill=prefill_s, decode=decode_s)
+                rec.commit()
+            self._active_g.set(self._active)
+            self._pages_used_g.set(self.pool.used_pages)
+
+    def _pages_needed(self, req: _DecodeRequest) -> int:
+        return -(-(req.prompt_len + req.max_new_tokens) // self.page_size)
+
+    def _reap_cancelled_locked(self) -> None:
+        kept = []
+        for req in self._pending:
+            if req.cancelled:
+                self._pending_bytes -= req.nbytes
+                self._pending_g.dec()
+                self._cancelled_total.inc()
+                self._finish(req, "cancelled", None)
+            else:
+                kept.append(req)
+        self._pending = kept
+        for s in range(self.max_seqs):
+            req = self._slots[s]
+            if req is not None and req.cancelled:
+                self._cancelled_total.inc()
+                self._retire(s, "cancelled", None)
+
+    def _admit_locked(self) -> list[_DecodeRequest]:
+        """Pop admissible pending requests into free slots — strictly
+        FIFO (skipping the head for a smaller request behind it would
+        starve long prompts under sustained load)."""
+        admits: list[_DecodeRequest] = []
+        budget = self.pool.free_pages  # allocs happen later, in
+        # _prefill_one — the feasibility check must charge THIS batch's
+        # earlier admits or the second admission could over-commit
+        while self._pending and self._active + len(admits) < self.max_seqs:
+            req = self._pending[0]
+            need = self._pages_needed(req)
+            if need > budget:
+                break
+            budget -= need
+            self._pending.pop(0)
+            self._pending_bytes -= req.nbytes
+            self._pending_g.dec()
+            admits.append(req)
+        return admits
+
+    def _prefill_one(self, req: _DecodeRequest) -> None:
+        from tensorflowonspark_tpu import serving, shapes
+
+        perf = time.perf_counter
+        t0 = perf()
+        slot = self._slots.index(None)
+        pages = self.pool.alloc(self._pages_needed(req))
+        req.slot, req.pages = slot, pages
+        req.t_admit = t0
+        row = self._ptables[slot]
+        row[:] = 0
+        row[: len(pages)] = pages
+        bucket = shapes.choose_bucket(req.prompt_len, self.prefill_buckets)
+        padded = np.zeros((bucket,), np.int32)
+        padded[: req.prompt_len] = req.prompt
+        plen = np.asarray(req.prompt_len, np.int32)
+        fresh = serving.note_compile(
+            self.cache_key, {"tokens": padded, "prompt_len": plen})
+        nt, self._kp, self._vp = self._prefill_jit(
+            self._params, padded, plen, self._kp, self._vp, row)
+        tok = int(nt)
+        dt = perf() - t0
+        if fresh:
+            serving.observe_compile_seconds(dt)
+        if req.rt is not None:
+            req.rt.add("queue", req.t_admit - req.t_submit,
+                       pending_depth=len(self._pending))
+            req.rt.add("prefill", dt, bucket=bucket,
+                       prompt_len=req.prompt_len, pages=len(pages))
+        self._slots[slot] = req
+        self._active += 1
+        self._seq_lens[slot] = req.prompt_len
+        self._tokens[slot] = tok
+        self._emit(req, tok)
+        if req.generated >= req.max_new_tokens or (
+                self.eos_id is not None and tok == self.eos_id):
+            self._retire(slot, "ok", None)
+
+    def _decode_step(self) -> None:
+        from tensorflowonspark_tpu import serving
+
+        perf = time.perf_counter
+        t0 = perf()
+        batch = {"tokens": self._tokens, "seq_lens": self._seq_lens,
+                 "page_tables": self._ptables}
+        fresh = serving.note_compile(self.cache_key, batch)
+        nts, self._kp, self._vp = self._decode_jit(
+            self._params, self._tokens, self._seq_lens, self._kp,
+            self._vp, self._ptables)
+        nts_np = np.asarray(nts)
+        dt = perf() - t0
+        if fresh:
+            serving.observe_compile_seconds(dt)
+        for s in range(self.max_seqs):
+            req = self._slots[s]
+            if req is None:
+                continue
+            tok = int(nts_np[s])
+            self._seq_lens[s] += 1
+            self._tokens[s] = tok
+            self._emit(req, tok)
+            if req.generated >= req.max_new_tokens or (
+                    self.eos_id is not None and tok == self.eos_id):
+                self._retire(s, "ok", None)
+
+    def _emit(self, req: _DecodeRequest, tok: int) -> None:
+        now = time.perf_counter()
+        req.generated += 1
+        if req.ttft_s is None:
+            req.ttft_s = now - req.t_submit
+            self._ttft_hist.observe(req.ttft_s)
+            with self._lock:
+                self._ttft_window.note(req.ttft_s)
+        else:
+            itl = now - req.t_last
+            req.max_itl_s = max(req.max_itl_s, itl)
+            self._itl_hist.observe(itl)
+            with self._lock:
+                self._itl_window.note(itl)
+            if req.rt is not None and req.generated <= _MAX_TOKEN_SPANS:
+                req.rt.add("token", itl, index=req.generated - 1,
+                           itl_ms=round(itl * 1000, 3))
+        req.t_last = now
+        self._tokens_total.inc()
+        if not req.cancelled:
+            req.queue.put(tok)
+
+    def _retire(self, slot: int, status: str,
+                err: BaseException | None) -> None:
+        req = self._slots[slot]
+        self._slots[slot] = None
+        self._active -= 1
+        self._seq_lens[slot] = 0
+        self._tokens[slot] = 0
+        self._ptables[slot][:] = 0
+        if req.pages:
+            self.pool.free(req.pages)
+            req.pages = []
+        self._pages_used_g.set(self.pool.used_pages)
+        self._active_g.set(self._active)
+        self._finish(req, status, err)
+
+    def _finish(self, req: _DecodeRequest, status: str,
+                err: BaseException | None) -> None:
+        if req.done:
+            return
+        req.done = True
+        req.error = err
+        rt = req.rt
+        if rt is not None:
+            lat = time.perf_counter() - req.t_submit
+            rt.finish(status=status, tokens=req.generated,
+                      ttft_ms=(round(req.ttft_s * 1000, 3)
+                               if req.ttft_s is not None else None),
+                      latency_ms=round(lat * 1000, 3),
+                      **({"error": f"{type(err).__name__}: {err}"[:300]}
+                         if err else {}))
+            if status != "ok":
+                retain = status
+            elif ((req.ttft_s is not None
+                   and req.ttft_s > self.ttft_slo_s)
+                  or req.max_itl_s > self.itl_slo_s):
+                retain = "slo_breach"
+            else:
+                retain = None  # commit's own uniform-sample roll applies
+            _trace.get_trace_store().commit(rt, retain=retain)
+        req.queue.put(err if err is not None else _DONE)
+
+    def _fail_all(self, err: BaseException) -> None:
+        with self._cond:
+            pending, self._pending = self._pending, []
+            self._pending_bytes = 0
+        for req in pending:
+            self._pending_g.dec()
+            self._finish(req, "error", err)
+        for s in range(self.max_seqs):
+            if self._slots[s] is not None:
+                self._retire(s, "error", err)
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        if self._stopped:
+            return "stopped"
+        return "serving" if self._started else "created"
+
+    def slo_snapshot(self, now: float | None = None) -> dict[str, Any]:
+        """The windowed-latency ``slo`` block: TTFT/ITL p99 over the
+        last ``SLO_WINDOW_S`` seconds against their SLOs — what the mesh
+        router's admission check reads (windowed, so it CLEARS when
+        pressure does; the lifetime histograms stay on /metrics)."""
+        with self._lock:
+            return {
+                "ttft_p99_ms": self._ttft_window.quantile_ms(0.99, now),
+                "itl_p99_ms": self._itl_window.quantile_ms(0.99, now),
+                "ttft_slo_ms": round(self.ttft_slo_s * 1000, 3),
+                "itl_slo_ms": round(self.itl_slo_s * 1000, 3),
+                "window_s": SLO_WINDOW_S,
+                "samples": self._ttft_window.count(now),
+                "itl_samples": self._itl_window.count(now),
+            }
+
+    def stats(self) -> dict[str, Any]:
+        """JSON-able engine state (the ``/healthz`` body).  The
+        ``admission`` block follows the online tier's versioned schema
+        (the mesh router consumes it unchanged) plus the decode-specific
+        ``slo`` sub-document."""
+        with self._lock:
+            pending = len(self._pending)
+            pending_bytes = self._pending_bytes
+            window = self.shed_window.snapshot()
+        slo = self.slo_snapshot()
+        used = self.pool.used_pages
+        total = self.num_pages - 1
+        return {
+            "state": self.state,
+            "engine": {
+                "model": self.model_name,
+                "max_seqs": self.max_seqs,
+                "active_seqs": self._active,
+                "page_size": self.page_size,
+                "kv_pages_used": used,
+                "kv_pages_total": total,
+                "kv_pages_peak": self.pool.peak_used,
+                "kv_occupancy": round(used / total, 4) if total else 0.0,
+                "kv_pool_bytes": self.kv_pool_bytes,
+                "prefill_buckets": list(self.prefill_buckets),
+                "max_len": self.max_len,
+                "max_prompt_len": self.max_prompt_len,
+                "warmed": self._warmed,
+            },
+            "slo": slo,
+            "admission": {
+                "admission_schema": 1,
+                "pending_bytes": pending_bytes,
+                "pending_rows": pending,
+                "max_pending_bytes": self.max_pending_bytes,
+                "saturation": (round(pending_bytes
+                                     / self.max_pending_bytes, 4)
+                               if self.max_pending_bytes else 0.0),
+                "shed_window": window,
+                "slo": slo,
+            },
+            "requests_total": int(self._requests_total.value),
+            "tokens_total": int(self._tokens_total.value),
+            "shed_total": int(self._shed_total.value),
+            "errors_total": int(self._errors_total.value),
+            "cancelled_total": int(self._cancelled_total.value),
+        }
+
+
+def enumerate_signatures(*, max_seqs: int, pages_per_seq: int,
+                         prefill_buckets: Sequence[int]) -> list[tuple]:
+    """The decode tier's complete compile-shape set, from geometry alone
+    (no engine, no params): one prefill signature per ladder bucket plus
+    exactly one decode-step signature.  Signed through
+    ``shapes.signature`` on ``ShapeDtypeStruct`` specs — identical to
+    what the runtime hands ``serving.note_compile``, which is the
+    zero-new-signatures test's whole claim."""
+    import jax
+
+    from tensorflowonspark_tpu import shapes
+
+    i32 = np.dtype(np.int32)
+    sigs = []
+    for b in prefill_buckets:
+        sigs.append(shapes.signature({
+            "tokens": jax.ShapeDtypeStruct((int(b),), i32),
+            "prompt_len": jax.ShapeDtypeStruct((), i32)}))
+    sigs.append(shapes.signature({
+        "tokens": jax.ShapeDtypeStruct((int(max_seqs),), i32),
+        "seq_lens": jax.ShapeDtypeStruct((int(max_seqs),), i32),
+        "page_tables": jax.ShapeDtypeStruct(
+            (int(max_seqs), int(pages_per_seq)), i32)}))
+    return sigs
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end (obs/httpd pattern; token streaming over chunked replies)
+# ---------------------------------------------------------------------------
+
+
+class DecodeHTTPServer:
+    """Stdlib HTTP front end over a :class:`DecodeEngine`.
+
+    - ``POST /v1/generate`` — body ``{"prompt": [ids],
+      "max_new_tokens": n, "stream": bool?, "timeout_s": float?}``.
+      With ``stream`` (the default) the reply is newline-delimited JSON
+      over ``Transfer-Encoding: chunked`` — one ``{"token": id,
+      "index": i}`` line per generated token as it is produced, then a
+      terminal ``{"done": true, "tokens": [...], "n": n}`` line — riding
+      the keep-alive-safe streaming support in ``obs/httpd``.  Without
+      it, one JSON document after generation completes.  Admission shed
+      → **429** + ``Retry-After``; malformed → 400; token timeout → 504.
+      A W3C ``traceparent`` header joins the caller's trace (per-token
+      spans on the retained tree).
+    - ``GET /metrics`` / ``/healthz`` / ``/pipeline`` /
+      ``/debug/requests`` — the standard per-process views; ``/healthz``
+      carries the ``admission`` block (with the windowed TTFT/ITL
+      ``slo`` sub-document the mesh router sheds on) and is 200 only
+      while serving.
+    """
+
+    def __init__(self, engine: DecodeEngine, host: str = "127.0.0.1",
+                 port: int = 0):
+        from tensorflowonspark_tpu import obs
+        from tensorflowonspark_tpu.obs import flight
+        from tensorflowonspark_tpu.obs import httpd as _httpd
+
+        self._engine = engine
+
+        def metrics():
+            return (200, _httpd.PROMETHEUS_CONTENT_TYPE,
+                    obs.get_registry().to_prometheus())
+
+        def healthz():
+            doc = engine.stats()
+            return (200 if doc["state"] == "serving" else 503,
+                    "application/json", _json.dumps(doc))
+
+        def pipeline():
+            return (200, "application/json", _json.dumps(
+                {"planes": flight.local_report(),
+                 "server": engine.stats()}))
+
+        def debug_requests():
+            return (200, "application/json",
+                    _json.dumps(_trace.get_trace_store().to_doc()))
+
+        self._server = _httpd.ObservabilityServer(
+            routes={"/metrics": metrics, "/healthz": healthz,
+                    "/pipeline": pipeline,
+                    "/debug/requests": debug_requests},
+            host=host, port=port,
+            post_routes={"/v1/generate": self._generate})
+
+    def _generate(self, body: bytes, headers) -> tuple:
+        import math
+
+        engine = self._engine
+        try:
+            doc = _json.loads(body or b"{}")
+            prompt = doc.get("prompt")
+            if not isinstance(prompt, list) or not prompt:
+                raise ValueError("body must carry a non-empty 'prompt' "
+                                 "list of token ids")
+            max_new = int(doc.get("max_new_tokens", 16))
+            stream = bool(doc.get("stream", True))
+            timeout = min(float(doc.get("timeout_s", 60.0)), 300.0)
+            ctx = _trace.parse_traceparent(headers.get("traceparent"))
+            handle = engine.submit(prompt, max_new_tokens=max_new,
+                                   trace_ctx=ctx)
+        except Rejected as e:
+            return (429, "application/json",
+                    _json.dumps({"error": str(e),
+                                 "retry_after_s": e.retry_after_s}),
+                    {"Retry-After": str(max(1,
+                                            math.ceil(e.retry_after_s)))})
+        except (ValueError, TypeError) as e:
+            return (400, "application/json",
+                    _json.dumps({"error": str(e)}))
+        except RuntimeError as e:
+            return (503, "application/json",
+                    _json.dumps({"error": str(e)}))
+        trace_id = handle.trace_id
+        if not stream:
+            try:
+                tokens = handle.result(timeout=timeout)
+            except TimeoutError as e:
+                # the caller stopped waiting: cancel so the generation
+                # does not keep a slot + pages busy for nobody (the
+                # streaming path does the same on its error line)
+                handle.cancel()
+                return (504, "application/json",
+                        _json.dumps({"error": str(e)}))
+            except RuntimeError as e:
+                return (500, "application/json",
+                        _json.dumps({"error": str(e)}))
+            out = {"tokens": tokens, "n": len(tokens)}
+            if trace_id:
+                out["trace_id"] = trace_id
+            return (200, "application/json", _json.dumps(out))
+
+        def ndjson():
+            tokens: list[int] = []
+            try:
+                for tok in handle.tokens(timeout=timeout):
+                    tokens.append(tok)
+                    yield _json.dumps({"token": tok,
+                                       "index": len(tokens) - 1}) + "\n"
+            except (TimeoutError, RuntimeError) as e:
+                # headers are long gone: the error rides the stream as
+                # its final line (the transport stays framed; the
+                # caller sees an explicit failure, not a truncation)
+                handle.cancel()
+                yield _json.dumps({"error": str(e),
+                                   "tokens": tokens}) + "\n"
+                return
+            except GeneratorExit:
+                # the transport died mid-stream (client disconnect, via
+                # the streaming reply closing its body iterator): stop
+                # paying for tokens nobody will read — the slot retires
+                # at the next step boundary and its pages return
+                handle.cancel()
+                raise
+            done = {"done": True, "tokens": tokens, "n": len(tokens)}
+            if trace_id:
+                done["trace_id"] = trace_id
+            yield _json.dumps(done) + "\n"
+
+        return (200, "application/x-ndjson", ndjson())
+
+    def start(self) -> tuple[str, int]:
+        return self._server.start()
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._server.address
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def url(self, path: str = "/") -> str:
+        return self._server.url(path)
+
+    def stop(self) -> None:
+        self._server.stop()

@@ -114,8 +114,29 @@ DEFAULT_REPLICA_CAPACITY_MB = 256.0
 #: consecutive failed health polls before a replica is declared lost
 DEFAULT_FAIL_AFTER = 3
 #: health snapshots older than this fail OPEN at admission (forward the
-#: request rather than shed on stale evidence)
+#: request rather than shed on stale evidence).  Overridable via
+#: ``TFOS_MESH_HEALTH_STALE_S``: replicas with long step times between
+#: health polls — a generative decode replica mid-batch answers its
+#: health poll late by one decode step — must not be judged stale on a
+#: window sized for sub-ms forwards (DEPLOY "Mesh sizing")
 DEFAULT_HEALTH_STALE_S = 5.0
+
+
+def health_stale_default() -> float:
+    """The effective default staleness window: the env override when set
+    (and parseable, positive), else :data:`DEFAULT_HEALTH_STALE_S`."""
+    raw = os.environ.get("TFOS_MESH_HEALTH_STALE_S", "").strip()
+    if raw:
+        try:
+            v = float(raw)
+            if v > 0:
+                return v
+            logger.warning("TFOS_MESH_HEALTH_STALE_S=%r not positive; "
+                           "using default %s", raw, DEFAULT_HEALTH_STALE_S)
+        except ValueError:
+            logger.warning("TFOS_MESH_HEALTH_STALE_S=%r unparseable; "
+                           "using default %s", raw, DEFAULT_HEALTH_STALE_S)
+    return DEFAULT_HEALTH_STALE_S
 #: window shed rate at/over which the router sheds pre-hop — corroborated
 #: by byte-bound saturation ≥ 0.5 so a long-tail window alone cannot keep
 #: shedding after pressure cleared
@@ -270,7 +291,7 @@ class MeshRouter:
                  replica_capacity_mb: float = DEFAULT_REPLICA_CAPACITY_MB,
                  poll_interval: float = 1.0,
                  fail_after: int = DEFAULT_FAIL_AFTER,
-                 health_stale_s: float = DEFAULT_HEALTH_STALE_S,
+                 health_stale_s: float | None = None,
                  shed_rate_threshold: float = DEFAULT_SHED_RATE_THRESHOLD,
                  shed_min_offered: int = DEFAULT_SHED_MIN_OFFERED,
                  regroup_timeout: float = 60.0, max_regroups: int = 8,
@@ -280,7 +301,12 @@ class MeshRouter:
         self.capacity_bytes = int(replica_capacity_mb * (1 << 20))
         self.poll_interval = float(poll_interval)
         self.fail_after = int(fail_after)
-        self.health_stale_s = float(health_stale_s)
+        # explicit argument wins; else TFOS_MESH_HEALTH_STALE_S; else the
+        # built-in default — so decode replicas with longer step times
+        # can widen the fail-open window without a code change
+        self.health_stale_s = (float(health_stale_s)
+                               if health_stale_s is not None
+                               else health_stale_default())
         self.shed_rate_threshold = float(shed_rate_threshold)
         self.shed_min_offered = int(shed_min_offered)
         self.regroup_timeout = float(regroup_timeout)
@@ -850,6 +876,33 @@ class MeshRouter:
                     f"{w['shed_rate']} over its last {w.get('window_s')}s "
                     f"window (byte bound {round(saturation, 2)} "
                     "saturated)")
+        # generative decode replicas publish a WINDOWED latency-SLO
+        # sub-document (TTFT / inter-token p99 over the last window):
+        # a replica whose recent tail breaches its own SLO is overloaded
+        # in the one dimension a byte bound cannot see (tokens in flight,
+        # not bytes queued).  The window is tumbling on the replica side,
+        # so this verdict clears when pressure does — the same
+        # no-stale-evidence discipline as the shed-rate corroboration.
+        slo = block.get("slo")
+        if isinstance(slo, dict):
+            for kind in ("ttft", "itl"):
+                # per-kind evidence floor: one long generation yields ONE
+                # ttft sample but hundreds of itl samples — gating the
+                # itl verdict on the ttft count would ignore a tail
+                # backed by plenty of real evidence (and vice versa)
+                n = (slo.get("itl_samples", slo.get("samples", 0))
+                     if kind == "itl" else slo.get("samples", 0))
+                if not isinstance(n, (int, float)) \
+                        or n < self.shed_min_offered:
+                    continue
+                p99 = slo.get(f"{kind}_p99_ms")
+                bound = slo.get(f"{kind}_slo_ms")
+                if (isinstance(p99, (int, float))
+                        and isinstance(bound, (int, float)) and bound > 0
+                        and p99 > bound):
+                    return (f"replica {replica.id} {kind} p99 {p99}ms "
+                            f"over its {bound}ms SLO across the last "
+                            f"{slo.get('window_s')}s window")
         return None
 
     def _proxy(self, replica: _Replica, path: str, body: bytes,

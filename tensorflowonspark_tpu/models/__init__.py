@@ -28,6 +28,7 @@ _REGISTRY = {
     "mobilenet_v1": "tensorflowonspark_tpu.models.mobilenet",
     "wide_deep": "tensorflowonspark_tpu.models.widedeep",
     "bert": "tensorflowonspark_tpu.models.bert",
+    "tiny_lm": "tensorflowonspark_tpu.models.tinylm",
 }
 
 

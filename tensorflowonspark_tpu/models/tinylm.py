@@ -1,0 +1,315 @@
+"""Small causal decoder LM — the generative-decode demo workload.
+
+The zoo's other entries are fixed-cost forwards (one batch in, one batch
+out); this one exists for the workload class they cannot represent:
+autoregressive generation, where every served request RUNS A LOOP and
+requests finish at different lengths (the Orca/vLLM regime the decode
+tier, :mod:`tensorflowonspark_tpu.decode`, schedules at token
+granularity).
+
+Two API layers over ONE set of weights:
+
+- the standard zoo surface (``Config`` / ``make_model`` /
+  ``make_loss_fn`` / ``make_forward_fn`` / ``example_batch``) — a flax
+  module whose ``__call__`` is the full teacher-forced forward
+  (``(B, T) tokens → (B, T, V) logits``), trained with next-token
+  cross-entropy, so the model rides ``Trainer`` / export / serving like
+  every other entry;
+- the **incremental decode surface** (:func:`prefill_fn` /
+  :func:`decode_fn`) — pure functions over the SAME flat param dict,
+  reading/writing a *paged* KV cache: K/V live in a pooled buffer of
+  fixed-size pages (``(layers, num_pages, page_size, heads, head_dim)``)
+  and each sequence owns a page TABLE (physical page ids), so attention
+  gathers its own pages regardless of where they sit in the pool.  All
+  shapes are fixed by the (slot, page) geometry — sequence growth moves
+  an int in ``seq_lens``, never a shape — which is what lets the decode
+  step compile exactly once (the decode tier's zero-new-signatures
+  invariant, same discipline as the PR 5 bucket ladder).
+
+Page 0 of the pool is the TRASH page by convention: a page-table slot
+that was never allocated reads 0, so out-of-range writes (prompt padding
+beyond the allocated pages, inactive decode slots) land in a page whose
+content is never read — attention masks positions ``>= seq_len`` before
+any gathered value can matter.
+
+The params are registered with ``self.param`` directly (no nn.Dense
+nesting), so the flax variable tree is a FLAT dict the pure decode
+functions index by name — one set of weights, no export/import step
+between the training forward and the decode path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+#: no sequence-parallel sharding: decode shapes are tiny by design
+SEQUENCE_AXES: dict = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    vocab_size: int = 256
+    dim: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    head_dim: int = 32
+    mlp_dim: int = 256
+    max_len: int = 128
+    dtype: str = "float32"
+
+    @classmethod
+    def tiny(cls) -> "Config":
+        return cls(vocab_size=64, dim=32, n_layers=2, n_heads=2,
+                   head_dim=16, mlp_dim=64, max_len=64)
+
+
+def _rms(x, scale, eps=1e-6):
+    import jax.numpy as jnp
+
+    return x * scale / jnp.sqrt(
+        jnp.mean(jnp.square(x), axis=-1, keepdims=True) + eps)
+
+
+def _layer_names(i: int) -> tuple[str, ...]:
+    return (f"ln1_{i}", f"wq_{i}", f"wk_{i}", f"wv_{i}", f"wo_{i}",
+            f"ln2_{i}", f"w1_{i}", f"w2_{i}")
+
+
+def apply_tokens(params, tokens, config: Config):
+    """Full teacher-forced forward: ``(B, T) int tokens → (B, T, V)``
+    logits.  The reference semantics the incremental paged path must
+    reproduce token-for-token (asserted in ``tests/test_decode.py``)."""
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models._common import embedding_lookup
+
+    B, T = tokens.shape
+    scale = 1.0 / np.sqrt(config.head_dim)
+    x = embedding_lookup(params["embed"], tokens) + params["pos"][:T]
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    for i in range(config.n_layers):
+        ln1, wq, wk, wv, wo, ln2, w1, w2 = (params[n]
+                                            for n in _layer_names(i))
+        h = _rms(x, ln1)
+        q = jnp.einsum("btd,dhk->bthk", h, wq)
+        k = jnp.einsum("btd,dhk->bthk", h, wk)
+        v = jnp.einsum("btd,dhk->bthk", h, wv)
+        s = jnp.einsum("bthk,bshk->bhts", q, k) * scale
+        s = jnp.where(causal[None, None], s, -1e30)
+        w = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+        w = w / jnp.sum(w, axis=-1, keepdims=True)
+        o = jnp.einsum("bhts,bshk->bthk", w, v)
+        x = x + jnp.einsum("bthk,hkd->btd", o, wo)
+        h = _rms(x, ln2)
+        x = x + jnp.maximum(h @ w1, 0.0) @ w2
+    x = _rms(x, params["lnf"])
+    return x @ params["embed"].T
+
+
+def _attend_one(q, k, v, mask, scale):
+    """Single-position attention over gathered keys: ``q (S,H,K)``
+    against ``k/v (S,C,H,K)`` with a ``(S,C)`` validity mask."""
+    import jax.numpy as jnp
+
+    s = jnp.einsum("shk,schk->shc", q, k) * scale
+    s = jnp.where(mask[:, None, :], s, -1e30)
+    w = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    w = w / jnp.sum(w, axis=-1, keepdims=True)
+    return jnp.einsum("shc,schk->shk", w, v)
+
+
+def prefill_fn(params, tokens, prompt_len, k_pool, v_pool, page_table,
+               *, config: Config, page_size: int):
+    """Prefill ONE sequence: run the prompt (padded to a ladder bucket),
+    write its K/V into the pool through ``page_table``, return the first
+    generated token.
+
+    - ``tokens``: ``(B,)`` int32, the prompt padded to bucket length B;
+    - ``prompt_len``: ``()`` int32 — traced, so every prompt length
+      shares the bucket's one compiled signature;
+    - ``page_table``: ``(P,)`` int32 physical page ids; positions beyond
+      the allocated pages read entry 0 = the trash page, so padded
+      positions write garbage nowhere that is ever read.
+
+    Returns ``(next_token (), k_pool, v_pool)``.
+    """
+    import jax.numpy as jnp
+
+    B = tokens.shape[0]
+    scale = 1.0 / np.sqrt(config.head_dim)
+    pos_idx = jnp.arange(B)
+    pages = page_table[pos_idx // page_size]
+    offs = pos_idx % page_size
+    x = params["embed"][tokens] + params["pos"][:B]
+    causal = jnp.tril(jnp.ones((B, B), bool))
+    for i in range(config.n_layers):
+        ln1, wq, wk, wv, wo, ln2, w1, w2 = (params[n]
+                                            for n in _layer_names(i))
+        h = _rms(x, ln1)
+        q = jnp.einsum("td,dhk->thk", h, wq)
+        k = jnp.einsum("td,dhk->thk", h, wk)
+        v = jnp.einsum("td,dhk->thk", h, wv)
+        k_pool = k_pool.at[i, pages, offs].set(k)
+        v_pool = v_pool.at[i, pages, offs].set(v)
+        s = jnp.einsum("thk,shk->hts", q, k) * scale
+        s = jnp.where(causal[None], s, -1e30)
+        w = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+        w = w / jnp.sum(w, axis=-1, keepdims=True)
+        o = jnp.einsum("hts,shk->thk", w, v)
+        x = x + jnp.einsum("thk,hkd->td", o, wo)
+        h = _rms(x, ln2)
+        x = x + jnp.maximum(h @ w1, 0.0) @ w2
+    # only the last prompt position's logits matter (they predict the
+    # first generated token); padded positions computed garbage that is
+    # sliced away here
+    xl = jnp.take(x, prompt_len - 1, axis=0)
+    logits = _rms(xl, params["lnf"]) @ params["embed"].T
+    return jnp.argmax(logits).astype(jnp.int32), k_pool, v_pool
+
+
+def decode_fn(params, tokens, seq_lens, k_pool, v_pool, page_tables,
+              *, config: Config, page_size: int):
+    """One decode step for EVERY slot at once — the fixed-shape batched
+    token step (`S` slots × `P` pages; `C = P * page_size` gathered
+    context positions).
+
+    - ``tokens``: ``(S,)`` int32, each slot's last emitted token (the
+      token entering the cache at position ``seq_lens[s]``);
+    - ``seq_lens``: ``(S,)`` int32, cache length BEFORE this step;
+    - ``page_tables``: ``(S, P)`` int32; inactive slots carry all-zero
+      rows and ``seq_len`` 0, so their writes land in the trash page and
+      their outputs are garbage the engine never reads.
+
+    Returns ``(next_tokens (S,), k_pool, v_pool)``.  Per-slot math is
+    row-independent, so a slot's output does not depend on which slot
+    index (or which physical pages) it occupies — the property that
+    makes concurrent and sequential decode token-identical.
+    """
+    import jax.numpy as jnp
+
+    S, P = page_tables.shape
+    C = P * page_size
+    scale = 1.0 / np.sqrt(config.head_dim)
+    sl = jnp.minimum(seq_lens, config.max_len - 1)
+    pages = jnp.take_along_axis(
+        page_tables, (sl // page_size)[:, None], axis=1)[:, 0]
+    offs = sl % page_size
+    # valid context = positions 0..seq_len inclusive (the incoming token
+    # is written below, before the gather reads it back)
+    mask = jnp.arange(C)[None, :] <= sl[:, None]
+    x = params["embed"][tokens] + params["pos"][sl]
+    for i in range(config.n_layers):
+        ln1, wq, wk, wv, wo, ln2, w1, w2 = (params[n]
+                                            for n in _layer_names(i))
+        h = _rms(x, ln1)
+        q = jnp.einsum("sd,dhk->shk", h, wq)
+        k = jnp.einsum("sd,dhk->shk", h, wk)
+        v = jnp.einsum("sd,dhk->shk", h, wv)
+        k_pool = k_pool.at[i, pages, offs].set(k)
+        v_pool = v_pool.at[i, pages, offs].set(v)
+        kg = k_pool[i][page_tables].reshape(S, C, *k_pool.shape[3:])
+        vg = v_pool[i][page_tables].reshape(S, C, *v_pool.shape[3:])
+        o = _attend_one(q, kg, vg, mask, scale)
+        x = x + jnp.einsum("shk,hkd->sd", o, wo)
+        h = _rms(x, ln2)
+        x = x + jnp.maximum(h @ w1, 0.0) @ w2
+    logits = _rms(x, params["lnf"]) @ params["embed"].T
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pool, v_pool
+
+
+def kv_pool_shape(config: Config, num_pages: int,
+                  page_size: int) -> tuple[int, ...]:
+    """Shape of ONE pool (keys or values): pre-sized at engine init,
+    never grown — the decode tier's whole-buffer memory contract."""
+    return (config.n_layers, int(num_pages), int(page_size),
+            config.n_heads, config.head_dim)
+
+
+def make_model(config: Config, mesh=None):
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(config.dtype)
+    D, H, K = config.dim, config.n_heads, config.head_dim
+
+    def p(mod, name, shape, axes):
+        init = nn.initializers.normal(0.02)
+        if axes is not None:
+            init = nn.with_partitioning(init, axes)
+        return mod.param(name, init, shape, dtype)
+
+    class TinyLM(nn.Module):
+        @nn.compact
+        def __call__(self, tokens):
+            params = {
+                "embed": p(self, "embed", (config.vocab_size, D),
+                           ("vocab", "embed")),
+                "pos": p(self, "pos", (config.max_len, D), None),
+                "lnf": self.param("lnf", nn.initializers.ones, (D,), dtype),
+            }
+            for i in range(config.n_layers):
+                params[f"ln1_{i}"] = self.param(
+                    f"ln1_{i}", nn.initializers.ones, (D,), dtype)
+                params[f"ln2_{i}"] = self.param(
+                    f"ln2_{i}", nn.initializers.ones, (D,), dtype)
+                params[f"wq_{i}"] = p(self, f"wq_{i}", (D, H, K),
+                                      ("embed", "heads", "kv"))
+                params[f"wk_{i}"] = p(self, f"wk_{i}", (D, H, K),
+                                      ("embed", "heads", "kv"))
+                params[f"wv_{i}"] = p(self, f"wv_{i}", (D, H, K),
+                                      ("embed", "heads", "kv"))
+                params[f"wo_{i}"] = p(self, f"wo_{i}", (H, K, D),
+                                      ("heads", "kv", "embed"))
+                params[f"w1_{i}"] = p(self, f"w1_{i}", (D, config.mlp_dim),
+                                      ("embed", "mlp"))
+                params[f"w2_{i}"] = p(self, f"w2_{i}", (config.mlp_dim, D),
+                                      ("mlp", "embed"))
+            return apply_tokens(params, tokens, config)
+
+    return TinyLM()
+
+
+def make_loss_fn(module, config: Config):
+    """Next-token cross-entropy over the token sequence itself — no
+    separate label column (the targets are the inputs shifted left)."""
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, batch):
+        logits = module.apply({"params": params}, batch["tokens"])
+        return jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1].astype(jnp.float32),
+                batch["tokens"][:, 1:]))
+
+    return loss_fn
+
+
+def make_forward_fn(module, config: Config):
+    def forward(params, batch):
+        return module.apply({"params": params}, batch["tokens"])
+
+    return forward
+
+
+def init_params(config: Config, seed: int = 0):
+    """The flat param dict the pure decode functions consume — unboxed
+    from the flax module's own init, so training, export, and decode all
+    hold the same weights."""
+    import flax.linen as nn
+    import jax
+
+    module = make_model(config)
+    tokens = np.zeros((1, min(4, config.max_len)), np.int32)
+    variables = module.init(jax.random.PRNGKey(seed), tokens)
+    return nn.meta.unbox(variables)["params"]
+
+
+def example_batch(config: Config, batch_size: int = 8, seed: int = 0,
+                  seq_len: int | None = None):
+    rng = np.random.RandomState(seed)
+    T = min(16, config.max_len) if seq_len is None else int(seq_len)
+    return {"tokens": rng.randint(
+        0, config.vocab_size, size=(batch_size, T)).astype(np.int32)}

@@ -41,9 +41,14 @@ And the pipeline flight recorder (ISSUE 6 tentpole):
 
 The flight recorder also attributes the continuous-batching online
 serving tier (plane ``"online"``:
-``wait``/``coalesce``/``pad``/``compute``/``reply``), and the online
-tier's counters and per-tenant latency histograms live in the same
-registry (:mod:`tensorflowonspark_tpu.online`).
+``wait``/``coalesce``/``pad``/``compute``/``reply``) and the generative
+decode tier (plane ``"decode"``: ``wait``/``prefill``/``decode`` with
+``prefill_bound``/``decode_bound`` verdicts — the two decode phases have
+different remedies, so they classify apart), and those tiers' counters
+and latency histograms (per-tenant request seconds; decode TTFT/ITL SLO
+histograms) live in the same registry
+(:mod:`tensorflowonspark_tpu.online`,
+:mod:`tensorflowonspark_tpu.decode`).
 
 Instrumented out of the box: cluster lifecycle (``TFCluster`` /
 ``TFSparkNode`` bootstrap, reserve, probe, shutdown), the trainer

@@ -92,11 +92,19 @@ STAGE_VERDICT = {
     "allreduce": "comm_bound",
     "emit": "emit_bound",
     "reply": "emit_bound",
+    # generative decode plane: prefill (prompt ingestion, one sequence at
+    # a time) and decode (the batched token step over every active slot)
+    # are SEPARATE phases with separate economics — a prefill_bound tier
+    # needs a longer ladder or chunked prefill, a decode_bound tier needs
+    # more slots per step — so they classify apart
+    "prefill": "prefill_bound",
+    "decode": "decode_bound",
 }
 
 #: every verdict :func:`classify` can return
 VERDICTS = ("feed_starved", "device_bound", "comm_bound", "emit_bound",
-            "queue_backpressured", "ingest_bound", "balanced")
+            "queue_backpressured", "ingest_bound", "prefill_bound",
+            "decode_bound", "balanced")
 
 #: a verdict needs this share of the additive batch time to be named
 DOMINANCE = 0.5

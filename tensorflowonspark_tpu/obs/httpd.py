@@ -39,7 +39,13 @@ OPENMETRICS_CONTENT_TYPE = ("application/openmetrics-text; version=1.0.0; "
                             "charset=utf-8")
 
 #: GET route: no-arg callable → ``(status, content_type, body)`` or
-#: ``(status, content_type, body, extra_headers)``
+#: ``(status, content_type, body, extra_headers)``.  ``body`` may be
+#: bytes/str (sent with Content-Length) or any other iterable of
+#: bytes/str chunks — a STREAMING reply, sent with ``Transfer-Encoding:
+#: chunked`` so HTTP/1.1 keep-alive connections stay in sync (a
+#: content-length-less body would otherwise desync the persistent
+#: connection: the peer cannot tell where the reply ends and parses the
+#: next response's bytes as body, or vice versa).
 Route = Callable[[], tuple]
 #: POST route: ``(body_bytes, request_headers)`` → the same reply tuple
 #: shape.  The handler never parses the body itself — interpretation
@@ -128,7 +134,10 @@ class ObservabilityServer:
                     return
                 if isinstance(body, str):
                     body = body.encode()
-                self._reply(status, ctype, body, extra)
+                if isinstance(body, bytes):
+                    self._reply(status, ctype, body, extra)
+                else:
+                    self._reply_stream(status, ctype, body, extra)
 
             def _reply(self, status: int, ctype: str, body: bytes,
                        extra_headers: dict | None = None) -> None:
@@ -139,6 +148,64 @@ class ObservabilityServer:
                     self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _reply_stream(self, status: int, ctype: str, chunks,
+                              extra_headers: dict | None = None) -> None:
+                """Stream an iterable body.
+
+                A reply with neither Content-Length nor chunked framing
+                has no end marker, so a keep-alive peer would read the
+                NEXT response's bytes as this body — the connection
+                desync family the POST drain-body fix addressed.  An
+                HTTP/1.1 client gets ``Transfer-Encoding: chunked`` (the
+                connection stays reusable); an HTTP/1.0 client cannot
+                parse chunked framing, so it gets the raw bytes and the
+                connection closes to delimit the body.
+                """
+                chunked = self.request_version != "HTTP/1.0"
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                if chunked:
+                    self.send_header("Transfer-Encoding", "chunked")
+                else:
+                    self.close_connection = True
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, str(v))
+                self.end_headers()
+                try:
+                    for chunk in chunks:
+                        if isinstance(chunk, str):
+                            chunk = chunk.encode()
+                        if not chunk:
+                            continue
+                        if chunked:
+                            self.wfile.write(b"%x\r\n" % len(chunk))
+                            self.wfile.write(chunk)
+                            self.wfile.write(b"\r\n")
+                        else:
+                            self.wfile.write(chunk)
+                        self.wfile.flush()
+                    if chunked:
+                        self.wfile.write(b"0\r\n\r\n")
+                except Exception as e:
+                    # headers (and possibly chunks) are already on the
+                    # wire: the status cannot change, so the only honest
+                    # signal is TRUNCATION — drop the connection without
+                    # the terminal chunk instead of leaving the peer's
+                    # framing desynced on a reused connection
+                    logger.warning("streaming reply truncated: %s", e)
+                    self.close_connection = True
+                    # close the body iterator NOW (not at GC): a
+                    # generator producer may be metering real work per
+                    # chunk (the decode tier cancels its generation on
+                    # GeneratorExit) and must learn the peer is gone at
+                    # the break, not whenever the collector runs
+                    close = getattr(chunks, "close", None)
+                    if close is not None:
+                        try:
+                            close()
+                        except Exception:
+                            pass
 
             def log_message(self, fmt: str, *args: Any) -> None:
                 logger.debug("observability http: " + fmt, *args)

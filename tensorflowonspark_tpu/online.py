@@ -338,6 +338,12 @@ class _ShedWindow:
                 "shed_rate": round(shed / offered, 4) if offered else 0.0}
 
 
+#: public name for reuse by the other admission-controlled tiers (the
+#: generative decode engine surfaces the same tumbling shed-rate view on
+#: ITS /healthz admission block)
+ShedWindow = _ShedWindow
+
+
 class _Tenant:
     """Per-tenant routing + admission state (pending queue lives here so
     one tenant's backlog is *visible* and boundable independently)."""

@@ -162,6 +162,44 @@ def pow2_bucket(n: int) -> int:
     return b
 
 
+def prefill_buckets(max_prompt_len: int, *, min_bucket: int = 8,
+                    cap: int | None = None) -> tuple[int, ...]:
+    """The generative-decode PREFILL ladder: power-of-two prompt-length
+    buckets from ``min_bucket`` up to the one covering
+    ``max_prompt_len``, optionally capped at ``cap`` (the model's
+    positional capacity ``max_len`` — a bucket longer than the position
+    table cannot be embedded).
+
+    This is the decode tier's compile-triggering shape policy: every
+    prompt pads to a ladder bucket, so prefill compiles once per BUCKET
+    and the decode step (whose shapes are fixed by the slot/page
+    geometry, not the sequence length) compiles exactly once — sequence
+    growth never mints a new jit signature.  Pure arithmetic (no
+    env, no device state), so every process derives the identical
+    ladder from the same config — the fleet-compile-cache discipline.
+
+    When the covering power of two exceeds ``cap``, the terminal bucket
+    is ``max_prompt_len`` itself (one exact-fit compile instead of an
+    un-embeddable shape).
+    """
+    max_prompt_len = int(max_prompt_len)
+    if max_prompt_len < 1:
+        raise ValueError(f"max_prompt_len must be >= 1, got {max_prompt_len}")
+    terminal = pow2_bucket(max_prompt_len)
+    if cap is not None and terminal > int(cap):
+        if max_prompt_len > int(cap):
+            raise ValueError(
+                f"max_prompt_len {max_prompt_len} exceeds cap {cap}")
+        terminal = max_prompt_len
+    out: list[int] = []
+    b = pow2_bucket(max(1, int(min_bucket)))
+    while b < terminal and b < max_prompt_len:
+        out.append(b)
+        b <<= 1
+    out.append(terminal)
+    return tuple(out)
+
+
 def batch_rows(batch: Mapping[str, Any]) -> int:
     """The batch's paddable row count: the leading dimension EVERY
     ``ndim >= 1`` input shares — that shared dimension is what makes it a

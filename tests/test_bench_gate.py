@@ -1212,7 +1212,7 @@ def test_coldstart_regression_is_lower_is_better(tmp_path):
     paths = [
         _write(tmp_path, "BENCH_r15.json", _r15()),
         _write(tmp_path, "BENCH_r16.json",
-               _r15(**_coldstart_fields(seconds=2.9))),
+               _r16(**_coldstart_fields(seconds=2.9))),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "fail"
@@ -1221,7 +1221,7 @@ def test_coldstart_regression_is_lower_is_better(tmp_path):
     paths = [
         _write(tmp_path, "BENCH_r15.json", _r15()),
         _write(tmp_path, "BENCH_r16.json",
-               _r15(**_coldstart_fields(seconds=0.9))),
+               _r16(**_coldstart_fields(seconds=0.9))),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "pass", verdict["reasons"]
@@ -1232,7 +1232,7 @@ def test_coldstart_not_compared_across_configs(tmp_path):
     paths = [
         _write(tmp_path, "BENCH_r15.json", _r15()),
         _write(tmp_path, "BENCH_r16.json",
-               _r15(**_coldstart_fields(seconds=2.9,
+               _r16(**_coldstart_fields(seconds=2.9,
                                         coldstart_buckets=[128]))),
     ]
     verdict = bench_gate.gate(paths)
@@ -1241,7 +1241,7 @@ def test_coldstart_not_compared_across_configs(tmp_path):
     paths = [
         _write(tmp_path, "BENCH_r15.json", _r15()),
         _write(tmp_path, "BENCH_r16.json",
-               _r15(**_coldstart_fields(seconds=2.9,
+               _r16(**_coldstart_fields(seconds=2.9,
                                         coldstart_host_cpus=8))),
     ]
     verdict = bench_gate.gate(paths)
@@ -1254,9 +1254,204 @@ def test_coldstart_judged_even_on_degraded_newest(tmp_path):
     paths = [
         _write(tmp_path, "BENCH_r15.json", _r15()),
         _write(tmp_path, "BENCH_r16.json",
-               _r15(**_coldstart_fields(seconds=2.9),
+               _r16(**_coldstart_fields(seconds=2.9),
                     degraded="accelerator unavailable: probe timeout")),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "fail"
     assert any("cold start slowed" in r for r in verdict["reasons"])
+
+
+# -- generative decode tier (ISSUE 14) ---------------------------------------
+
+
+def _decode_fields(tps=8000.0, seq=2300.0, ttft_p99=3.2, itl_p99=2.4,
+                   **extra):
+    fields = {"decode_tokens_per_sec": tps,
+              "decode_tokens_per_sec_sequential": seq,
+              "decode_speedup": round(tps / seq, 2) if seq else None,
+              "decode_output_equality": "pass",
+              "decode_tokens_total": 864,
+              "decode_ttft_ms_p50": 1.6, "decode_ttft_ms_p99": ttft_p99,
+              "decode_itl_ms_p50": 0.4, "decode_itl_ms_p99": itl_p99,
+              "decode_ttft_slo_ms": 5000.0, "decode_itl_slo_ms": 1000.0,
+              "decode_kv_occupancy_peak": 0.52,
+              "decode_clients": 6, "decode_requests": 36,
+              "decode_max_new_tokens": 24,
+              "decode_prompt_lens": [8, 24],
+              "decode_model": "tiny_lm_d32L2H2v64",
+              "decode_page_size": 8, "decode_max_seqs": 8,
+              "decode_num_pages": 65,
+              "decode_prefill_buckets": [8, 16, 32],
+              "decode_devices": 1, "decode_host_cpus": 1,
+              "decode_stage_breakdown": _flight_bd(
+                  verdict="decode_bound",
+                  stages_s={"wait": 1.0, "prefill": 2.0, "decode": 7.0})}
+    fields.update(extra)
+    return fields
+
+
+def _r16(**extra):
+    """A round-16-complete primary half: r15 + the generative-decode
+    A/B."""
+    half = _r15(**_decode_fields())
+    half.update(extra)
+    return half
+
+
+def test_decode_field_required_on_primary_from_round_16(tmp_path):
+    # round 15: grandfathered — no decode A/B owed
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r15.json", _r15())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # round 16+: the primary must carry it (or explicit null + reason)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r16.json", _r15())])
+    assert verdict["verdict"] == "fail"
+    assert any("decode_tokens_per_sec" in r for r in verdict["reasons"])
+    # complete round 16 passes
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r16.json", _r16())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # explicit null + reason satisfies (e.g. wall budget exhausted)
+    half = _r15(decode_tokens_per_sec=None,
+                decode_reason="wall budget exhausted before the "
+                              "generative decode microbench")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r16.json", half)])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # bare null does not
+    half = _r15(decode_tokens_per_sec=None)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r16.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("decode_reason" in r for r in verdict["reasons"])
+
+
+def test_decode_output_equality_failed_fails_artifact(tmp_path):
+    """Concurrent decode producing different tokens than sequential is
+    broken, not fast — even though it stamps null throughput + reason,
+    the artifact must FAIL, not pass as a legitimate null."""
+    half = _r15(decode_tokens_per_sec=None,
+                decode_output_equality="fail",
+                decode_reason="3/36 request(s) decoded different tokens "
+                              "concurrently vs sequentially")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r16.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("broken, not fast" in r for r in verdict["reasons"])
+    # numeric throughput without ANY equality verdict is also unverified
+    half = _r16()
+    del half["decode_output_equality"]
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r16.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("decode_output_equality" in r for r in verdict["reasons"])
+
+
+def test_decode_value_without_config_identity_fails(tmp_path):
+    half = _r16()
+    del half["decode_page_size"]  # the paging geometry: part of identity
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r16.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("config identity" in r and "decode_page_size" in r
+               for r in verdict["reasons"])
+
+
+def test_decode_value_without_sequential_partner_fails(tmp_path):
+    half = _r16()
+    del half["decode_tokens_per_sec_sequential"]
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r16.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("decode_tokens_per_sec_sequential" in r
+               for r in verdict["reasons"])
+
+
+def test_decode_p99_over_slo_fails(tmp_path):
+    """A tokens/sec claimed at a TTFT or inter-token SLO the run missed
+    is not a measurement — either p99 over its bound fails."""
+    verdict = bench_gate.gate([_write(
+        tmp_path, "BENCH_r16.json",
+        _r16(**_decode_fields(ttft_p99=9000.0)))])
+    assert verdict["verdict"] == "fail"
+    assert any("decode_ttft_ms_p99" in r and "SLO it missed" in r
+               for r in verdict["reasons"])
+    verdict = bench_gate.gate([_write(
+        tmp_path, "BENCH_r16.json",
+        _r16(**_decode_fields(itl_p99=2000.0)))])
+    assert verdict["verdict"] == "fail"
+    assert any("decode_itl_ms_p99" in r for r in verdict["reasons"])
+    # a missing p99 is as bad as a breached one
+    half = _r16()
+    del half["decode_ttft_ms_p99"]
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r16.json", half)])
+    assert verdict["verdict"] == "fail"
+
+
+def test_decode_throughput_regression_within_identity(tmp_path):
+    paths = [
+        _write(tmp_path, "BENCH_r16.json", _r16()),
+        _write(tmp_path, "BENCH_r17.json",
+               _r16(**_decode_fields(tps=3000.0))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("decode tier regressed" in r for r in verdict["reasons"])
+    # a different page size is a different experiment — no comparison
+    paths = [
+        _write(tmp_path, "BENCH_r16.json", _r16()),
+        _write(tmp_path, "BENCH_r17.json",
+               _r16(**_decode_fields(tps=3000.0, decode_page_size=16))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+
+
+def test_decode_latency_regression_is_lower_is_better(tmp_path):
+    # TTFT p99 tripled within one identity while throughput held: the
+    # tail regression the latency gates exist to catch
+    paths = [
+        _write(tmp_path, "BENCH_r16.json", _r16()),
+        _write(tmp_path, "BENCH_r17.json",
+               _r16(**_decode_fields(ttft_p99=12.0))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("decode tail slowed" in r for r in verdict["reasons"])
+    # and a FASTER tail passes (lower is better, not different)
+    paths = [
+        _write(tmp_path, "BENCH_r16.json", _r16()),
+        _write(tmp_path, "BENCH_r17.json",
+               _r16(**_decode_fields(ttft_p99=1.1, itl_p99=0.9))),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+
+
+def test_decode_judged_even_on_degraded_newest(tmp_path):
+    """Host-side like the other serving microbenches: a degraded
+    accelerator half still measured the real decode path, so its number
+    stays gated."""
+    paths = [
+        _write(tmp_path, "BENCH_r16.json", _r16()),
+        _write(tmp_path, "BENCH_r17.json",
+               _r16(**_decode_fields(tps=3000.0),
+                    degraded="accelerator unavailable: probe timeout")),
+    ]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("decode tier regressed" in r for r in verdict["reasons"])
+
+
+def test_decode_breakdown_held_to_reconciliation(tmp_path):
+    """The decode plane's stage breakdown rides _FLIGHT_BREAKDOWNS: a
+    stage sum that does not add up to the wall fails the artifact."""
+    bad = _flight_bd(verdict="decode_bound",
+                     stages_s={"wait": 1.0, "prefill": 1.0,
+                               "decode": 2.0})
+    bad["stage_sum_s"] = 4.0
+    bad["wall_s"] = 10.0
+    bad["stage_sum_frac"] = 0.4
+    verdict = bench_gate.gate([_write(
+        tmp_path, "BENCH_r16.json",
+        _r16(decode_stage_breakdown=bad))])
+    assert verdict["verdict"] == "fail"
+    # a null breakdown with a reason is exempt (TFOS_FLIGHT=0)
+    half = _r16(decode_stage_breakdown=None,
+                decode_stage_breakdown_reason="flight recorder disabled "
+                                              "(TFOS_FLIGHT=0)")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r16.json", half)])
+    assert verdict["verdict"] == "pass", verdict["reasons"]

@@ -1,0 +1,567 @@
+"""Token-level continuous batching for generative decode (ISSUE 14).
+
+The engine-level contracts: incremental paged decode reproduces the full
+teacher-forced forward token-for-token, concurrent and sequential decode
+are token-identical, steady-state decode adds ZERO jit signatures after
+warmup, the KV pool leaks nothing (every test asserts zero leaked pages
+and zero device-buffer growth at teardown — the ``test_shm`` pattern),
+admission sheds loudly, cancellation mid-stream frees exactly what it
+held, tokens stream over chunked HTTP without desyncing keep-alive, and
+the windowed TTFT/ITL SLO block feeds the mesh router's admission check.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import decode, serving, shapes
+from tensorflowonspark_tpu.models import tinylm
+from tensorflowonspark_tpu.online import Rejected
+from tensorflowonspark_tpu.util import ensure_jax_platform
+
+ensure_jax_platform()
+
+CFG = tinylm.Config.tiny()
+
+
+@pytest.fixture
+def make_engine():
+    """Engine factory with the KV-pool hygiene contract enforced at
+    teardown for EVERY engine a test creates: zero leaked pages, zero
+    device-buffer growth, pool shape untouched — after stop(), which
+    itself must release whatever the test left in flight."""
+    engines = []
+
+    def _make(**kw):
+        defaults = dict(max_seqs=4, page_size=8, max_len=64,
+                        max_prompt_len=24)
+        defaults.update(kw)
+        eng = decode.DecodeEngine(CFG, **defaults)
+        engines.append((eng, eng.kv_pool_bytes))
+        return eng
+
+    yield _make
+    for eng, pool_bytes in engines:
+        eng.stop()
+        assert eng.pool.used_pages == 0, "leaked KV pages"
+        assert eng.pool.free_pages == eng.num_pages - 1
+        assert eng.kv_pool_bytes == pool_bytes, "device pool grew"
+        assert tuple(eng._kp.shape) == tinylm.kv_pool_shape(
+            eng.config, eng.num_pages, eng.page_size)
+        assert tuple(eng._vp.shape) == tuple(eng._kp.shape)
+
+
+def _prompts(n, lo=3, hi=24, seed=3):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, CFG.vocab_size,
+                        size=(lo + (i * (hi - lo)) // max(1, n - 1),)
+                        ).astype(np.int32) for i in range(n)]
+
+
+# -- pool + ladder units -----------------------------------------------------
+
+
+def test_paged_pool_alloc_free_and_trash_page_reserved():
+    pool = decode.PagedKVPool(5)
+    assert pool.free_pages == 4  # page 0 is the trash page, never handed out
+    a = pool.alloc(2)
+    assert 0 not in a and len(set(a)) == 2
+    b = pool.alloc(2)
+    assert not set(a) & set(b)
+    assert not pool.can_alloc(1)
+    with pytest.raises(RuntimeError):
+        pool.alloc(1)
+    pool.free(a)
+    assert pool.free_pages == 2
+    with pytest.raises(ValueError):
+        pool.free(a)  # double free is loud
+    with pytest.raises(ValueError):
+        pool.free([0])  # the trash page is not freeable
+    assert pool.peak_used == 4
+
+
+def test_prefill_buckets_ladder():
+    assert shapes.prefill_buckets(24) == (8, 16, 32)
+    assert shapes.prefill_buckets(8) == (8,)
+    assert shapes.prefill_buckets(5) == (8,)
+    assert shapes.prefill_buckets(100, min_bucket=16) == (16, 32, 64, 128)
+    # cap: the covering pow2 exceeds the positional capacity → the
+    # terminal bucket is the exact max prompt length instead
+    assert shapes.prefill_buckets(60, cap=60) == (8, 16, 32, 60)
+    assert shapes.prefill_buckets(64, cap=64) == (8, 16, 32, 64)
+    with pytest.raises(ValueError):
+        shapes.prefill_buckets(0)
+    with pytest.raises(ValueError):
+        shapes.prefill_buckets(100, cap=64)
+
+
+# -- decode semantics --------------------------------------------------------
+
+
+def test_incremental_paged_decode_matches_full_forward(make_engine):
+    """The whole paged-KV claim: prefill + per-token decode through page
+    tables produces EXACTLY the greedy continuation the full
+    teacher-forced forward predicts."""
+    import jax.numpy as jnp
+
+    eng = make_engine()
+    eng.start()
+    params = eng._params
+    for prompt in _prompts(3, lo=3, hi=20):
+        got = eng.submit(prompt, max_new_tokens=8).result()
+        seq = list(int(t) for t in prompt)
+        ref = []
+        for _ in range(8):
+            logits = tinylm.apply_tokens(
+                params, jnp.asarray([seq], jnp.int32), CFG)
+            tok = int(jnp.argmax(logits[0, -1]))
+            ref.append(tok)
+            seq.append(tok)
+        assert got == ref
+
+
+def test_concurrent_decode_matches_sequential(make_engine):
+    eng = make_engine(max_seqs=4)
+    eng.warmup()
+    eng.start()
+    prompts = _prompts(8)
+    seq_out = [eng.submit(p, max_new_tokens=10).result() for p in prompts]
+    conc_out = [None] * len(prompts)
+
+    def run(i):
+        conc_out[i] = eng.submit(prompts[i], max_new_tokens=10).result()
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert conc_out == seq_out
+
+
+def test_zero_new_signatures_after_warmup(make_engine):
+    """The r13 invariant extended to sequences that GROW every step:
+    warmup enumerates exactly the ladder + the one decode-step shape,
+    and steady-state serving (varied prompt lengths, varied generation
+    lengths, admissions and retirements) mints nothing new."""
+    eng = make_engine()
+    eng.warmup()
+    enumerated = set(eng.enumerate_signatures())
+    # one signature per prefill bucket + exactly ONE for the decode step
+    assert len(enumerated) == len(eng.prefill_buckets) + 1
+    assert serving._SEEN_SHAPES[eng.cache_key] == enumerated
+    eng.start()
+    for i, p in enumerate(_prompts(6)):
+        eng.submit(p, max_new_tokens=3 + 2 * i).result()
+    assert serving._SEEN_SHAPES[eng.cache_key] == enumerated
+
+
+def test_eos_retires_early_and_frees_slot(make_engine):
+    eng = make_engine()
+    eng.start()
+    prompt = _prompts(1)[0]
+    toks = eng.submit(prompt, max_new_tokens=12).result()
+    # greedy decode on random weights settles into a repeated token:
+    # declare it EOS and the same generation must stop at its first
+    # occurrence instead of running to max_new_tokens
+    eos = toks[-1]
+    first = toks.index(eos)
+    eng.eos_id = eos
+    toks2 = eng.submit(prompt, max_new_tokens=12).result()
+    assert toks2 == toks[: first + 1]
+    assert eng.pool.used_pages == 0
+
+
+# -- admission, cancellation, shutdown ---------------------------------------
+
+
+def test_admission_sheds_loudly_and_validates(make_engine):
+    eng = make_engine(max_pending_requests=0)
+    eng.start()
+    with pytest.raises(Rejected) as ei:
+        eng.submit([1, 2, 3], max_new_tokens=2)
+    assert ei.value.retry_after_s > 0
+    assert int(eng._shed_total.value) >= 1
+    assert eng.stats()["admission"]["shed_window"]["shed"] >= 1
+    eng2 = make_engine()
+    eng2.start()
+    with pytest.raises(ValueError):
+        eng2.submit([], max_new_tokens=2)  # empty prompt
+    with pytest.raises(ValueError):
+        eng2.submit(list(range(25)), max_new_tokens=2)  # over the ladder
+    with pytest.raises(ValueError):
+        eng2.submit([1, 2], max_new_tokens=0)
+    with pytest.raises(ValueError):
+        eng2.submit([1, 2], max_new_tokens=63)  # no room inside max_len
+    with pytest.raises(ValueError):
+        eng2.submit([CFG.vocab_size + 5], max_new_tokens=2)  # out of vocab
+    # a valid request still serves after all those rejections
+    assert len(eng2.submit([1, 2, 3], max_new_tokens=2).result()) == 2
+
+
+def test_cancel_mid_stream_frees_pages(make_engine):
+    """The client-disconnect path: cancelling a stream mid-generation
+    retires the slot at the next step boundary and frees its pages while
+    OTHER generations keep going untouched."""
+    eng = make_engine(max_seqs=2)
+    eng.start()
+    victim = eng.submit(_prompts(1)[0], max_new_tokens=40)
+    it = victim.tokens(timeout=30)
+    next(it)
+    next(it)
+    other = eng.submit([5, 6, 7], max_new_tokens=6)
+    victim.cancel()
+    assert other.result() == eng.submit([5, 6, 7], max_new_tokens=6).result()
+    deadline = time.time() + 10
+    while eng.pool.used_pages and time.time() < deadline:
+        time.sleep(0.01)
+    assert eng.pool.used_pages == 0
+    assert int(eng._cancelled_total.value) >= 1
+
+
+def test_stop_fails_inflight_loudly(make_engine):
+    eng = make_engine(max_seqs=1)
+    eng.start()
+    streams = [eng.submit(p, max_new_tokens=38)
+               for p in _prompts(3, lo=3, hi=20)]
+    results = []
+
+    def consume(s):
+        try:
+            results.append(("ok", s.result(timeout=30)))
+        except Exception as e:
+            results.append(("err", type(e).__name__))
+
+    threads = [threading.Thread(target=consume, args=(s,))
+               for s in streams]
+    for t in threads:
+        t.start()
+    eng.stop()  # immediately: at least the queued requests must fail loudly
+    for t in threads:
+        t.join(timeout=30)
+    assert len(results) == 3  # nobody left waiting
+    assert any(kind == "err" for kind, _ in results)  # stop was loud
+    assert eng.state == "stopped"
+    with pytest.raises(RuntimeError):
+        eng.submit([1], max_new_tokens=1)
+
+
+# -- observability -----------------------------------------------------------
+
+
+def test_flight_plane_and_slo_windows(make_engine):
+    from tensorflowonspark_tpu.obs import flight
+
+    eng = make_engine()
+    eng.start()
+    rec = flight.recorder("decode")
+    rec.reset()
+    for p in _prompts(3):
+        eng.submit(p, max_new_tokens=6).result()
+    snap = rec.snapshot()
+    assert snap["stages_s"].get("prefill", 0) > 0
+    assert snap["stages_s"].get("decode", 0) > 0
+    assert snap["verdict"] in flight.VERDICTS
+    slo = eng.slo_snapshot()
+    assert slo["samples"] >= 3
+    assert slo["ttft_p99_ms"] > 0
+    assert slo["itl_p99_ms"] > 0
+    assert slo["ttft_slo_ms"] == decode.DEFAULT_TTFT_SLO_MS
+    st = eng.stats()
+    assert st["admission"]["slo"] == eng.slo_snapshot()
+    assert st["engine"]["kv_pages_total"] == eng.num_pages - 1
+    assert st["tokens_total"] >= 18
+
+
+def test_per_token_spans_on_retained_trace(make_engine, monkeypatch):
+    from tensorflowonspark_tpu.obs import trace as trace_lib
+
+    monkeypatch.setenv("TFOS_TRACE_SAMPLE", "1")
+    eng = make_engine()
+    eng.start()
+    ctx = trace_lib.TraceContext(trace_lib.new_trace_id(),
+                                 trace_lib.new_span_id())
+    stream = eng.submit(_prompts(1)[0], max_new_tokens=6, trace_ctx=ctx)
+    assert stream.trace_id == ctx.trace_id
+    stream.result()
+    deadline = time.time() + 5
+    entry = None
+    while entry is None and time.time() < deadline:
+        for e in trace_lib.get_trace_store().to_doc()["retained"]:
+            if e.get("trace_id") == ctx.trace_id:
+                entry = e
+        time.sleep(0.01)
+    assert entry is not None, "armed decode request was not retained"
+    names = [s["name"] for s in entry["spans"]]
+    assert "prefill" in names and "queue" in names
+    # per-token spans: one per generated token after the first
+    assert names.count("token") == 5
+    token_spans = [s for s in entry["spans"] if s["name"] == "token"]
+    assert [s["attrs"]["index"] for s in token_spans] == [1, 2, 3, 4, 5]
+
+
+def test_http_streaming_healthz_metrics(make_engine):
+    import http.client
+
+    from tensorflowonspark_tpu.obs.httpd import validate_prometheus_text
+
+    eng = make_engine()
+    eng.start()
+    srv = decode.DecodeHTTPServer(eng)
+    try:
+        host, port = srv.start()
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn.request("POST", "/v1/generate", body=json.dumps(
+            {"prompt": [1, 2, 3, 4], "max_new_tokens": 5}).encode())
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Transfer-Encoding") == "chunked"
+        lines = [json.loads(ln) for ln in
+                 resp.read().decode().strip().splitlines()]
+        assert [d["token"] for d in lines[:-1]] == lines[-1]["tokens"]
+        assert lines[-1]["done"] is True and lines[-1]["n"] == 5
+        # keep-alive survived the chunked body: the SAME connection
+        # serves a second (non-streaming) request without desyncing
+        conn.request("POST", "/v1/generate", body=json.dumps(
+            {"prompt": [1, 2, 3, 4], "max_new_tokens": 5,
+             "stream": False}).encode())
+        r2 = conn.getresponse()
+        assert r2.status == 200
+        assert json.loads(r2.read())["tokens"] == lines[-1]["tokens"]
+        # healthz: admission block + the windowed slo sub-document
+        conn.request("GET", "/healthz")
+        h = conn.getresponse()
+        doc = json.loads(h.read())
+        assert h.status == 200
+        adm = doc["admission"]
+        assert adm["admission_schema"] == 1
+        assert {"ttft_p99_ms", "itl_p99_ms", "ttft_slo_ms",
+                "itl_slo_ms", "samples"} <= set(adm["slo"])
+        # metrics: schema-valid exposition carrying the SLO histograms
+        conn.request("GET", "/metrics")
+        m = conn.getresponse()
+        text = m.read().decode()
+        assert validate_prometheus_text(text) == []
+        assert "decode_ttft_seconds_bucket" in text
+        assert "decode_itl_seconds_bucket" in text
+        # error mapping: malformed → 400; shed → 429 + Retry-After
+        conn.request("POST", "/v1/generate", body=b'{"prompt": []}')
+        r = conn.getresponse()
+        assert r.status == 400
+        r.read()
+        eng.max_pending_requests = 0
+        conn.request("POST", "/v1/generate", body=json.dumps(
+            {"prompt": [1], "max_new_tokens": 1}).encode())
+        r = conn.getresponse()
+        assert r.status == 429
+        assert int(r.getheader("Retry-After")) >= 1
+        r.read()
+        eng.max_pending_requests = 128
+    finally:
+        srv.stop()
+
+
+def test_unsatisfiable_request_refused_not_queued(make_engine):
+    """A request whose worst-case page need exceeds the POOL must be
+    refused at submit: admission is strict FIFO, so an unsatisfiable
+    head would wedge the queue forever (every request behind it starves
+    while /healthz still says serving)."""
+    eng = make_engine(num_pages=5)  # 4 allocatable pages = 32 tokens
+    eng.start()
+    with pytest.raises(ValueError, match="KV pages worst-case"):
+        eng.submit([1, 2, 3, 4, 5, 6, 7, 8], max_new_tokens=40)
+    # the engine is still live: a feasible request decodes normally
+    assert len(eng.submit([1, 2, 3], max_new_tokens=4).result()) == 4
+
+
+def test_http_nonstream_timeout_cancels_generation(make_engine):
+    """A non-streaming caller whose timeout_s expires gets the 504 AND
+    the generation is cancelled — not left running to max_new_tokens
+    holding a slot and pages for nobody."""
+    import http.client
+
+    eng = make_engine()
+    real_step = eng._decode_jit
+
+    def slow_step(*a, **kw):
+        time.sleep(0.02)
+        return real_step(*a, **kw)
+
+    eng._decode_jit = slow_step
+    eng.start()
+    srv = decode.DecodeHTTPServer(eng)
+    try:
+        host, port = srv.start()
+        cancelled0 = int(eng._cancelled_total.value)
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn.request("POST", "/v1/generate", body=json.dumps(
+            {"prompt": [1, 2, 3], "max_new_tokens": 50,
+             "stream": False, "timeout_s": 0.1}).encode())
+        resp = conn.getresponse()
+        assert resp.status == 504
+        resp.read()
+        deadline = time.time() + 10
+        while time.time() < deadline and (
+                int(eng._cancelled_total.value) == cancelled0
+                or eng.pool.used_pages):
+            time.sleep(0.05)
+        assert int(eng._cancelled_total.value) > cancelled0
+        assert eng.pool.used_pages == 0
+    finally:
+        srv.stop()
+
+
+def test_http_client_disconnect_cancels_generation(make_engine):
+    """A streaming client that walks away mid-generation must CANCEL the
+    generation (slot retired at the next step boundary, pages freed),
+    not run it to completion for nobody: the streaming reply closes its
+    body iterator on the write failure and the ndjson generator turns
+    that GeneratorExit into ``handle.cancel()``."""
+    import socket as socket_mod
+
+    eng = make_engine()
+    # meter the decode step so the generation outlives the disconnect
+    real_step = eng._decode_jit
+
+    def slow_step(*a, **kw):
+        time.sleep(0.02)
+        return real_step(*a, **kw)
+
+    eng._decode_jit = slow_step
+    eng.start()
+    srv = decode.DecodeHTTPServer(eng)
+    try:
+        host, port = srv.start()
+        cancelled0 = int(eng._cancelled_total.value)
+        body = json.dumps({"prompt": [1, 2, 3],
+                           "max_new_tokens": 50}).encode()
+        sock = socket_mod.create_connection((host, port), timeout=10)
+        sock.sendall(b"POST /v1/generate HTTP/1.1\r\nHost: x\r\n"
+                     b"Content-Type: application/json\r\n"
+                     b"Content-Length: %d\r\n\r\n" % len(body) + body)
+        f = sock.makefile("rb")
+        assert b"200" in f.readline()  # admitted; tokens are flowing
+        while b'"token"' not in f.readline():
+            pass  # first streamed token reached the wire
+        # really disconnect: makefile() holds a second reference, so
+        # close() alone would leave the connection open under the test
+        sock.shutdown(socket_mod.SHUT_RDWR)
+        f.close()
+        sock.close()  # the client is gone, ~49 tokens still unpaid-for
+        deadline = time.time() + 10
+        while time.time() < deadline and (
+                int(eng._cancelled_total.value) == cancelled0
+                or eng.pool.used_pages):
+            time.sleep(0.05)
+        assert int(eng._cancelled_total.value) > cancelled0, \
+            "disconnect did not cancel the generation"
+        assert eng.pool.used_pages == 0
+    finally:
+        srv.stop()
+
+
+def test_healthz_503_when_stopped(make_engine):
+    import http.client
+
+    eng = make_engine()
+    eng.start()
+    srv = decode.DecodeHTTPServer(eng)
+    try:
+        host, port = srv.start()
+        eng.stop()
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.request("GET", "/healthz")
+        assert conn.getresponse().status == 503
+    finally:
+        srv.stop()
+
+
+# -- mesh admission consumption ----------------------------------------------
+
+
+def _router_and_replica(breaching_slo):
+    from tensorflowonspark_tpu import mesh
+
+    router = mesh.MeshRouter(expected_replicas=1)
+    replica = mesh._Replica("r1", {"host": "127.0.0.1", "port": 1})
+    replica.health = {"admission": {
+        "admission_schema": 1, "pending_bytes": 0, "pending_rows": 0,
+        "max_pending_bytes": 1 << 23, "saturation": 0.0,
+        "shed_window": {"window_s": 30.0, "offered": 0, "shed": 0,
+                        "shed_rate": 0.0},
+        "slo": breaching_slo,
+    }}
+    replica.health_ts = time.time()
+    return router, replica
+
+
+def test_mesh_router_sheds_on_decode_slo_breach():
+    """The decode tier's windowed TTFT/ITL p99s are CONSUMED by the mesh
+    router's global admission control: a replica whose recent tail
+    breaches its own SLO sheds pre-hop; within-SLO, thin-sample, and
+    stale evidence all fail open."""
+    breaching = {"ttft_p99_ms": 900.0, "itl_p99_ms": 10.0,
+                 "ttft_slo_ms": 500.0, "itl_slo_ms": 250.0,
+                 "window_s": 60.0, "samples": 50}
+    router, replica = _router_and_replica(breaching)
+    verdict = router._admission_verdict(replica, "t")
+    assert verdict is not None and "ttft p99" in verdict
+    # ITL breach alone sheds too
+    router2, replica2 = _router_and_replica(
+        dict(breaching, ttft_p99_ms=10.0, itl_p99_ms=400.0))
+    assert "itl p99" in router2._admission_verdict(replica2, "t")
+    # within SLO: forward
+    router3, replica3 = _router_and_replica(
+        dict(breaching, ttft_p99_ms=10.0, itl_p99_ms=10.0))
+    assert router3._admission_verdict(replica3, "t") is None
+    # too few samples: a thin window is not evidence
+    router4, replica4 = _router_and_replica(dict(breaching, samples=2))
+    assert router4._admission_verdict(replica4, "t") is None
+    # per-kind evidence floor: 8 long generations = 8 ttft samples but
+    # hundreds of itl samples — the itl verdict must gate on ITS count
+    router6, replica6 = _router_and_replica(
+        dict(breaching, ttft_p99_ms=10.0, itl_p99_ms=400.0,
+             samples=8, itl_samples=800))
+    assert "itl p99" in router6._admission_verdict(replica6, "t")
+    # and a thin itl window is not evidence even when ttft's is rich
+    router7, replica7 = _router_and_replica(
+        dict(breaching, ttft_p99_ms=10.0, itl_p99_ms=400.0,
+             samples=50, itl_samples=2))
+    assert router7._admission_verdict(replica7, "t") is None
+    # stale health FAILS OPEN even on a breach
+    router5, replica5 = _router_and_replica(breaching)
+    replica5.health_ts = time.time() - 999
+    assert router5._admission_verdict(replica5, "t") is None
+
+
+def test_engine_slo_block_satisfies_router_schema(make_engine):
+    """End-to-end schema compatibility: the live engine's /healthz
+    admission block, handed to the router verbatim, produces a shed
+    verdict exactly when the engine's windowed p99 breaches."""
+    from tensorflowonspark_tpu import mesh
+
+    eng = make_engine(ttft_slo_ms=0.0001)  # everything breaches
+    eng.start()
+    for p in _prompts(3):
+        eng.submit(p, max_new_tokens=4).result()
+    router = mesh.MeshRouter(expected_replicas=1)
+    replica = mesh._Replica("r1", {"host": "127.0.0.1", "port": 1})
+    replica.health = eng.stats()
+    replica.health_ts = time.time()
+    # judge the expectation from the EXACT snapshot the router saw,
+    # per kind: each latency verdict gates on its own sample count
+    slo = replica.health["admission"]["slo"]
+    floor = router.shed_min_offered
+    expect = ((slo["samples"] >= floor
+               and slo["ttft_p99_ms"] > slo["ttft_slo_ms"])
+              or (slo["itl_samples"] >= floor
+                  and (slo["itl_p99_ms"] or 0) > slo["itl_slo_ms"]))
+    verdict = router._admission_verdict(replica, "t")
+    if expect:
+        assert verdict is not None
+    else:  # below the evidence floor the router must fail open
+        assert verdict is None

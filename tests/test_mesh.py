@@ -602,3 +602,22 @@ def test_concurrent_mixed_tenant_requests_route_correctly(live_mesh,
     for t in threads:
         t.join(timeout=60.0)
     assert errors == []
+
+
+def test_health_stale_window_configurable_via_env(monkeypatch):
+    """TFOS_MESH_HEALTH_STALE_S widens the fail-open staleness window
+    without a code change — decode replicas whose step times delay their
+    health replies must not be judged stale on a window sized for sub-ms
+    forwards.  Explicit argument still wins; junk values fall back."""
+    router = mesh.MeshRouter(expected_replicas=1)
+    assert router.health_stale_s == mesh.DEFAULT_HEALTH_STALE_S
+    monkeypatch.setenv("TFOS_MESH_HEALTH_STALE_S", "17.5")
+    assert mesh.MeshRouter(expected_replicas=1).health_stale_s == 17.5
+    assert mesh.MeshRouter(expected_replicas=1,
+                           health_stale_s=3.0).health_stale_s == 3.0
+    monkeypatch.setenv("TFOS_MESH_HEALTH_STALE_S", "not-a-number")
+    assert (mesh.MeshRouter(expected_replicas=1).health_stale_s
+            == mesh.DEFAULT_HEALTH_STALE_S)
+    monkeypatch.setenv("TFOS_MESH_HEALTH_STALE_S", "-2")
+    assert (mesh.MeshRouter(expected_replicas=1).health_stale_s
+            == mesh.DEFAULT_HEALTH_STALE_S)

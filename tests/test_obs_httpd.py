@@ -110,3 +110,94 @@ def test_prometheus_validator_catches_violations():
     garbage = "# TYPE tfos_y gauge\ntfos_y not-a-number\n"
     assert any("non-numeric" in p
                for p in httpd.validate_prometheus_text(garbage))
+
+
+# -- streaming (chunked) replies ---------------------------------------------
+
+
+def test_streaming_route_chunked_and_keep_alive_stays_in_sync():
+    """A route returning an ITERABLE body streams with Transfer-Encoding:
+    chunked — and the persistent connection survives it: a reply with
+    neither Content-Length nor chunked framing has no end marker, so the
+    next request on the same connection would read this body's leftover
+    bytes as its own response (the drain-body desync family)."""
+    import http.client
+
+    srv = httpd.ObservabilityServer({
+        "/stream": lambda: (200, "application/x-ndjson",
+                            (f'{{"i": {i}}}\n' for i in range(5))),
+        "/plain": lambda: (200, "text/plain", "after-stream"),
+    })
+    try:
+        host, port = srv.start()
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.request("GET", "/stream")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Transfer-Encoding") == "chunked"
+        assert resp.getheader("Content-Length") is None
+        lines = [json.loads(ln) for ln in
+                 resp.read().decode().strip().splitlines()]
+        assert [d["i"] for d in lines] == [0, 1, 2, 3, 4]
+        # SAME connection, next request: framing must still be aligned
+        conn.request("GET", "/plain")
+        r2 = conn.getresponse()
+        assert r2.status == 200
+        assert r2.read() == b"after-stream"
+    finally:
+        srv.stop()
+
+
+def test_streaming_route_http10_client_falls_back_to_close():
+    """An HTTP/1.0 client cannot parse chunked framing: the stream goes
+    out raw and the connection CLOSES to delimit the body (connection
+    teardown is the only end-of-body marker HTTP/1.0 has)."""
+    import socket
+
+    srv = httpd.ObservabilityServer({
+        "/stream": lambda: (200, "text/plain",
+                            (s for s in ("alpha\n", "beta\n"))),
+    })
+    try:
+        host, port = srv.start()
+        s = socket.create_connection((host, port), timeout=10)
+        s.sendall(b"GET /stream HTTP/1.0\r\nHost: x\r\n\r\n")
+        raw = b""
+        while True:
+            b = s.recv(4096)
+            if not b:
+                break  # server closed: the HTTP/1.0 end-of-body marker
+            raw += b
+        s.close()
+        head, _, body = raw.partition(b"\r\n\r\n")
+        assert b"200" in head.split(b"\r\n")[0]
+        assert b"Transfer-Encoding" not in head
+        assert body == b"alpha\nbeta\n"
+    finally:
+        srv.stop()
+
+
+def test_streaming_route_midstream_error_truncates_not_desyncs():
+    """A generator that raises mid-stream cannot change the status line
+    (headers are on the wire): the server drops the connection WITHOUT
+    the terminal chunk, so the client sees explicit truncation instead
+    of a desynced next response."""
+    import http.client
+
+    def bad():
+        yield "ok-1\n"
+        raise RuntimeError("source died")
+
+    srv = httpd.ObservabilityServer({
+        "/stream": lambda: (200, "text/plain", bad()),
+    })
+    try:
+        host, port = srv.start()
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.request("GET", "/stream")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        with pytest.raises(http.client.IncompleteRead):
+            resp.read()
+    finally:
+        srv.stop()

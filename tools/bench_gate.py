@@ -93,7 +93,20 @@ ship its cache-off A/B partner ``coldstart_seconds_nocache``, a numeric
 nothing), and its config identity (platform, model geometry, bucket
 ladder, host CPU count); cold start is a latency, so healthy numbers are
 regression-judged LOWER-is-better within one config identity, like
-``recovery_seconds``.
+``recovery_seconds``.  From round ``--require-decode-from`` (default 16,
+the round that introduced token-level continuous batching for generative
+decode) the primary half must carry ``decode_tokens_per_sec`` — the
+continuous-batching engine's closed-loop aggregate token throughput over
+the paged KV pool, A/B'd against sequential per-request decode in the
+same run — or an explicit ``null`` + ``decode_reason``; a numeric value
+must ship its ``decode_tokens_per_sec_sequential`` partner, a
+``decode_output_equality`` of ``"pass"`` (token-level divergence between
+concurrent and sequential decode FAILS the artifact — broken, not fast),
+its config identity (model geometry, page size, slot count, ladder,
+SLOs, device and host-CPU counts), and both latency p99s
+(``decode_ttft_ms_p99`` / ``decode_itl_ms_p99``) at or under their SLOs;
+the throughput is regression-judged higher-is-better and the two latency
+p99s LOWER-is-better, all within one decode config identity.
 
 Usage::
 
@@ -151,6 +164,10 @@ DEFAULT_REQUIRE_STEP_FROM = 14
 #: A/B (``coldstart_seconds``, introduced with the persistent compile
 #: cache + shape-policy unification)
 DEFAULT_REQUIRE_COLDSTART_FROM = 15
+#: first round whose primary half must carry the generative-decode A/B
+#: (``decode_tokens_per_sec``, introduced with token-level continuous
+#: batching over the paged KV-cache pool)
+DEFAULT_REQUIRE_DECODE_FROM = 16
 #: |stage_sum / wall - 1| beyond this fails the artifact: a breakdown that
 #: does not add up is decoration, not attribution
 DEFAULT_FLIGHT_TOLERANCE = 0.15
@@ -209,12 +226,31 @@ _ONLINE_IDENT_KEYS = ("online_clients", "online_rows_total",
 #: partitions) are different experiments
 _SERVE_IDENT_KEYS = ("serve_ingest", "serve_rows_total", "serve_batch_size",
                      "serve_row_bytes", "serve_bucket_sizes")
+_DECODE_KEY = "decode_tokens_per_sec"
+#: the decode microbench's config identity: aggregate tokens/sec is only
+#: comparable at the same model geometry, page/slot/pool geometry (the
+#: scheduling surface), request volume, generation length, SLOs AND
+#: device/CPU counts — a decode step over different slots or pages is a
+#: different experiment, and TTFT/ITL latencies are only comparable at
+#: the same everything
+_DECODE_IDENT_KEYS = ("decode_clients", "decode_requests",
+                      "decode_max_new_tokens", "decode_prompt_lens",
+                      "decode_model", "decode_page_size",
+                      "decode_max_seqs", "decode_prefill_buckets",
+                      "decode_ttft_slo_ms", "decode_itl_slo_ms",
+                      "decode_devices", "decode_host_cpus")
+#: decode latency p99s regression-gated LOWER-is-better beside the
+#: throughput (a scheduler change that buys tokens/sec by doubling the
+#: tail is a regression, not a win)
+_DECODE_LATENCY_KEYS = ("decode_ttft_ms_p99", "decode_itl_ms_p99")
+
 #: (metric key, breakdown key) pairs the flight requirement covers: a
 #: healthy metric value must carry its stage decomposition; a null metric
 #: (already explained by its reason field) owes none
 _FLIGHT_BREAKDOWNS = ((_FEED_KEY, "feed_stage_breakdown"),
                       (_SERVE_KEY, "serve_stage_breakdown"),
-                      (_ONLINE_KEY, "online_stage_breakdown"))
+                      (_ONLINE_KEY, "online_stage_breakdown"),
+                      (_DECODE_KEY, "decode_stage_breakdown"))
 
 
 def validate_breakdown(half: dict[str, Any], metric_key: str,
@@ -322,7 +358,8 @@ def validate_half(half: dict[str, Any], *,
                   require_trace: bool = False,
                   require_mesh: bool = False,
                   require_step: bool = False,
-                  require_coldstart: bool = False) -> list[str]:
+                  require_coldstart: bool = False,
+                  require_decode: bool = False) -> list[str]:
     """Schema problems of one measured result (a wrapper's half)."""
     problems = []
     for key in _REQUIRED_HALF_KEYS:
@@ -564,6 +601,69 @@ def validate_half(half: dict[str, Any], *,
                     f"{_COLDSTART_KEY!r} with coldstart_disk_hits "
                     f"{hits!r}: a 'cached' cold start that took no disk "
                     "hits did not measure the cache")
+    # generative-decode A/B (token-level continuous batching): host-side
+    # like the other serving microbenches, so a degraded-accelerator
+    # round still owes it; null + 'decode_reason' always satisfies.  A
+    # numeric value must carry its sequential A/B partner, its config
+    # identity, a PASSING token-level output-equality check, and both
+    # latency p99s under their SLOs — a tokens/sec claimed at an SLO the
+    # run missed (or with diverging tokens) is not a measurement
+    if require_decode or _DECODE_KEY in half:
+        if half.get("decode_output_equality") == "fail":
+            # judged FIRST: a diverged concurrent decode also stamps
+            # null throughput + reason, and that legitimate-looking null
+            # must not launder broken batching into a passing artifact
+            problems.append(
+                "decode_output_equality is 'fail': continuous batching "
+                "produced different tokens than sequential decode — "
+                "broken, not fast; the artifact fails")
+        if _DECODE_KEY not in half:
+            problems.append(
+                f"missing {_DECODE_KEY!r} (generative-decode microbench "
+                "is part of the schema from r16: measure it or stamp an "
+                "explicit null + 'decode_reason')")
+        elif half[_DECODE_KEY] is None and "decode_reason" not in half:
+            problems.append(
+                f"{_DECODE_KEY!r} is null without a 'decode_reason'")
+        elif isinstance(half.get(_DECODE_KEY), (int, float)):
+            missing = [k for k in _DECODE_IDENT_KEYS if k not in half]
+            if missing:
+                problems.append(
+                    f"{_DECODE_KEY!r} without its config identity "
+                    f"({', '.join(missing)}) — decode tokens/sec is only "
+                    "comparable within one model/page/slot/SLO/device "
+                    "config")
+            if not isinstance(half.get("decode_tokens_per_sec_sequential"),
+                              (int, float)):
+                problems.append(
+                    f"{_DECODE_KEY!r} without a numeric "
+                    "'decode_tokens_per_sec_sequential' — the batched "
+                    "number is only meaningful against the sequential "
+                    "per-request decode A/B'd in the same run")
+            if half.get("decode_output_equality") != "pass":
+                problems.append(
+                    "decode_output_equality is "
+                    f"{half.get('decode_output_equality')!r}: a "
+                    "continuous-batched decode whose tokens were not "
+                    "verified equal to sequential decode's is broken, "
+                    "not fast")
+            for lkey, slo_key, what in (
+                    ("decode_ttft_ms_p99", "decode_ttft_slo_ms",
+                     "time-to-first-token"),
+                    ("decode_itl_ms_p99", "decode_itl_slo_ms",
+                     "inter-token latency")):
+                p99 = half.get(lkey)
+                slo = half.get(slo_key)
+                if not isinstance(p99, (int, float)):
+                    problems.append(
+                        f"{_DECODE_KEY!r} without its measured "
+                        f"'{lkey}' — the number is only meaningful AT "
+                        f"its {what} p99")
+                elif isinstance(slo, (int, float)) and p99 > slo:
+                    problems.append(
+                        f"{lkey} {p99} exceeds {slo_key} {slo}: a "
+                        "tokens/sec claimed at an SLO it missed is not "
+                        "a measurement")
     # request-tracing overhead: A/B-measured on the online path, so a
     # degraded-accelerator round still owes it; null + reason always
     # satisfies (e.g. TFOS_TRACE_REQUESTS=0 runs have no A to B against)
@@ -671,6 +771,19 @@ def _comparable_prior_step(artifacts: list[dict], newest: dict,
                                       _STEP_KEY, _STEP_IDENT_KEYS)
 
 
+def _comparable_prior_decode(artifacts: list[dict], newest: dict,
+                             half: dict, key: str = _DECODE_KEY,
+                             better=max) -> tuple[float, str] | None:
+    """Best prior decode metric under the same model/page/slot/SLO/device
+    config (``_DECODE_IDENT_KEYS``).  ``key``/``better`` select the
+    direction: throughput (``max``) for ``decode_tokens_per_sec``,
+    latency (``min``) for the TTFT/ITL p99s.  Host-side like the other
+    serving microbenches: degraded-accelerator priors still count."""
+    return _comparable_prior_hostside(artifacts, newest, half,
+                                      key, _DECODE_IDENT_KEYS,
+                                      better=better)
+
+
 def _comparable_prior_coldstart(artifacts: list[dict], newest: dict,
                                 half: dict) -> tuple[float, str] | None:
     """Best (LOWEST — cold start is a latency) prior
@@ -730,7 +843,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
          require_trace_from: int = DEFAULT_REQUIRE_TRACE_FROM,
          require_mesh_from: int = DEFAULT_REQUIRE_MESH_FROM,
          require_step_from: int = DEFAULT_REQUIRE_STEP_FROM,
-         require_coldstart_from: int = DEFAULT_REQUIRE_COLDSTART_FROM
+         require_coldstart_from: int = DEFAULT_REQUIRE_COLDSTART_FROM,
+         require_decode_from: int = DEFAULT_REQUIRE_DECODE_FROM
          ) -> dict[str, Any]:
     """Run the gate over a trajectory; returns the verdict document."""
     checks: list[dict[str, Any]] = []
@@ -782,6 +896,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                           and art["n"] >= require_step_from)
             require_cs = (label == "primary"
                           and art["n"] >= require_coldstart_from)
+            require_dc = (label == "primary"
+                          and art["n"] >= require_decode_from)
             for problem in validate_half(half, require_roofline=require_rf,
                                          require_feed=require_fd,
                                          require_serving=require_sv,
@@ -790,7 +906,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                                          require_trace=require_tr,
                                          require_mesh=require_ms,
                                          require_step=require_st,
-                                         require_coldstart=require_cs):
+                                         require_coldstart=require_cs,
+                                         require_decode=require_dc):
                 check(f"schema:{name}:{label}",
                       "fail" if is_newest else "warn", problem)
             # flight breakdowns ride the primary half with the microbench
@@ -914,6 +1031,53 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                           f"{stval} is {round(stval / stprior[0], 4)}× "
                           f"best prior {stprior[0]} ({stprior[1]}) — the "
                           f"step path regressed below {threshold}")
+            # generative-decode A/B: host-side, judged before the
+            # degraded skip like the others — throughput higher-better,
+            # the two latency p99s LOWER-better within the same identity
+            # (a scheduler that buys tokens/sec with a doubled tail is a
+            # regression, not a win)
+            if isinstance(half.get(_DECODE_KEY), (int, float)):
+                dprior = _comparable_prior_decode(artifacts, newest, half)
+                dname = f"regression:{_DECODE_KEY}"
+                dval = float(half[_DECODE_KEY])
+                if dprior is None:
+                    check(dname, "pass",
+                          "no comparable prior decode measurement (same "
+                          "model/page/slot/SLO/device config) — nothing "
+                          "to regress against")
+                elif dval >= threshold * dprior[0]:
+                    check(dname, "pass",
+                          f"{dval} vs best prior {dprior[0]} "
+                          f"({dprior[1]}): ratio "
+                          f"{round(dval / dprior[0], 4)} ≥ {threshold}")
+                else:
+                    check(dname, "fail",
+                          f"{dval} is {round(dval / dprior[0], 4)}× best "
+                          f"prior {dprior[0]} ({dprior[1]}) — the decode "
+                          f"tier regressed below {threshold}")
+                for lkey in _DECODE_LATENCY_KEYS:
+                    if not isinstance(half.get(lkey), (int, float)):
+                        continue
+                    lprior = _comparable_prior_decode(
+                        artifacts, newest, half, key=lkey, better=min)
+                    lname = f"regression:{lkey}"
+                    lval = float(half[lkey])
+                    if lprior is None:
+                        check(lname, "pass",
+                              "no comparable prior latency measurement "
+                              "— nothing to regress against")
+                    elif lval * threshold <= lprior[0]:
+                        check(lname, "pass",
+                              f"{lval}ms vs best prior {lprior[0]}ms "
+                              f"({lprior[1]}): ratio "
+                              f"{round(lval / lprior[0], 4)} ≤ "
+                              f"{round(1 / threshold, 4)}")
+                    else:
+                        check(lname, "fail",
+                              f"{lval}ms is "
+                              f"{round(lval / lprior[0], 4)}× the best "
+                              f"prior {lprior[0]}ms ({lprior[1]}) — the "
+                              f"decode tail slowed beyond 1/{threshold}")
             # compile-cache cold start: host-side, judged before the
             # degraded skip; LOWER is better (it is a latency), same
             # contract as recovery_seconds
@@ -1052,6 +1216,8 @@ def main(argv: list[str] | None = None) -> int:
                    default=DEFAULT_REQUIRE_STEP_FROM)
     p.add_argument("--require-coldstart-from", type=int,
                    default=DEFAULT_REQUIRE_COLDSTART_FROM)
+    p.add_argument("--require-decode-from", type=int,
+                   default=DEFAULT_REQUIRE_DECODE_FROM)
     args = p.parse_args(argv)
     paths = args.paths or discover(args.repo)
     if not paths:
@@ -1070,7 +1236,8 @@ def main(argv: list[str] | None = None) -> int:
                require_trace_from=args.require_trace_from,
                require_mesh_from=args.require_mesh_from,
                require_step_from=args.require_step_from,
-               require_coldstart_from=args.require_coldstart_from)
+               require_coldstart_from=args.require_coldstart_from,
+               require_decode_from=args.require_decode_from)
     print(json.dumps(doc))
     return 1 if doc["verdict"] == "fail" else 0
 

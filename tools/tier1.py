@@ -58,7 +58,10 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 #: the tier-1 flags (ROADMAP.md), minus the suite-level ``timeout`` wrapper
-PYTEST_ARGS = ["-q", "-m", "not slow", "--continue-on-collection-errors",
+#: ``-rf`` forces the FAILED summary lines even under the repo's quiet
+#: (-qq effective) config — run_file parses them into ``failed_names``
+PYTEST_ARGS = ["-q", "-rf", "-m", "not slow",
+               "--continue-on-collection-errors",
                "-p", "no:cacheprovider", "-p", "no:xdist",
                "-p", "no:randomly"]
 
@@ -120,6 +123,15 @@ def run_file(path: str, timeout_s: float) -> dict:
     # progress-dot lines are the only counts, and on a failing file the
     # trailing screens are tracebacks, not dots
     record.update(_parse_counts(out))
+    if rc != 0:
+        # a failing sweep that forgets WHICH test failed is unactionable
+        # (this box flakes under load; the next reader needs the name,
+        # not just rc=1): keep the FAILED/ERROR summary lines
+        names = [ln.split(" ", 1)[1].split(" - ")[0].strip()
+                 for ln in out.splitlines()
+                 if ln.startswith(("FAILED ", "ERROR "))]
+        if names:
+            record["failed_names"] = sorted(set(names))
     return record
 
 
