@@ -266,6 +266,15 @@ def _parse_args(argv=None):
                         "through one in-process server, plus router-hop "
                         "latency and a SIGKILL zero-loss chaos pass "
                         "(host-side, no accelerator involved)")
+    p.add_argument("--fleet-obs", action="store_true",
+                   help="measure the fleet observability plane: router "
+                        "p99 A/B'd collector-on/off "
+                        "(fleet_overhead_frac), an induced hot replica "
+                        "asserted to raise a fleet.load_skew finding "
+                        "within one scrape cadence, and /fleet/metrics "
+                        "schema-validated — through N replica PROCESSES "
+                        "behind the real router (host-side, no "
+                        "accelerator involved)")
     p.add_argument("--step-collectives", action="store_true",
                    help="A/B the bucketed, overlapped gradient-collective "
                         "train step against the monolithic GSPMD step on "
@@ -1956,6 +1965,361 @@ def measure_serving_mesh(replicas: int = 3, clients: int = 16,
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def measure_fleet_obs(replicas: int = 2, clients: int = 6,
+                      reqs_per_client: int = 40, feature_dim: int = 64,
+                      hidden_dim: int = 128, out_dim: int = 4,
+                      batch_size: int = 32, flush_ms: float = 2.0,
+                      scrape_interval_s: float = 1.0,
+                      pairs: int = 3,
+                      deadline: "_Deadline | None" = None) -> dict:
+    """Fleet-observability microbench (ISSUE 15): the collector's cost
+    and its detection claim, through a REAL multi-process mesh.
+
+    Phases:
+
+    1. **Overhead A/B** — ``pairs`` alternating (collector-off,
+       collector-on) closed loops of ``clients`` threads through
+       ``MeshRouter.route_predict`` (load spread over ``replicas``
+       tenants, one per replica process); ``fleet_overhead_frac`` is the
+       median over pairs of ``(p99_on − p99_off) / p99_off`` — what the
+       scrape+judge tick costs the ROUTER's tail, the one place the
+       fleet plane rides the data path's process.
+    2. **Induced hot replica** — every client hammers ONE tenant while
+       the collector scrapes on its ``scrape_interval_s`` cadence;
+       ``fleet_skew_detect_s`` is load-start → the first
+       ``fleet.load_skew`` finding naming the hot replica.  Two scrapes
+       must bracket the load (≤ 2 cadences) and the judgment must fire
+       within ONE further cadence: detection later than
+       ``3 × cadence + 1.0s`` (the 1s is subprocess-CI slack) refuses
+       to stamp — a skew detector that cannot beat the re-balancing
+       loop it feeds is not a detector.
+    3. **Schema validation** — ``GET /fleet/metrics`` must validate
+       under BOTH ``validate_prometheus_text`` and
+       ``validate_openmetrics_text`` with every replica's series
+       present (``fleet_metrics_valid``); a federation that emits
+       invalid exposition refuses to stamp.
+
+    Host-side and CPU-capable like the other serving microbenches;
+    ``fleet_host_cpus`` rides the config identity (the scrape thread
+    competes with routing for cores, so the overhead is only comparable
+    at one CPU count).
+    """
+    import shutil
+    import subprocess as _subprocess
+    import tempfile as _tempfile
+    import threading
+
+    import numpy as np
+
+    from tensorflowonspark_tpu import compat, mesh
+    from tensorflowonspark_tpu.obs import httpd as _httpd
+
+    rng = np.random.default_rng(7)
+    w1 = (rng.standard_normal((feature_dim, hidden_dim))
+          .astype(np.float32) * (2.0 / feature_dim) ** 0.5)
+    w2 = (rng.standard_normal((hidden_dim, out_dim))
+          .astype(np.float32) * (2.0 / hidden_dim) ** 0.5)
+    rows_total = clients * reqs_per_client
+    feats = rng.standard_normal(
+        (rows_total, feature_dim)).astype(np.float32)
+
+    def mlp_fwd(state, batch):
+        import jax
+
+        p = state["params"]
+        return {"score": jax.nn.relu(
+            batch["features"] @ p["w1"]) @ p["w2"]}
+
+    def remaining() -> float:
+        return deadline.remaining() if deadline is not None else 1e9
+
+    tmpdir = _tempfile.mkdtemp(prefix="tfos_fleetobs_")
+    router = None
+    front = None
+    procs: list = []
+    logs: list = []
+    try:
+        exports = []
+        for i in range(replicas):
+            d = os.path.join(tmpdir, f"export{i}")
+            compat.export_saved_model(
+                {"params": {"w1": w1,
+                            "w2": (w2 * (1.0 + 0.5 * i)
+                                   ).astype(np.float32)}},
+                d, forward_fn=mlp_fwd,
+                example_batch={"features": np.zeros((2, feature_dim),
+                                                    np.float32)})
+            exports.append(d)
+
+        router = mesh.MeshRouter(
+            expected_replicas=replicas, poll_interval=scrape_interval_s,
+            fail_after=6, regroup_timeout=60.0,
+            replica_capacity_mb=256.0, min_replicas=1,
+            fleet_window_s=10.0)
+        host, port = router.start()
+        env = dict(os.environ)
+        env[mesh.MESH_AUTH_ENV] = router.auth_token
+        for i in range(replicas):
+            log = open(os.path.join(tmpdir, f"replica{i}.log"), "wb")
+            logs.append(log)
+            procs.append(_subprocess.Popen(
+                [sys.executable, "-m", "tensorflowonspark_tpu.mesh",
+                 "--registry", f"{host}:{port}", "--replica-id", f"r{i}",
+                 "--poll-interval", "0.1"],
+                stdout=log, stderr=log, env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__))))
+        router.await_replicas(
+            timeout=min(180.0, max(60.0, remaining() - 90.0)))
+        rid_of = {}
+        for i in range(replicas):
+            rid_of[i] = router.add_tenant(
+                f"t{i}", wait_applied_s=60.0, export_dir=exports[i],
+                batch_size=batch_size,
+                bucket_sizes=[max(1, batch_size // 8), batch_size],
+                input_mapping={"features": "features"},
+                flush_ms=flush_ms, max_pending_mb=64.0)
+        if len(set(rid_of.values())) != replicas:
+            raise RuntimeError(
+                f"tenants not spread 1:1 over replicas: {rid_of}")
+
+        import json as _json
+
+        bodies = [
+            _json.dumps(
+                {"tenant": f"t{ri % replicas}",
+                 "inputs": {"features": feats[ri:ri + 1].tolist()}}
+            ).encode()
+            for ri in range(rows_total)]
+        hot_body = _json.dumps(
+            {"tenant": "t0",
+             "inputs": {"features": feats[:1].tolist()}}).encode()
+
+        def via_router(ri) -> None:
+            status, _ct, body, _extra = router.route_predict(
+                bodies[ri], {})
+            if status != 200:
+                raise RuntimeError(
+                    f"router returned {status}: {body[:200]}")
+
+        def closed_loop() -> list:
+            lats: list[float] = []
+            errs: list[str] = []
+            lock = threading.Lock()
+
+            def client(ci: int) -> None:
+                try:
+                    mine = []
+                    for k in range(reqs_per_client):
+                        ri = ci * reqs_per_client + k
+                        t0 = time.perf_counter()
+                        via_router(ri)
+                        mine.append(time.perf_counter() - t0)
+                    with lock:
+                        lats.extend(mine)
+                except Exception as e:
+                    with lock:
+                        errs.append(f"client {ci}: {e!r}")
+
+            threads = [threading.Thread(target=client, args=(ci,),
+                                        daemon=True)
+                       for ci in range(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300.0)
+            if errs or any(t.is_alive() for t in threads):
+                raise RuntimeError("; ".join(errs[:3]) or "wedged caller")
+            if len(lats) != rows_total:
+                raise RuntimeError(
+                    f"lost replies: {len(lats)}/{rows_total}")
+            return lats
+
+        via_router(0)  # warm every layer once, un-timed
+
+        # -- phase 1: collector-off vs collector-on router p99 --------------
+        fracs, p99s_on, p99s_off = [], [], []
+        for _pair in range(pairs):
+            if remaining() < 60:
+                raise RuntimeError("wall budget exhausted mid-A/B")
+            router.set_fleet_enabled(False)
+            time.sleep(2 * scrape_interval_s)  # drain in-flight ticks
+            off = closed_loop()
+            router.set_fleet_enabled(True)
+            time.sleep(2 * scrape_interval_s)  # at least one scrape lands
+            on = closed_loop()
+            p_off = float(np.percentile(off, 99))
+            p_on = float(np.percentile(on, 99))
+            p99s_off.append(p_off)
+            p99s_on.append(p_on)
+            fracs.append((p_on - p_off) / p_off)
+        overhead = float(np.median(fracs))
+
+        # -- phase 2: induced hot replica → fleet.load_skew ------------------
+        if remaining() < 45:
+            raise RuntimeError("wall budget exhausted before the skew "
+                               "phase")
+        hot_rid = rid_of[0]
+        stop = threading.Event()
+        hammer_errs: list[str] = []
+
+        def hammer() -> None:
+            while not stop.is_set():
+                try:
+                    status, _ct, body, _extra = router.route_predict(
+                        hot_body, {})
+                    if status != 200:
+                        hammer_errs.append(f"status {status}")
+                        return
+                except Exception as e:
+                    hammer_errs.append(repr(e))
+                    return
+
+        threads = [threading.Thread(target=hammer, daemon=True)
+                   for _ in range(clients)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        detect_s = None
+        finding = None
+        budget = 3 * scrape_interval_s + 1.0
+        try:
+            while time.monotonic() - t0 < budget + 2.0:
+                report = router.check_fleet()
+                hits = [f for f in report["load_skew"]
+                        if f["replica"] == hot_rid]
+                if hits:
+                    detect_s = time.monotonic() - t0
+                    finding = hits[0]
+                    break
+                time.sleep(0.1)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30.0)
+        if hammer_errs:
+            raise RuntimeError("hot-load clients failed: "
+                               + "; ".join(hammer_errs[:3]))
+        if finding is None:
+            raise RuntimeError(
+                "induced hot replica never raised a fleet.load_skew "
+                "finding")
+        if detect_s > budget:
+            raise RuntimeError(
+                f"fleet.load_skew took {detect_s:.2f}s — later than one "
+                f"scrape cadence past the earliest detectable window "
+                f"({budget:.2f}s at a {scrape_interval_s}s cadence)")
+
+        # -- phase 3: the federated exposition must validate -----------------
+        front = mesh.MeshHTTPServer(router)
+        fhost, fport = front.start()
+        import http.client as _hc
+
+        def fetch(path, accept=None):
+            conn = _hc.HTTPConnection(fhost, fport, timeout=30.0)
+            conn.request("GET", path,
+                         headers={"Accept": accept} if accept else {})
+            resp = conn.getresponse()
+            body = resp.read().decode()
+            conn.close()
+            if resp.status != 200:
+                raise RuntimeError(f"{path} returned {resp.status}")
+            return body
+
+        text = fetch("/fleet/metrics")
+        problems = _httpd.validate_prometheus_text(text)
+        om = fetch("/fleet/metrics",
+                   accept="application/openmetrics-text")
+        problems += _httpd.validate_openmetrics_text(om)
+        for i in range(replicas):
+            if f'replica="r{i}"' not in text:
+                problems.append(f"replica r{i} missing from the "
+                                "federated exposition")
+        if problems:
+            raise RuntimeError(
+                f"/fleet/metrics failed schema validation: "
+                f"{problems[:3]}")
+
+        return {
+            "fleet_overhead_frac": round(overhead, 4),
+            "fleet_router_p99_ms": round(
+                float(np.median(p99s_on)) * 1000, 3),
+            "fleet_router_p99_ms_off": round(
+                float(np.median(p99s_off)) * 1000, 3),
+            "fleet_skew_detect_s": round(detect_s, 3),
+            "fleet_skew_replica": hot_rid,
+            "fleet_skew_ratio": finding.get("ratio"),
+            "fleet_skew_rows_per_sec": finding.get("rows_per_sec"),
+            "fleet_metrics_valid": True,
+            "fleet_scrape_interval_s": scrape_interval_s,
+            "fleet_window_s": router.fleet_window_s,
+            "fleet_ring_depth": router.fleet.ring_depth,
+            "fleet_replicas": replicas,
+            "fleet_clients": clients,
+            "fleet_rows_total": rows_total,
+            "fleet_host_cpus": os.cpu_count(),
+        }
+    finally:
+        if front is not None:
+            front.stop()
+        if router is not None:
+            try:
+                router.stop(stop_replicas=True)
+            except Exception:
+                pass
+        for proc in procs:
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except Exception:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+        if router is not None:
+            try:
+                router.server.stop()
+            except Exception:
+                pass
+        for log in logs:
+            try:
+                log.close()
+            except Exception:
+                pass
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def _stamp_fleet(result: dict, deadline: _Deadline) -> None:
+    """Stamp the fleet-observability microbench into the headline
+    result.
+
+    Host-side like the mesh microbench (replica subprocesses on this
+    box, CPU capable).  The schema is total from r17: failure or an
+    exhausted wall budget stamps an explicit null + ``fleet_reason``
+    (``tools/bench_gate.py --require-fleet-from``)."""
+    from tensorflowonspark_tpu import obs
+
+    if deadline.remaining() < 150:
+        result["fleet_overhead_frac"] = None
+        result["fleet_reason"] = ("wall budget exhausted before the "
+                                  "fleet-observability microbench")
+        return
+    with obs.span("bench.fleet_obs") as sp:
+        try:
+            result.update(measure_fleet_obs(deadline=deadline))
+            sp.set(ok=True,
+                   overhead_frac=result.get("fleet_overhead_frac"),
+                   skew_detect_s=result.get("fleet_skew_detect_s"))
+        except Exception as e:
+            result["fleet_overhead_frac"] = None
+            result["fleet_reason"] = (
+                f"fleet-observability microbench failed: {e!r}"[:200])
+            sp.set(ok=False, error=str(e)[:200])
+
+
 def _stamp_mesh(result: dict, deadline: _Deadline) -> None:
     """Stamp the serving-mesh microbench into the headline result.
 
@@ -3035,6 +3399,16 @@ def main() -> None:
         print(json.dumps(result))
         return
 
+    if args.fleet_obs:
+        # host-side multi-process fleet-observability measurement: no
+        # accelerator, no probe
+        result = {"metric": "fleet_overhead_frac", "unit": "fraction"}
+        _stamp_fleet(result, deadline)
+        result["value"] = result.get("fleet_overhead_frac")
+        _write_trace_artifact(result)
+        print(json.dumps(result))
+        return
+
     if args.recovery:
         # host-side elastic-recovery measurement: no accelerator, no probe
         result = {"metric": "recovery_seconds", "unit": "seconds"}
@@ -3148,6 +3522,7 @@ def main() -> None:
     _stamp_decode(result, deadline)
     _stamp_recovery(result, deadline)
     _stamp_mesh(result, deadline)
+    _stamp_fleet(result, deadline)
     _stamp_step_collectives(result, deadline)
     _stamp_compile_cache(result, deadline)
     if not probe.get("ok"):

@@ -382,6 +382,7 @@ class DecodeEngine:
         self._pending: list[_DecodeRequest] = []
         self._pending_bytes = 0
         self._started = False
+        self._started_ts = 0.0
         self._stopped = False
         self._thread: threading.Thread | None = None
         self._warmed = False
@@ -479,6 +480,9 @@ class DecodeEngine:
             if self._started:
                 return self
             self._started = True
+            # monotonic, not wall clock: the fleet plane's young-replica
+            # exemption reads this uptime (see online.py start())
+            self._started_ts = time.monotonic()
         self._thread = threading.Thread(
             target=self._loop, name="tfos-decode-engine", daemon=True)
         self._thread.start()
@@ -858,7 +862,15 @@ class DecodeEngine:
         """JSON-able engine state (the ``/healthz`` body).  The
         ``admission`` block follows the online tier's versioned schema
         (the mesh router consumes it unchanged) plus the decode-specific
-        ``slo`` sub-document."""
+        ``slo`` sub-document.  ``compile_cache``
+        (:func:`tensorflowonspark_tpu.serving.cache_health`) makes fleet
+        cold-start health readable without a full metrics scrape — the
+        same block the online tier publishes, so a decode replica's
+        warm ratio shows up on the router's fleet view too;
+        ``uptime_s`` says how long this engine has served (a young
+        engine with a low warm ratio is EXPECTED cold)."""
+        from tensorflowonspark_tpu import serving as _serving
+
         with self._lock:
             pending = len(self._pending)
             pending_bytes = self._pending_bytes
@@ -868,6 +880,9 @@ class DecodeEngine:
         total = self.num_pages - 1
         return {
             "state": self.state,
+            "uptime_s": (round(time.monotonic() - self._started_ts, 3)
+                         if self._started_ts else None),
+            "compile_cache": _serving.cache_health(),
             "engine": {
                 "model": self.model_name,
                 "max_seqs": self.max_seqs,

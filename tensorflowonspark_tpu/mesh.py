@@ -87,6 +87,7 @@ import time
 from typing import Any, Mapping, Sequence
 
 from tensorflowonspark_tpu import elastic, obs, reservation
+from tensorflowonspark_tpu.obs import fleet as _fleet
 from tensorflowonspark_tpu.obs import trace as _trace
 
 logger = logging.getLogger(__name__)
@@ -143,6 +144,15 @@ def health_stale_default() -> float:
 DEFAULT_SHED_RATE_THRESHOLD = 0.5
 #: minimum offered requests in the window before its shed rate is evidence
 DEFAULT_SHED_MIN_OFFERED = 8
+
+
+def fleet_metrics_default() -> bool:
+    """The fleet collector's default-on switch: ``TFOS_FLEET_METRICS=0``
+    opts the router out of scraping replica ``/metrics`` entirely (the
+    health poll and admission control are untouched)."""
+    return os.environ.get("TFOS_FLEET_METRICS",
+                          "1").strip().lower() not in ("0", "false",
+                                                       "no")
 
 #: fast-path tenant extraction: when the body's FIRST key is a plain
 #: (escape-free) "tenant", the router routes without parsing the whole
@@ -296,7 +306,12 @@ class MeshRouter:
                  shed_min_offered: int = DEFAULT_SHED_MIN_OFFERED,
                  regroup_timeout: float = 60.0, max_regroups: int = 8,
                  min_replicas: int = 1, proxy_timeout_s: float = 60.0,
-                 auth_token: str | None = None):
+                 auth_token: str | None = None,
+                 fleet_metrics: bool | None = None,
+                 fleet_ring: int | None = None,
+                 fleet_window_s: float = _fleet.DEFAULT_WINDOW_S,
+                 fleet_scrape_timeout_s: float = 1.5,
+                 slo_objectives: Sequence[Any] | None = None):
         self.expected_replicas = int(expected_replicas)
         self.capacity_bytes = int(replica_capacity_mb * (1 << 20))
         self.poll_interval = float(poll_interval)
@@ -329,6 +344,7 @@ class MeshRouter:
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._fleet_thread: threading.Thread | None = None
         self._conns = threading.local()
         # instruments cached once: the route path must not pay a registry
         # lookup per request (the online tier's hot-path rule)
@@ -349,6 +365,18 @@ class MeshRouter:
             "mesh_replicas_up", "serving replicas currently up")
         self._t_requests: dict[str, Any] = {}
         self._t_shed: dict[str, Any] = {}
+        # fleet observability plane (ISSUE 15): scrapes ride the health
+        # poll, so the cadence is poll_interval; the collector itself is
+        # always constructed (cheap) and the flag gates the scrape tick
+        self._fleet_enabled = (fleet_metrics if fleet_metrics is not None
+                               else fleet_metrics_default())
+        self.fleet = _fleet.FleetCollector(
+            ring_depth=fleet_ring, timeout_s=fleet_scrape_timeout_s)
+        self.fleet_window_s = float(fleet_window_s)
+        self._explicit_slo = list(slo_objectives or [])
+        #: finding keys that already fired an obs event (re-fires only
+        #: after the finding clears and re-appears)
+        self._fleet_fired: set[tuple] = set()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -376,6 +404,15 @@ class MeshRouter:
                 target=self._watch, name="tfos-mesh-router-watch",
                 daemon=True)
             self._thread.start()
+        if self._fleet_thread is None:
+            # the fleet scrape gets its OWN thread at the same cadence:
+            # a black-holed replica's /metrics (timeout × retries per
+            # scrape) must delay only the next scrape, never the health
+            # poll and the loss detection the data path depends on
+            self._fleet_thread = threading.Thread(
+                target=self._fleet_watch, name="tfos-mesh-fleet-watch",
+                daemon=True)
+            self._fleet_thread.start()
         logger.info("mesh formed: %d replicas (%s)", len(info),
                     ", ".join(sorted(self._replicas)))
         return sorted(self._replicas)
@@ -389,6 +426,8 @@ class MeshRouter:
                 pass
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+        if self._fleet_thread is not None:
+            self._fleet_thread.join(timeout=5.0)
         with self._lock:
             self.state = "stopped"
         if not stop_replicas:
@@ -560,6 +599,178 @@ class MeshRouter:
         except Exception:
             return None
 
+    # -- fleet observability plane (ISSUE 15) --------------------------------
+
+    def set_fleet_enabled(self, enabled: bool) -> None:
+        """Toggle the fleet scrape tick (the bench A/B seam; the env
+        default is :func:`fleet_metrics_default`)."""
+        self._fleet_enabled = bool(enabled)
+
+    def _fleet_watch(self) -> None:
+        """The scrape loop: health-poll cadence, its OWN thread.
+
+        A replica that black-holes its ``/metrics`` costs this loop up
+        to ``timeout × (1 + retries)`` per tick — which is why the loop
+        is NOT the health-poll thread: scraping must never delay loss
+        detection or regroups."""
+        while not self._stop.wait(self.poll_interval):
+            if not self._fleet_enabled:
+                continue
+            with self._lock:
+                if self.state in ("stopped", "dead"):
+                    continue
+                replicas = [r for r in self._replicas.values()
+                            if r.state == "up"]
+            try:
+                self._fleet_tick(replicas)
+            except Exception as e:  # judgment must never kill the loop
+                logger.debug("mesh fleet tick failed: %s", e)
+
+    def _fleet_tick(self, replicas: list["_Replica"]) -> None:
+        """One scrape + judgment pass (:meth:`_fleet_watch` cadence).
+
+        Scrapes are bounded per replica (collector timeout × retries) so
+        a black-holed replica costs only this thread's budget; findings
+        are judged from the refreshed rings and NEW ones emit structured
+        trace events (``fleet.load_skew`` / ``fleet.capacity`` /
+        ``fleet.compile_cache`` / ``slo.burn``) exactly once per
+        episode — a finding re-fires only after it cleared."""
+        self.fleet.scrape([(r.id, r.host, r.port) for r in replicas])
+        findings = self.check_fleet()
+        fired: set[tuple] = set()
+        for kind in ("load_skew", "capacity", "compile_cache"):
+            for f in findings.get(kind) or ():
+                key = (f["finding"], f.get("replica"))
+                fired.add(key)
+                if key not in self._fleet_fired:
+                    obs.event(f["finding"], **{
+                        k: v for k, v in f.items()
+                        if k != "finding" and isinstance(
+                            v, (str, int, float, bool))})
+        for f in findings.get("slo_burn") or ():
+            key = ("slo.burn", f.get("objective"), f.get("tenant"))
+            fired.add(key)
+            if key not in self._fleet_fired:
+                obs.event("slo.burn", **{
+                    k: v for k, v in f.items()
+                    if k != "finding" and isinstance(
+                        v, (str, int, float, bool))})
+        self._fleet_fired = fired
+
+    def slo_objectives(self) -> list[Any]:
+        """The declarative objective set: explicit objectives passed at
+        construction, plus per-tenant defaults derived from the tenant
+        configs — a latency objective for every tenant with an
+        ``slo_ms`` (budget 5% over it) and a shed-rate objective per
+        tenant (budget 5% shed) — so the burn engine watches every
+        placed tenant without per-tenant wiring."""
+        out = list(self._explicit_slo)
+        explicit = {(o.tenant, o.signal) for o in out}
+        with self._lock:
+            cfgs = dict(self._tenant_cfgs)
+        for name, cfg in sorted(cfgs.items()):
+            slo_ms = cfg.get("slo_ms")
+            if slo_ms and (name, "latency") not in explicit:
+                out.append(_fleet.Objective(
+                    f"{name}-latency", signal="latency", tenant=name,
+                    threshold_ms=float(slo_ms), budget=0.05))
+            if (name, "shed_rate") not in explicit:
+                out.append(_fleet.Objective(
+                    f"{name}-shed", signal="shed_rate", tenant=name,
+                    budget=0.05))
+        return out
+
+    def check_fleet(self) -> dict[str, Any]:
+        """Fleet findings over the windowed rings: ``load_skew`` /
+        ``capacity`` / ``compile_cache``
+        (:func:`tensorflowonspark_tpu.obs.fleet.check_fleet`) plus the
+        SLO burn verdicts (``slo_burn``).  Replicas whose scrape is
+        staler than the mesh's fail-open window never judge — the
+        admission block's stale discipline."""
+        with self._lock:
+            placements = {
+                rid: {"placed_bytes": self._placed_bytes(rid),
+                      "capacity_bytes": self.capacity_bytes}
+                for rid, r in self._replicas.items() if r.state == "up"}
+            healths = {rid: r.health for rid, r in self._replicas.items()
+                       if r.health is not None}
+        out = _fleet.check_fleet(
+            self.fleet, placements=placements, healths=healths,
+            window_s=self.fleet_window_s,
+            fresh_within_s=max(self.health_stale_s,
+                               2.5 * self.poll_interval))
+        out["slo_burn"] = _fleet.evaluate_slo(
+            self.fleet, self.slo_objectives(),
+            fresh_within_s=max(self.health_stale_s,
+                               2.5 * self.poll_interval))
+        return out
+
+    def fleet_summary(self) -> dict[str, Any]:
+        """The ``GET /fleet`` body: per-replica windowed rates/latency +
+        scrape freshness + placement/capacity context, the current
+        findings, and the objective set — the operator's (and the
+        autoscaler's) one-stop fleet view."""
+        now = time.time()
+        scrape_health = self.fleet.scrape_health()
+        with self._lock:
+            reps = {rid: (r.state, self._placed_bytes(rid), r.health)
+                    for rid, r in self._replicas.items()}
+        replicas: dict[str, Any] = {}
+        for rid, (state, placed, health) in sorted(reps.items()):
+            w = self.fleet.window(rid, self.fleet_window_s, now)
+            adm = (health or {}).get("admission") or {}
+            # latency histograms are per-tenant labeled series: the
+            # replica-level quantile is their bucket-wise union
+            lat = _fleet.merge_family_hists(
+                (w or {}).get("histograms"),
+                "online_request_seconds") or {}
+            doc = {
+                "state": state,
+                "scrape": scrape_health.get(rid),
+                "placed_bytes": placed,
+                "capacity_bytes": self.capacity_bytes,
+                "window": None,
+                "saturation": adm.get("saturation"),
+                "compile_cache": (health or {}).get("compile_cache"),
+            }
+            if w is not None:
+                doc["window"] = {
+                    "span_s": round(w["span_s"], 3),
+                    "rows_per_sec": round(
+                        (w["counters"].get(_fleet.LOAD_COUNTER)
+                         or {}).get("rate", 0.0), 2),
+                    "requests_per_sec": round(
+                        (w["counters"].get("online_requests_total")
+                         or {}).get("rate", 0.0), 2),
+                    "requests_observed": lat.get("count", 0),
+                    "request_p50_ms": (
+                        round(lat["p50"] * 1000, 3)
+                        if lat.get("p50") is not None else None),
+                    "request_p99_ms": (
+                        round(lat["p99"] * 1000, 3)
+                        if lat.get("p99") is not None else None),
+                }
+            replicas[rid] = doc
+        return {
+            "enabled": self._fleet_enabled,
+            "scrape_interval_s": self.poll_interval,
+            "ring_depth": self.fleet.ring_depth,
+            "window_s": self.fleet_window_s,
+            "replicas": replicas,
+            "findings": self.check_fleet(),
+            "slo_objectives": [o.to_doc() for o in self.slo_objectives()],
+        }
+
+    def fleet_metrics_text(self, openmetrics: bool = False) -> str:
+        """The ``GET /fleet/metrics`` body: every replica's latest
+        scraped snapshot plus the router's own registry, one federated
+        exposition with a first-class ``replica=`` label (the router
+        under ``replica="router"``)."""
+        extra = {"router": obs.get_registry().snapshot()}
+        if openmetrics:
+            return self.fleet.to_openmetrics(extra=extra)
+        return self.fleet.to_prometheus(extra=extra)
+
     def _refresh_applied(self) -> None:
         try:
             stamps = self.server.kv_items(MESH_APPLIED_PREFIX)
@@ -694,6 +905,17 @@ class MeshRouter:
             }
             self.regroups.append(record)
             self.state = "watching"
+            dropped = [rid for rid in old if rid not in self._replicas]
+            members = sorted(self._replicas)
+        for rid in dropped:
+            # a regrouped-away replica's ring and staleness gauge go with
+            # it — /fleet/metrics must not carry a corpse's series forever
+            self.fleet.drop(rid)
+        for rid in members:
+            # the regroup is the membership authority: a re-JOINED id
+            # (dropped in an earlier regroup) is tracked again from here
+            # — a scrape tick's possibly-stale target list never un-drops
+            self.fleet.undrop(rid)
         obs.counter("mesh_regroups_total").inc()
         if lost_new:
             obs.counter("mesh_lost_replicas_total").inc(len(lost_new))
@@ -971,6 +1193,10 @@ class MeshRouter:
                     "shed_total": int(self._shed_total.value),
                     "errors_total": int(self._errors_total.value),
                 },
+                "fleet": {
+                    "enabled": self._fleet_enabled,
+                    "scrape": self.fleet.scrape_health(),
+                },
             }
 
     def merged_request_docs(self, limit: int = 50) -> dict[str, Any]:
@@ -999,7 +1225,17 @@ class MeshHTTPServer:
       W3C ``traceparent`` joins the caller's trace across the hop);
     - ``GET /healthz`` — :meth:`MeshRouter.stats`; 200 while the mesh
       self-heals (``watching``/``regrouping``), 503 once ``dead``;
-    - ``GET /metrics`` — this process's registry (Prometheus text);
+    - ``GET /metrics`` — this process's registry (Prometheus text;
+      ``Accept: application/openmetrics-text`` gets the OpenMetrics
+      flavor);
+    - ``GET /fleet/metrics`` — the FEDERATED exposition: every
+      replica's latest scraped snapshot plus the router's own registry,
+      one document with a first-class ``replica=`` label (content
+      negotiation as on ``/metrics``);
+    - ``GET /fleet`` — the JSON fleet summary: per-replica windowed
+      rates and latency quantiles, scrape freshness, capacity context,
+      and the current findings (load skew / capacity / compile cache /
+      SLO burn);
     - ``GET /debug/requests`` — router+replica span trees merged by
       trace id (slowest-first).
     """
@@ -1012,7 +1248,9 @@ class MeshHTTPServer:
         self._srv = httpd.ObservabilityServer(
             routes={
                 "/healthz": self._healthz,
-                "/metrics": self._metrics,
+                "/metrics": httpd.with_headers(self._metrics),
+                "/fleet": self._fleet,
+                "/fleet/metrics": httpd.with_headers(self._fleet_metrics),
                 "/debug/requests": self._debug_requests,
             },
             post_routes={"/v1/predict": router.route_predict},
@@ -1023,11 +1261,26 @@ class MeshHTTPServer:
         ok = doc["state"] in ("watching", "regrouping")
         return (200 if ok else 503, "application/json", json.dumps(doc))
 
-    def _metrics(self) -> tuple:
+    def _metrics(self, headers) -> tuple:
         from tensorflowonspark_tpu.obs import httpd
 
+        if httpd.wants_openmetrics(headers):
+            return (200, httpd.OPENMETRICS_CONTENT_TYPE,
+                    obs.get_registry().to_openmetrics())
         return (200, httpd.PROMETHEUS_CONTENT_TYPE,
                 obs.get_registry().to_prometheus())
+
+    def _fleet(self) -> tuple:
+        return (200, "application/json",
+                json.dumps(self.router.fleet_summary()))
+
+    def _fleet_metrics(self, headers) -> tuple:
+        from tensorflowonspark_tpu.obs import httpd
+
+        om = httpd.wants_openmetrics(headers)
+        return (200, httpd.OPENMETRICS_CONTENT_TYPE if om
+                else httpd.PROMETHEUS_CONTENT_TYPE,
+                self.router.fleet_metrics_text(openmetrics=om))
 
     def _debug_requests(self) -> tuple:
         return (200, "application/json",

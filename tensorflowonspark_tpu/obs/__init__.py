@@ -63,6 +63,7 @@ timeout).  ``TFOS_TRACE=0`` disables recording.
 from tensorflowonspark_tpu.obs import (  # noqa: F401
     anomaly,
     chrome,
+    fleet,
     flight,
     httpd,
     roofline,
@@ -79,6 +80,7 @@ from tensorflowonspark_tpu.obs.registry import (  # noqa: F401
     histogram,
     merge_snapshots,
     merged_to_prometheus,
+    relabel_snapshot,
     snapshot_to_openmetrics,
     snapshot_to_prometheus,
 )
@@ -103,11 +105,11 @@ from tensorflowonspark_tpu.obs.trace import (  # noqa: F401
 )
 
 __all__ = [
-    "anomaly", "chrome", "flight", "httpd", "roofline", "trace",
+    "anomaly", "chrome", "fleet", "flight", "httpd", "roofline", "trace",
     "Counter", "Gauge", "Histogram", "Registry",
     "counter", "gauge", "histogram", "get_registry",
-    "merge_snapshots", "merged_to_prometheus", "snapshot_to_prometheus",
-    "snapshot_to_openmetrics",
+    "merge_snapshots", "merged_to_prometheus", "relabel_snapshot",
+    "snapshot_to_prometheus", "snapshot_to_openmetrics",
     "TRACE_KV_PREFIX", "Tracer", "collect_blackboard", "configure",
     "event", "flush", "get_tracer", "span",
     "TraceContext", "RequestTrace", "TraceStore", "get_trace_store",

@@ -53,6 +53,31 @@ Route = Callable[[], tuple]
 PostRoute = Callable[[bytes, Any], tuple]
 
 
+def with_headers(fn: Callable[[Any], tuple]) -> Callable[[], tuple]:
+    """Mark a GET route as wanting the request headers.
+
+    A plain GET route is a no-arg callable; some routes need the
+    request headers — content negotiation on ``/fleet/metrics`` serves
+    the OpenMetrics flavor only when ``Accept:
+    application/openmetrics-text`` asks for it.  Wrapping the handler
+    with this marker makes the server call it as ``fn(headers)``
+    instead, without per-request signature inspection on every route.
+    """
+    def route(headers):
+        return fn(headers)
+
+    # a wrapper (not an attribute on fn): bound methods reject attribute
+    # writes, and the common registrant IS a bound method
+    route.wants_headers = True  # type: ignore[attr-defined]
+    return route
+
+
+def wants_openmetrics(headers: Any) -> bool:
+    """Does the scraper's Accept header ask for the OpenMetrics flavor?"""
+    accept = (headers.get("Accept", "") if headers is not None else "") or ""
+    return "application/openmetrics-text" in accept
+
+
 class ObservabilityServer:
     """Threaded HTTP server over a route table; start() → (host, port).
 
@@ -93,7 +118,11 @@ class ObservabilityServer:
                          "routes": sorted(routes)}).encode()
                     self._reply(404, "application/json", body)
                     return
-                self._run_route(path, route)
+                if getattr(route, "wants_headers", False):
+                    headers = self.headers
+                    self._run_route(path, lambda: route(headers))
+                else:
+                    self._run_route(path, route)
 
             def do_POST(self) -> None:  # noqa: N802 (stdlib naming)
                 path = self.path.split("?", 1)[0].rstrip("/") or "/"

@@ -460,6 +460,35 @@ def snapshot_to_openmetrics(snap: dict[str, Any], prefix: str = "tfos_",
                                   openmetrics=True) + "# EOF\n"
 
 
+def relabel_snapshot(snap: dict[str, Any], labels: dict[str, str],
+                     override: bool = True) -> dict[str, Any]:
+    """A snapshot with ``labels`` merged into every series key.
+
+    The federation primitive (ISSUE 15): the fleet collector relabels
+    each replica's scraped snapshot with ``{"replica": id}`` before
+    merging, so N per-process registries become one document whose
+    series stay distinct per replica while families share one ``# TYPE``
+    line.  Existing labels are preserved; on a clashing key,
+    ``override=True`` (the default, for SCRAPED snapshots) lets
+    ``labels`` win — a replica must not be able to spoof another's
+    series — while ``override=False`` (for the federator's own TRUSTED
+    registry) keeps the existing label: the router's per-replica
+    ``fleet_scrape_stale_seconds{replica=…}`` gauges must not collapse
+    into one ``replica="router"`` series.  Values are not copied
+    deeply: the result shares histogram dicts with the input (treat
+    both as read-only snapshots).
+    """
+    out: dict[str, Any] = {}
+    for section in ("counters", "gauges", "histograms"):
+        relabeled = {}
+        for series, val in (snap.get(section) or {}).items():
+            fam, lab = split_series(series)
+            merged = {**lab, **labels} if override else {**labels, **lab}
+            relabeled[series_key(fam, merged)] = val
+        out[section] = relabeled
+    return out
+
+
 def merged_to_prometheus(merged: dict[str, Any],
                          prefix: str = "tfos_") -> str:
     """Exposition of a :func:`merge_snapshots` result: counters and

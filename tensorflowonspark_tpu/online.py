@@ -555,6 +555,7 @@ class OnlineServer:
         self._coalescer: threading.Thread | None = None
         self._computer: threading.Thread | None = None
         self._started = False
+        self._started_ts = 0.0
         self._stopped = False
         # batches staged or computing right now: while 0 the engine is
         # IDLE and the coalescer flushes any pending work immediately —
@@ -767,6 +768,10 @@ class OnlineServer:
         if self._started:
             return self
         self._started = True
+        # monotonic: uptime feeds the fleet plane's young-replica
+        # exemption — a wall-clock NTP step must not rejuvenate a
+        # long-cold replica (or age a fresh one into a finding)
+        self._started_ts = time.monotonic()
         self._coalescer = threading.Thread(
             target=self._coalesce_loop, name="tfos-online-coalescer",
             daemon=True)
@@ -1335,6 +1340,11 @@ class OnlineServer:
 
         return {
             "state": self.state,
+            # fleet-view context: a young replica with a low compile-
+            # cache warm ratio is an EXPECTED cold start; a long-running
+            # one is a finding (obs/fleet.py check_fleet)
+            "uptime_s": (round(time.monotonic() - self._started_ts, 3)
+                         if self._started_ts else None),
             "tenants": tenants,
             # compile-cache visibility: ``warm_ratio`` (in-process + disk
             # hits over all shape requests) is how the mesh router can see

@@ -429,6 +429,41 @@ class TestOutageProofing(unittest.TestCase):
         self.assertIsNone(result["mesh_rows_per_sec"])
         self.assertIn("wall budget", result["mesh_reason"])
 
+    def test_fleet_stamp_is_total_on_exhausted_budget(self):
+        sys.path.insert(0, os.path.dirname(BENCH))
+        import bench
+
+        result = {}
+        bench._stamp_fleet(result, bench._Deadline(0.0))
+        self.assertIsNone(result["fleet_overhead_frac"])
+        self.assertIn("wall budget", result["fleet_reason"])
+
+    @pytest.mark.slow  # spawns 2 replica subprocesses + 3 A/B pairs
+    def test_fleet_obs_microbench_small_config(self):
+        # ISSUE 15: collector-on/off router p99 A/B, induced hot-replica
+        # skew detected within one scrape cadence of the earliest
+        # detectable window, and the federated /fleet/metrics
+        # schema-validated — all through REAL replica processes.  Small
+        # config to stay affordable; the in-artifact number uses the
+        # defaults (BENCH_NOTES.md "Round 17").
+        sys.path.insert(0, os.path.dirname(BENCH))
+        import bench
+
+        out = bench.measure_fleet_obs(
+            replicas=2, clients=4, reqs_per_client=10, feature_dim=16,
+            hidden_dim=32, out_dim=4, batch_size=8, flush_ms=2.0,
+            scrape_interval_s=0.5, pairs=1)
+        self.assertIsInstance(out["fleet_overhead_frac"], float)
+        self.assertGreaterEqual(out["fleet_overhead_frac"], -1.0)
+        self.assertLessEqual(out["fleet_overhead_frac"], 1.0)
+        self.assertLessEqual(out["fleet_skew_detect_s"],
+                             3 * 0.5 + 1.0)
+        self.assertTrue(out["fleet_metrics_valid"])
+        self.assertEqual(out["fleet_replicas"], 2)
+        self.assertEqual(out["fleet_rows_total"], 40)
+        self.assertEqual(out["fleet_host_cpus"], os.cpu_count())
+        self.assertIn(out["fleet_skew_replica"], ("r0", "r1"))
+
     def test_online_stamp_is_total_on_exhausted_budget(self):
         sys.path.insert(0, os.path.dirname(BENCH))
         import bench

@@ -1385,7 +1385,7 @@ def test_decode_throughput_regression_within_identity(tmp_path):
     paths = [
         _write(tmp_path, "BENCH_r16.json", _r16()),
         _write(tmp_path, "BENCH_r17.json",
-               _r16(**_decode_fields(tps=3000.0))),
+               _r17(**_decode_fields(tps=3000.0))),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "fail"
@@ -1394,7 +1394,7 @@ def test_decode_throughput_regression_within_identity(tmp_path):
     paths = [
         _write(tmp_path, "BENCH_r16.json", _r16()),
         _write(tmp_path, "BENCH_r17.json",
-               _r16(**_decode_fields(tps=3000.0, decode_page_size=16))),
+               _r17(**_decode_fields(tps=3000.0, decode_page_size=16))),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "pass", verdict["reasons"]
@@ -1406,7 +1406,7 @@ def test_decode_latency_regression_is_lower_is_better(tmp_path):
     paths = [
         _write(tmp_path, "BENCH_r16.json", _r16()),
         _write(tmp_path, "BENCH_r17.json",
-               _r16(**_decode_fields(ttft_p99=12.0))),
+               _r17(**_decode_fields(ttft_p99=12.0))),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "fail"
@@ -1415,7 +1415,7 @@ def test_decode_latency_regression_is_lower_is_better(tmp_path):
     paths = [
         _write(tmp_path, "BENCH_r16.json", _r16()),
         _write(tmp_path, "BENCH_r17.json",
-               _r16(**_decode_fields(ttft_p99=1.1, itl_p99=0.9))),
+               _r17(**_decode_fields(ttft_p99=1.1, itl_p99=0.9))),
     ]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "pass", verdict["reasons"]
@@ -1428,7 +1428,7 @@ def test_decode_judged_even_on_degraded_newest(tmp_path):
     paths = [
         _write(tmp_path, "BENCH_r16.json", _r16()),
         _write(tmp_path, "BENCH_r17.json",
-               _r16(**_decode_fields(tps=3000.0),
+               _r17(**_decode_fields(tps=3000.0),
                     degraded="accelerator unavailable: probe timeout")),
     ]
     verdict = bench_gate.gate(paths)
@@ -1455,3 +1455,130 @@ def test_decode_breakdown_held_to_reconciliation(tmp_path):
                                               "(TFOS_FLIGHT=0)")
     verdict = bench_gate.gate([_write(tmp_path, "BENCH_r16.json", half)])
     assert verdict["verdict"] == "pass", verdict["reasons"]
+
+
+# -- fleet observability plane (ISSUE 15) ------------------------------------
+
+
+def _fleet_fields(overhead=0.03, detect=1.2, cadence=0.5, **extra):
+    fields = {"fleet_overhead_frac": overhead,
+              "fleet_router_p99_ms": 22.5,
+              "fleet_router_p99_ms_off": 21.8,
+              "fleet_skew_detect_s": detect,
+              "fleet_skew_replica": "r0",
+              "fleet_skew_ratio": 40.0,
+              "fleet_skew_rows_per_sec": 210.0,
+              "fleet_metrics_valid": True,
+              "fleet_scrape_interval_s": cadence,
+              "fleet_window_s": 10.0,
+              "fleet_ring_depth": 64,
+              "fleet_replicas": 2, "fleet_clients": 6,
+              "fleet_rows_total": 240, "fleet_host_cpus": 1}
+    fields.update(extra)
+    return fields
+
+
+def _r17(**extra):
+    """A round-17-complete primary half: r16 + the fleet-observability
+    microbench."""
+    half = _r16(**_fleet_fields())
+    half.update(extra)
+    return half
+
+
+def test_fleet_field_required_on_primary_from_round_17(tmp_path):
+    # round 16: grandfathered — no fleet microbench owed
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r16.json", _r16())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # round 17+: the primary must carry it (or explicit null + reason)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r17.json", _r16())])
+    assert verdict["verdict"] == "fail"
+    assert any("fleet_overhead_frac" in r for r in verdict["reasons"])
+    # complete round 17 passes
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r17.json", _r17())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # explicit null + reason satisfies (e.g. wall budget exhausted)
+    half = _r16(fleet_overhead_frac=None,
+                fleet_reason="wall budget exhausted before the fleet-"
+                             "observability microbench")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r17.json", half)])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # bare null does not
+    half = _r16(fleet_overhead_frac=None)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r17.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("fleet_reason" in r for r in verdict["reasons"])
+
+
+def test_fleet_overhead_bound_sanity(tmp_path):
+    """The overhead is (p99_on − p99_off)/p99_off: anything outside
+    [-1, 1] is a measurement bug, not a measurement."""
+    verdict = bench_gate.gate([_write(
+        tmp_path, "BENCH_r17.json",
+        _r17(**_fleet_fields(overhead=3.7)))])
+    assert verdict["verdict"] == "fail"
+    assert any("fraction in [-1, 1]" in r for r in verdict["reasons"])
+    # a small negative (noise-centered A/B) is legitimate
+    verdict = bench_gate.gate([_write(
+        tmp_path, "BENCH_r17.json",
+        _r17(**_fleet_fields(overhead=-0.02)))])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+
+
+def test_fleet_string_value_is_rejected_not_skipped(tmp_path):
+    """A value that is neither null nor numeric (a JSON string) must
+    not slide past the whole r17 block — every fleet requirement hangs
+    off the numeric branch."""
+    half = _r17(fleet_overhead_frac="0.02")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r17.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("must be numeric or an explicit null" in r
+               for r in verdict["reasons"])
+
+
+def test_fleet_value_without_config_identity_fails(tmp_path):
+    half = _r17()
+    del half["fleet_replicas"]  # the fleet size: part of identity
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r17.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("config identity" in r and "fleet_replicas" in r
+               for r in verdict["reasons"])
+
+
+def test_fleet_skew_detection_bound(tmp_path):
+    """The detection claim is gated: a finding later than one cadence
+    past the earliest detectable window (2 scrapes bracket the load)
+    fails — and a MISSING detection time is as bad as a slow one."""
+    verdict = bench_gate.gate([_write(
+        tmp_path, "BENCH_r17.json",
+        _r17(**_fleet_fields(detect=9.0, cadence=0.5)))])
+    assert verdict["verdict"] == "fail"
+    assert any("fleet_skew_detect_s" in r and "cadence" in r
+               for r in verdict["reasons"])
+    half = _r17()
+    del half["fleet_skew_detect_s"]
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r17.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("fleet_skew_detect_s" in r for r in verdict["reasons"])
+
+
+def test_fleet_metrics_must_have_validated(tmp_path):
+    half = _r17(fleet_metrics_valid=False)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r17.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("fleet_metrics_valid" in r for r in verdict["reasons"])
+    half = _r17()
+    del half["fleet_metrics_valid"]
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r17.json", half)])
+    assert verdict["verdict"] == "fail"
+
+
+def test_fleet_judged_even_on_degraded_newest(tmp_path):
+    """Host-side multi-process like the mesh microbench: a degraded
+    accelerator half still ran the real router+collector, so its
+    schema stays enforced."""
+    half = _r17(**_fleet_fields(overhead=2.5),
+                degraded="accelerator unavailable: probe timeout")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r17.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("fraction in [-1, 1]" in r for r in verdict["reasons"])

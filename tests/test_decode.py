@@ -277,6 +277,24 @@ def test_flight_plane_and_slo_windows(make_engine):
     assert st["tokens_total"] >= 18
 
 
+def test_healthz_compile_cache_block_and_uptime(make_engine):
+    """ISSUE 15 satellite: the decode /healthz carries the same
+    ``compile_cache`` block the online tier publishes (PR 13's
+    counters), so fleet cold-start health is readable without a full
+    metrics scrape — plus ``uptime_s``, the context that distinguishes
+    an EXPECTED-cold young replica from a cold long-runner."""
+    eng = make_engine()
+    st = eng.stats()
+    cc = st["compile_cache"]
+    for key in ("warm_ratio", "dir", "compiles_total", "true_misses",
+                "in_process_hits"):
+        assert key in cc
+    assert st["uptime_s"] is None  # not started yet
+    eng.start()
+    st = eng.stats()
+    assert st["uptime_s"] is not None and st["uptime_s"] >= 0.0
+
+
 def test_per_token_spans_on_retained_trace(make_engine, monkeypatch):
     from tensorflowonspark_tpu.obs import trace as trace_lib
 

@@ -1,0 +1,701 @@
+"""Fleet observability plane (``obs/fleet.py``): exposition parsing,
+windowed deltas over the snapshot ring, federation with a ``replica=``
+label, scrape hardening, the multi-window SLO burn engine, and the
+load-skew / capacity / compile-cache findings."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from tensorflowonspark_tpu.obs import fleet, httpd, registry
+
+
+# ---------------------------------------------------------------------------
+# exposition parsing (the federation wire format, round-tripped)
+# ---------------------------------------------------------------------------
+
+
+def _sample_registry():
+    r = registry.Registry()
+    r.counter("online_rows_total").inc(42)
+    r.counter("online_tenant_requests_total",
+              labels={"tenant": "a"}).inc(7)
+    r.counter("online_tenant_requests_total",
+              labels={"tenant": "b"}).inc(3)
+    r.gauge("online_pending_rows").set(3.5)
+    h = r.histogram("online_request_seconds", labels={"tenant": "a"})
+    h.observe(0.004, exemplar={"trace_id": "ab" * 16})
+    h.observe(0.2)
+    return r
+
+
+def test_parse_exposition_round_trips_registry_snapshot():
+    r = _sample_registry()
+    snap = fleet.parse_exposition(r.to_prometheus())
+    orig = r.snapshot()
+    assert snap["counters"] == {
+        k: float(v) for k, v in orig["counters"].items()}
+    assert snap["gauges"] == orig["gauges"]
+    key = 'online_request_seconds{tenant="a"}'
+    got, want = snap["histograms"][key], orig["histograms"][key]
+    assert got["count"] == want["count"] == 2
+    assert got["sum"] == pytest.approx(want["sum"])
+    assert [[le, n] for le, n in got["buckets"]] == \
+        [[le, n] for le, n in want["buckets"]]
+
+
+def test_parse_exposition_survives_exemplars_and_foreign_lines():
+    r = _sample_registry()
+    text = r.to_openmetrics()  # exemplar-annotated + '# EOF'
+    text += "garbage line that is not a sample\n"
+    text += "foreign_untyped_metric 12\n"  # no TYPE: skipped, not fatal
+    snap = fleet.parse_exposition(text)
+    assert snap["counters"]["online_rows_total"] == 42
+    assert "foreign_untyped_metric" not in snap["counters"]
+    assert "foreign_untyped_metric" not in snap["gauges"]
+
+
+def test_parse_exposition_survives_brace_in_label_value():
+    """Prometheus escapes only backslash/quote/newline: a tenant named
+    'a}b' is emitted verbatim inside its label value and must still
+    parse — truncating at the first '}' would silently drop that
+    tenant's series from every window and SLO judgment."""
+    r = registry.Registry()
+    r.counter("online_tenant_requests_total",
+              labels={"tenant": 'a}b'}).inc(5)
+    r.counter("online_tenant_requests_total",
+              labels={"tenant": 'quo"te'}).inc(2)
+    snap = fleet.parse_exposition(r.to_prometheus())
+    assert snap["counters"][
+        'online_tenant_requests_total{tenant="a}b"}'] == 5
+    assert snap["counters"][
+        'online_tenant_requests_total{tenant="quo\\"te"}'] == 2
+
+
+def test_relabel_snapshot_adds_replica_label_preserving_labels():
+    snap = _sample_registry().snapshot()
+    rl = registry.relabel_snapshot(snap, {"replica": "r0"})
+    assert rl["counters"]['online_rows_total{replica="r0"}'] == 42
+    assert rl["counters"][
+        'online_tenant_requests_total{replica="r0",tenant="a"}'] == 7
+    # the federator's identity wins over a clashing scraped label
+    spoofed = {"counters": {'x_total{replica="victim"}': 5.0},
+               "gauges": {}, "histograms": {}}
+    rl2 = registry.relabel_snapshot(spoofed, {"replica": "r1"})
+    assert rl2["counters"] == {'x_total{replica="r1"}': 5.0}
+
+
+# ---------------------------------------------------------------------------
+# windows: counters → rates, cumulative histograms → windowed quantiles
+# ---------------------------------------------------------------------------
+
+
+def _snap(rows, buckets=None, extra_counters=None):
+    s = {"counters": {"online_rows_total": float(rows)},
+         "gauges": {}, "histograms": {}}
+    if buckets is not None:
+        s["histograms"]['online_request_seconds{tenant="a"}'] = {
+            "buckets": [list(b) for b in buckets], "sum": 0.0,
+            "count": buckets[-1][1]}
+    s["counters"].update(extra_counters or {})
+    return s
+
+
+def test_window_turns_counters_into_rates():
+    c = fleet.FleetCollector(ring_depth=8)
+    c.observe("r0", _snap(0), ts=100.0)
+    c.observe("r0", _snap(100), ts=110.0)
+    w = c.window("r0", 30.0, now=110.0)
+    assert w["span_s"] == pytest.approx(10.0)
+    assert w["counters"]["online_rows_total"]["rate"] == pytest.approx(10.0)
+    assert w["counters"]["online_rows_total"]["delta"] == pytest.approx(100)
+
+
+def test_window_needs_two_samples_and_respects_the_window_bound():
+    c = fleet.FleetCollector(ring_depth=8)
+    c.observe("r0", _snap(0), ts=100.0)
+    assert c.window("r0", 30.0, now=100.0) is None
+    c.observe("r0", _snap(50), ts=150.0)
+    # the first sample fell out of the 30s window: only one remains
+    assert c.window("r0", 30.0, now=150.0) is None
+    # a wider window brackets both
+    w = c.window("r0", 60.0, now=150.0)
+    assert w["counters"]["online_rows_total"]["rate"] == pytest.approx(1.0)
+
+
+def test_window_skips_series_on_counter_reset():
+    """A restarted replica's counters go backwards: the window spans two
+    incarnations and cannot be attributed — skip, never a negative rate."""
+    c = fleet.FleetCollector(ring_depth=8)
+    c.observe("r0", _snap(1000), ts=100.0)
+    c.observe("r0", _snap(5), ts=110.0)  # restart: 1000 → 5
+    w = c.window("r0", 30.0, now=110.0)
+    assert w is not None
+    assert "online_rows_total" not in w["counters"]
+
+
+def test_window_histogram_quantiles_from_bucket_deltas():
+    c = fleet.FleetCollector(ring_depth=8)
+    base = [[0.005, 100], [0.05, 100], ["+Inf", 100]]
+    # window adds 90 fast (≤5ms) + 10 slow (≤50ms) observations
+    newer = [[0.005, 190], [0.05, 200], ["+Inf", 200]]
+    c.observe("r0", _snap(0, base), ts=100.0)
+    c.observe("r0", _snap(0, newer), ts=110.0)
+    w = c.window("r0", 30.0, now=110.0)
+    h = w["histograms"]['online_request_seconds{tenant="a"}']
+    assert h["count"] == 100
+    assert h["rate"] == pytest.approx(10.0)
+    assert h["p50"] <= 0.005
+    assert 0.005 < h["p99"] <= 0.05
+    # a bucket reset (restarted replica: counts below the window base)
+    # skips the series
+    c.observe("r0", _snap(0, [[0.005, 3], [0.05, 4], ["+Inf", 4]]),
+              ts=115.0)
+    w2 = c.window("r0", 30.0, now=115.0)
+    assert 'online_request_seconds{tenant="a"}' not in w2["histograms"]
+
+
+def test_ring_is_bounded():
+    c = fleet.FleetCollector(ring_depth=4)
+    for i in range(10):
+        c.observe("r0", _snap(i), ts=100.0 + i)
+    w = c.window("r0", 100.0, now=109.0)
+    # only the last 4 samples are retained: delta 6 → 9 over 3s
+    assert w["counters"]["online_rows_total"]["delta"] == pytest.approx(3)
+    assert w["span_s"] == pytest.approx(3.0)
+
+
+def test_fleet_window_sums_across_replicas_bucket_wise():
+    c = fleet.FleetCollector(ring_depth=8)
+    c.observe("r0", _snap(0, [[0.005, 0], ["+Inf", 0]]), ts=100.0)
+    c.observe("r0", _snap(60, [[0.005, 50], ["+Inf", 60]]), ts=110.0)
+    c.observe("r1", _snap(0, [[0.005, 0], ["+Inf", 0]]), ts=100.0)
+    c.observe("r1", _snap(40, [[0.005, 0], ["+Inf", 40]]), ts=110.0)
+    fw = c.fleet_window(30.0, now=110.0)
+    assert sorted(fw["replicas"]) == ["r0", "r1"]
+    assert fw["counters"]["online_rows_total"]["delta"] == pytest.approx(100)
+    h = fw["histograms"]['online_request_seconds{tenant="a"}']
+    # the fleet p50 is a quantile of the UNION (50 fast of 100), not an
+    # average of per-replica quantiles
+    assert h["count"] == 100
+    assert h["buckets"][0][1] == 50
+
+
+def test_fleet_window_excludes_stale_replicas():
+    c = fleet.FleetCollector(ring_depth=8)
+    c.observe("r0", _snap(0), ts=100.0)
+    c.observe("r0", _snap(10), ts=101.0)  # stale by now=200
+    c.observe("r1", _snap(0), ts=195.0)
+    c.observe("r1", _snap(10), ts=200.0)
+    fw = c.fleet_window(300.0, now=200.0, fresh_within_s=30.0)
+    assert fw["replicas"] == ["r1"]
+
+
+# ---------------------------------------------------------------------------
+# federation exposition: one TYPE line per family across replica labels
+# ---------------------------------------------------------------------------
+
+
+def test_federated_exposition_validates_under_both_validators():
+    c = fleet.FleetCollector(ring_depth=4)
+    for rid in ("r0", "r1", "r2"):
+        c.observe(rid, fleet.parse_exposition(
+            _sample_registry().to_prometheus()))
+    text = c.to_prometheus()
+    assert httpd.validate_prometheus_text(text) == []
+    om = c.to_openmetrics()
+    assert httpd.validate_openmetrics_text(om) == []
+    # one TYPE line per family even though three replicas carry it
+    for fam, typ in (("tfos_online_rows_total", "counter"),
+                     ("tfos_online_request_seconds", "histogram"),
+                     ("tfos_online_pending_rows", "gauge")):
+        assert text.count(f"# TYPE {fam} {typ}") == 1
+    # every replica's series is present, distinctly labeled
+    for rid in ("r0", "r1", "r2"):
+        assert f'tfos_online_rows_total{{replica="{rid}"}} 42' in text
+        assert (f'tfos_online_tenant_requests_total'
+                f'{{replica="{rid}",tenant="a"}} 7') in text
+
+
+def test_federated_snapshot_takes_router_extra():
+    c = fleet.FleetCollector(ring_depth=4)
+    c.observe("r0", _snap(5))
+    router_snap = {"counters": {"mesh_router_requests_total": 9.0},
+                   "gauges": {}, "histograms": {}}
+    fed = c.federated_snapshot(extra={"router": router_snap})
+    assert fed["counters"]['online_rows_total{replica="r0"}'] == 5.0
+    assert fed["counters"][
+        'mesh_router_requests_total{replica="router"}'] == 9.0
+
+
+def test_federated_snapshot_keeps_routers_per_replica_gauges():
+    """The router's OWN registry carries per-replica series (the
+    scrape-staleness gauges): federation must NOT collapse them into
+    one replica="router" series — trusted-extra relabeling keeps the
+    existing label, while scraped snapshots stay override-relabeled
+    (no spoofing)."""
+    c = fleet.FleetCollector(ring_depth=4)
+    router_snap = {"counters": {}, "histograms": {}, "gauges": {
+        'fleet_scrape_stale_seconds{replica="r0"}': 0.4,
+        'fleet_scrape_stale_seconds{replica="r1"}': 7.2,
+        "mesh_replicas_up": 2.0}}
+    fed = c.federated_snapshot(extra={"router": router_snap})
+    assert fed["gauges"][
+        'fleet_scrape_stale_seconds{replica="r0"}'] == 0.4
+    assert fed["gauges"][
+        'fleet_scrape_stale_seconds{replica="r1"}'] == 7.2
+    assert fed["gauges"]['mesh_replicas_up{replica="router"}'] == 2.0
+    # a SCRAPED snapshot still cannot spoof another replica's series
+    c.observe("rX", {"counters": {
+        'online_rows_total{replica="victim"}': 1.0},
+        "gauges": {}, "histograms": {}})
+    fed = c.federated_snapshot()
+    assert list(fed["counters"]) == ['online_rows_total{replica="rX"}']
+
+
+# ---------------------------------------------------------------------------
+# scrape hardening: bounded timeout, retry, staleness, fail-open
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def metrics_server():
+    reg = _sample_registry()
+    srv = httpd.ObservabilityServer(routes={
+        "/metrics": lambda: (200, httpd.PROMETHEUS_CONTENT_TYPE,
+                             reg.to_prometheus())})
+    host, port = srv.start()
+    yield host, port, reg
+    srv.stop()
+
+
+def test_scrape_populates_ring_and_stale_gauge(metrics_server):
+    from tensorflowonspark_tpu import obs
+
+    host, port, reg = metrics_server
+    c = fleet.FleetCollector(ring_depth=4)
+    ok = c.scrape([("rA", host, port)])
+    assert ok == {"rA": True}
+    latest = c.latest("rA")
+    assert latest is not None
+    assert latest[1]["counters"]["online_rows_total"] == 42
+    assert c.stale_seconds("rA") < 5.0
+    g = obs.get_registry().peek("fleet_scrape_stale_seconds",
+                                {"replica": "rA"})
+    assert g is not None and g.value >= 0.0
+    # drop evicts the ring AND the labeled gauge
+    c.drop("rA")
+    assert c.latest("rA") is None
+    assert obs.get_registry().peek("fleet_scrape_stale_seconds",
+                                   {"replica": "rA"}) is None
+
+
+def test_scrape_failure_is_stale_tolerant(metrics_server):
+    """A dead target fails the scrape but KEEPS the prior snapshots —
+    the ring ages (visible staleness) instead of vanishing."""
+    host, port, reg = metrics_server
+    c = fleet.FleetCollector(ring_depth=4, timeout_s=0.5)
+    assert c.scrape([("rA", host, port)])["rA"] is True
+    before = c.latest("rA")
+    # an unused port: connection refused, immediately
+    dead = socket.socket()
+    dead.bind(("127.0.0.1", 0))
+    dead_port = dead.getsockname()[1]
+    dead.close()
+    assert c.scrape([("rA", "127.0.0.1", dead_port)])["rA"] is False
+    assert c.latest("rA") == before
+    health = c.scrape_health()["rA"]
+    assert health["failures"] == 1
+    assert health["last_error"]
+
+
+def test_scrape_timeout_bounds_a_black_holed_replica():
+    """A replica that accepts and never replies costs at most
+    timeout × (1 + retries), never a stall."""
+    hole = socket.socket()
+    hole.bind(("127.0.0.1", 0))
+    hole.listen(1)
+    port = hole.getsockname()[1]
+    try:
+        c = fleet.FleetCollector(ring_depth=4, timeout_s=0.3, retries=1)
+        t0 = time.monotonic()
+        ok = c.scrape([("rX", "127.0.0.1", port)])
+        elapsed = time.monotonic() - t0
+        assert ok == {"rX": False}
+        assert elapsed < 3.0  # 2 × 0.3s timeouts + slack, not forever
+    finally:
+        hole.close()
+
+
+def test_drop_wins_a_race_with_an_in_flight_scrape(metrics_server):
+    """A regroup-time drop() that races an in-flight scrape must stay
+    dropped — a resurrected ring would never be scraped or re-dropped
+    again, an immortal corpse series on /fleet/metrics.  A later scrape
+    tick that names the id again (a rejoined replica) re-tracks it."""
+    host, port, _reg = metrics_server
+    c = fleet.FleetCollector(ring_depth=4, timeout_s=0.5)
+    assert c.scrape([("rA", host, port)])["rA"] is True
+    c.drop("rA")
+    # the raced scrape lands AFTER the drop: both outcomes must no-op
+    c.observe("rA", _snap(5))
+    assert c.replica_ids() == [] and c.latest("rA") is None
+    dead = socket.socket()
+    dead.bind(("127.0.0.1", 0))
+    dead_port = dead.getsockname()[1]
+    dead.close()
+    assert c.scrape_replica("rA", "127.0.0.1", dead_port) is False
+    assert c.replica_ids() == []
+    # a scrape TICK naming the id must NOT un-drop it either: its
+    # target list may predate the drop (the stale-wanted-list race)
+    assert c.scrape([("rA", host, port)])["rA"] is True
+    assert c.replica_ids() == [] and c.latest("rA") is None
+    # only the membership authority un-drops (the router's regroup,
+    # for a re-joined replica)
+    c.undrop("rA")
+    assert c.scrape([("rA", host, port)])["rA"] is True
+    assert c.latest("rA") is not None
+
+
+def test_stale_gauge_refreshes_for_rings_outside_the_scrape_set(
+        metrics_server):
+    """A lost-but-not-yet-regrouped replica leaves the scrape set; its
+    staleness gauge must keep GROWING (the blindness alert), not freeze
+    at its last small value."""
+    from tensorflowonspark_tpu import obs
+
+    host, port, _reg = metrics_server
+    c = fleet.FleetCollector(ring_depth=4)
+    assert c.scrape([("rOld", host, port)])["rOld"] is True
+    g = obs.get_registry().peek("fleet_scrape_stale_seconds",
+                                {"replica": "rOld"})
+    first = g.value
+    time.sleep(0.2)
+    # next tick scrapes only rNew; rOld's gauge must still advance
+    c.scrape([("rNew", host, port)])
+    assert g.value > first
+    c.drop("rOld")
+    c.drop("rNew")
+
+
+def test_scrape_tick_is_concurrent_one_black_hole_costs_only_itself(
+        metrics_server):
+    """One black-holed replica must not degrade the other replicas'
+    scrape cadence: the tick scrapes concurrently and joins at the
+    SINGLE-replica budget, so the healthy replica still lands and the
+    tick wall stays ~one budget, not additive per unhealthy peer."""
+    host, port, _reg = metrics_server
+    hole = socket.socket()
+    hole.bind(("127.0.0.1", 0))
+    hole.listen(1)
+    hole_port = hole.getsockname()[1]
+    try:
+        c = fleet.FleetCollector(ring_depth=4, timeout_s=0.4, retries=1)
+        t0 = time.monotonic()
+        res = c.scrape([("dead", "127.0.0.1", hole_port),
+                        ("live", host, port)])
+        elapsed = time.monotonic() - t0
+        assert res == {"dead": False, "live": True}
+        assert c.latest("live") is not None
+        # serial would be ≥ 2×(0.4×2); concurrent joins at ~0.4×2+0.5
+        assert elapsed < 2.2, elapsed
+    finally:
+        hole.close()
+
+
+def test_stale_replica_never_judged(metrics_server):
+    """Fail-open: findings exclude replicas whose scrape is staler than
+    the freshness window — the admission block's stale discipline."""
+    c = fleet.FleetCollector(ring_depth=8)
+    c.observe("hot", _snap(0), ts=100.0)
+    c.observe("hot", _snap(1000), ts=110.0)
+    c.observe("cold", _snap(0), ts=100.0)
+    c.observe("cold", _snap(1), ts=110.0)
+    fresh = fleet.check_fleet(c, now=112.0, window_s=60.0)
+    assert [f["replica"] for f in fresh["load_skew"]] == ["hot"]
+    # same data read much later: everything is stale → nothing judged
+    stale = fleet.check_fleet(c, now=500.0, window_s=600.0,
+                              fresh_within_s=30.0)
+    assert stale["load_skew"] == []
+    assert stale["replicas_judged"] == []
+
+
+# ---------------------------------------------------------------------------
+# SLO burn engine: multi-window corroboration
+# ---------------------------------------------------------------------------
+
+
+def _lat_snap(good, total):
+    return {"counters": {}, "gauges": {}, "histograms": {
+        'online_request_seconds{tenant="a"}': {
+            "buckets": [[0.005, good], ["+Inf", total]],
+            "sum": 0.0, "count": total}}}
+
+
+def _obj(**kw):
+    base = dict(signal="latency", tenant="a", threshold_ms=5.0,
+                budget=0.01, fast_window_s=5.0, slow_window_s=30.0,
+                burn_threshold=2.0, min_events=5)
+    base.update(kw)
+    return fleet.Objective("a-lat", **base)
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError):
+        fleet.Objective("x", signal="nope")
+    with pytest.raises(ValueError):
+        fleet.Objective("x", signal="latency")  # no threshold_ms
+    with pytest.raises(ValueError):
+        fleet.Objective("x", signal="shed_rate", budget=1.5)
+    with pytest.raises(ValueError):
+        fleet.Objective("x", signal="shed_rate", fast_window_s=60,
+                        slow_window_s=30)
+    # per-process instruments reject a tenant filter loudly — it would
+    # be silently ignored and judge fleet traffic under a tenant's name
+    for signal in ("ttft", "itl"):
+        with pytest.raises(ValueError):
+            fleet.Objective("x", signal=signal, tenant="a",
+                            threshold_ms=5.0)
+    with pytest.raises(ValueError):
+        fleet.Objective("x", signal="error_rate", tenant="a")
+    # fleet-wide forms construct fine
+    fleet.Objective("x", signal="ttft", threshold_ms=5.0)
+    fleet.Objective("x", signal="error_rate")
+
+
+def test_slo_burn_fires_on_corroborated_breach_and_clears():
+    c = fleet.FleetCollector(ring_depth=32)
+    c.observe("r0", _lat_snap(0, 0), ts=100.0)
+    # 20 of 70 requests over threshold inside both windows
+    c.observe("r0", _lat_snap(50, 70), ts=104.0)
+    found = fleet.evaluate_slo(c, [_obj()], now=104.0)
+    assert len(found) == 1
+    f = found[0]
+    assert f["finding"] == "slo.burn"
+    assert f["objective"] == "a-lat" and f["tenant"] == "a"
+    assert f["burn_fast"] >= 2.0 and f["burn_slow"] >= 2.0
+    assert f["bad_frac_fast"] == pytest.approx(20 / 70, abs=1e-3)
+    # pressure clears: later samples are all good, the FAST window rolls
+    # past the episode → the finding stops firing even though the slow
+    # window still remembers it (no stale-evidence paging)
+    c.observe("r0", _lat_snap(150, 170), ts=112.0)
+    c.observe("r0", _lat_snap(250, 270), ts=118.0)
+    assert fleet.evaluate_slo(c, [_obj()], now=118.0) == []
+
+
+def test_slo_burn_needs_min_events():
+    c = fleet.FleetCollector(ring_depth=8)
+    c.observe("r0", _lat_snap(0, 0), ts=100.0)
+    c.observe("r0", _lat_snap(0, 3), ts=104.0)  # 100% bad but 3 events
+    assert fleet.evaluate_slo(c, [_obj(min_events=5)], now=104.0) == []
+
+
+def test_slo_burn_fast_blip_without_slow_corroboration_does_not_fire():
+    """One fast-window blip against a clean history must not page: the
+    slow window's burn stays under threshold."""
+    c = fleet.FleetCollector(ring_depth=64)
+    # long clean history: 10k good requests over 25s
+    c.observe("r0", _lat_snap(0, 0), ts=100.0)
+    c.observe("r0", _lat_snap(10000, 10000), ts=121.0)
+    # then a blip: 10 bad of 30 in the last 4s
+    c.observe("r0", _lat_snap(10020, 10030), ts=125.0)
+    found = fleet.evaluate_slo(
+        c, [_obj(fast_window_s=5.0, slow_window_s=30.0)], now=125.0)
+    # fast burn ≈ (10/30)/0.01 = 33 but slow burn ≈ (10/10030)/0.01 ≈ 0.1
+    assert found == []
+
+
+def test_slo_untenanted_latency_objective_judges_the_tenant_union():
+    """A fleet-wide latency objective (tenant=None) must aggregate the
+    per-tenant labeled series — a bare-name lookup matches nothing
+    (the online tier always tenant-labels) and would silently never
+    fire."""
+    c = fleet.FleetCollector(ring_depth=8)
+
+    def two_tenant_snap(good_a, tot_a, good_b, tot_b):
+        return {"counters": {}, "gauges": {}, "histograms": {
+            'online_request_seconds{tenant="a"}': {
+                "buckets": [[0.005, good_a], ["+Inf", tot_a]],
+                "sum": 0.0, "count": tot_a},
+            'online_request_seconds{tenant="b"}': {
+                "buckets": [[0.005, good_b], ["+Inf", tot_b]],
+                "sum": 0.0, "count": tot_b}}}
+
+    c.observe("r0", two_tenant_snap(0, 0, 0, 0), ts=100.0)
+    # tenant a: clean (30/30 good); tenant b: 20 bad of 40 — the UNION
+    # is 20 bad of 70
+    c.observe("r0", two_tenant_snap(30, 30, 20, 40), ts=104.0)
+    obj = fleet.Objective("global-lat", signal="latency", tenant=None,
+                          threshold_ms=5.0, budget=0.01,
+                          fast_window_s=5.0, slow_window_s=30.0,
+                          min_events=5)
+    found = fleet.evaluate_slo(c, [obj], now=104.0)
+    assert len(found) == 1
+    assert found[0]["tenant"] is None
+    assert found[0]["bad_frac_fast"] == pytest.approx(20 / 70, abs=1e-3)
+
+
+def test_slo_shed_rate_objective_reads_tenant_counters():
+    c = fleet.FleetCollector(ring_depth=8)
+
+    def shed_snap(req, shed):
+        return {"counters": {
+            'online_tenant_requests_total{tenant="a"}': float(req),
+            'online_tenant_shed_total{tenant="a"}': float(shed)},
+            "gauges": {}, "histograms": {}}
+
+    obj = fleet.Objective("a-shed", signal="shed_rate", tenant="a",
+                          budget=0.05, fast_window_s=5.0,
+                          slow_window_s=30.0, min_events=10)
+    c.observe("r0", shed_snap(0, 0), ts=100.0)
+    c.observe("r0", shed_snap(40, 20), ts=104.0)  # 20 shed of 60 offered
+    found = fleet.evaluate_slo(c, [obj], now=104.0)
+    assert len(found) == 1
+    assert found[0]["bad_frac_fast"] == pytest.approx(20 / 60, abs=1e-3)
+    # healthy: no sheds
+    c2 = fleet.FleetCollector(ring_depth=8)
+    c2.observe("r0", shed_snap(0, 0), ts=100.0)
+    c2.observe("r0", shed_snap(100, 0), ts=104.0)
+    assert fleet.evaluate_slo(c2, [obj], now=104.0) == []
+
+
+def test_slo_latency_threshold_quantizes_up_to_bucket_bound():
+    """threshold_ms between bucket bounds reads the good-count at the
+    next bound UP — conservative against false pages, documented."""
+    c = fleet.FleetCollector(ring_depth=8)
+    c.observe("r0", _lat_snap(0, 0), ts=100.0)
+    # 30 of 100 between 5ms and the next bound: with threshold 3ms the
+    # good-count still reads at the 5ms bucket → all 100 look good
+    c.observe("r0", {"counters": {}, "gauges": {}, "histograms": {
+        'online_request_seconds{tenant="a"}': {
+            "buckets": [[0.005, 100], ["+Inf", 100]],
+            "sum": 0.0, "count": 100}}}, ts=104.0)
+    found = fleet.evaluate_slo(c, [_obj(threshold_ms=3.0)], now=104.0)
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# fleet findings: load skew, capacity headroom, compile-cache
+# ---------------------------------------------------------------------------
+
+
+def test_load_skew_leave_one_out_median_two_replicas():
+    c = fleet.FleetCollector(ring_depth=8)
+    c.observe("r0", _snap(0), ts=100.0)
+    c.observe("r0", _snap(500), ts=110.0)
+    c.observe("r1", _snap(0), ts=100.0)
+    c.observe("r1", _snap(10), ts=110.0)
+    report = fleet.check_fleet(c, now=110.0, window_s=30.0)
+    assert len(report["load_skew"]) == 1
+    f = report["load_skew"][0]
+    assert f["finding"] == "fleet.load_skew"
+    assert f["replica"] == "r0"
+    assert f["rows_per_sec"] == pytest.approx(50.0)
+    assert f["fleet_median_rows_per_sec"] == pytest.approx(1.0)
+
+
+def test_load_skew_carries_saturation_evidence():
+    c = fleet.FleetCollector(ring_depth=8)
+    for rid, rows in (("r0", 500), ("r1", 10), ("r2", 12)):
+        c.observe(rid, _snap(0), ts=100.0)
+        c.observe(rid, _snap(rows), ts=110.0)
+    healths = {
+        "r0": {"admission": {"saturation": 0.9}},
+        "r1": {"admission": {"saturation": 0.1}},
+        "r2": {"admission": {"saturation": 0.2}},
+    }
+    report = fleet.check_fleet(c, healths=healths, now=110.0,
+                               window_s=30.0)
+    f = report["load_skew"][0]
+    assert f["replica"] == "r0"
+    assert f["saturation"] == 0.9
+    assert f["fleet_median_saturation"] == 0.2
+
+
+def test_load_skew_idle_fleet_below_noise_floor_is_quiet():
+    c = fleet.FleetCollector(ring_depth=8)
+    c.observe("r0", _snap(0), ts=100.0)
+    c.observe("r0", _snap(5), ts=110.0)  # 0.5 rows/s: under the floor
+    c.observe("r1", _snap(0), ts=100.0)
+    c.observe("r1", _snap(0), ts=110.0)
+    report = fleet.check_fleet(c, now=110.0, window_s=30.0)
+    assert report["load_skew"] == []
+
+
+def test_load_skew_needs_two_replicas():
+    c = fleet.FleetCollector(ring_depth=8)
+    c.observe("r0", _snap(0), ts=100.0)
+    c.observe("r0", _snap(1000), ts=110.0)
+    report = fleet.check_fleet(c, now=110.0, window_s=30.0)
+    assert report["load_skew"] == []
+
+
+def test_capacity_headroom_finding_is_the_autoscaling_signal():
+    c = fleet.FleetCollector(ring_depth=8)
+    placements = {
+        "r0": {"placed_bytes": 90 << 20, "capacity_bytes": 100 << 20},
+        "r1": {"placed_bytes": 10 << 20, "capacity_bytes": 100 << 20},
+    }
+    healths = {"r0": {"admission": {"pending_bytes": 7, "saturation": 0.6,
+                                    "max_pending_bytes": 10}}}
+    report = fleet.check_fleet(c, placements=placements, healths=healths)
+    assert len(report["capacity"]) == 1
+    f = report["capacity"][0]
+    assert f["finding"] == "fleet.capacity"
+    assert f["replica"] == "r0"
+    assert f["headroom_frac"] == pytest.approx(0.1)
+    assert f["saturation"] == 0.6
+
+
+def test_compile_cache_cold_replica_finding():
+    c = fleet.FleetCollector(ring_depth=8)
+
+    def cc_snap(hits, misses, disk=0):
+        return {"counters": {
+            "serving_compile_cache_hits_total": float(hits),
+            "serving_compile_cache_disk_hits_total": float(disk),
+            "serving_compile_cache_misses_total": float(misses)},
+            "gauges": {}, "histograms": {}}
+
+    c.observe("warm", cc_snap(95, 5))
+    c.observe("cold", cc_snap(1, 9))
+    healths = {
+        "warm": {"compile_cache": {"warm_ratio": 0.95, "dir": "/cache"}},
+        "cold": {"compile_cache": {"warm_ratio": 0.1, "dir": None}},
+    }
+    report = fleet.check_fleet(c, healths=healths)
+    assert len(report["compile_cache"]) == 1
+    f = report["compile_cache"][0]
+    assert f["finding"] == "fleet.compile_cache"
+    assert f["replica"] == "cold"
+    assert f["warm_ratio"] == pytest.approx(0.1)
+    assert f["persistent_dir"] is None
+    assert "TFOS_COMPILE_CACHE_DIR" in f["hint"]
+    # warm ratio falls back to the scraped counters when healthz lacks it
+    report2 = fleet.check_fleet(c, healths={})
+    assert [f["replica"] for f in report2["compile_cache"]] == ["cold"]
+
+
+def test_compile_cache_young_replica_is_an_expected_cold_start():
+    """A replica in its first couple of minutes paying compiles is a
+    rollout, not a finding — otherwise every deploy pages.  Uptime
+    comes from the /healthz ``uptime_s`` the serving tiers publish;
+    unknown uptime stays judged."""
+    c = fleet.FleetCollector(ring_depth=8)
+    c.observe("young", {"counters": {
+        "serving_compile_cache_hits_total": 1.0,
+        "serving_compile_cache_misses_total": 9.0},
+        "gauges": {}, "histograms": {}})
+    c.observe("old", {"counters": {
+        "serving_compile_cache_hits_total": 1.0,
+        "serving_compile_cache_misses_total": 9.0},
+        "gauges": {}, "histograms": {}})
+    healths = {
+        "young": {"uptime_s": 5.0,
+                  "compile_cache": {"warm_ratio": 0.1, "dir": None}},
+        "old": {"uptime_s": 3600.0,
+                "compile_cache": {"warm_ratio": 0.1, "dir": None}},
+    }
+    report = fleet.check_fleet(c, healths=healths)
+    assert [f["replica"] for f in report["compile_cache"]] == ["old"]

@@ -198,6 +198,47 @@ def test_merge_request_docs_joins_by_trace_id():
     assert "merged_entries" not in solo
 
 
+def test_merge_request_docs_three_stores_one_tree():
+    """Router + TWO replicas contributing to one trace (a re-placed
+    tenant answers from a second replica mid-trace; a fan-out caller
+    does the same): all three stores' spans join into ONE tree keyed by
+    the trace id, upstream-most (the router) first."""
+    tid = "ef" * 16
+    router_doc = {
+        "committed": 3, "retained_total": 1, "dropped_total": 2,
+        "retained": [{
+            "trace_id": tid, "root_span_id": "aa" * 8,
+            "parent_span_id": None, "name": "mesh.request",
+            "status": "ok", "ts": 50.0, "duration_ms": 9.0,
+            "spans": [{"name": "mesh.request", "span_id": "aa" * 8,
+                       "trace_id": tid, "node": "router"},
+                      {"name": "proxy", "span_id": "ab" * 8,
+                       "trace_id": tid, "node": "router"}]}]}
+    replica_docs = [{
+        "committed": 1, "retained_total": 1, "dropped_total": 0,
+        "retained": [{
+            "trace_id": tid, "root_span_id": f"{i}{i}" * 8,
+            "parent_span_id": "aa" * 8, "name": "online.request",
+            "status": "ok", "ts": 50.001 + i, "duration_ms": 4.0,
+            "spans": [{"name": "online.request",
+                       "span_id": f"{i}{i}" * 8, "trace_id": tid,
+                       "node": f"replica{i}"}]}]}
+        for i in (1, 2)]
+    out = trace_lib.merge_request_docs([router_doc] + replica_docs)
+    assert out["stores"] == 3
+    assert len(out["retained"]) == 1
+    merged = out["retained"][0]
+    assert merged["merged_entries"] == 3
+    assert merged["name"] == "mesh.request"  # upstream-most wins
+    assert merged["nodes"] == ["replica1", "replica2", "router"]
+    assert {s["span_id"] for s in merged["spans"]} == {
+        "aa" * 8, "ab" * 8, "11" * 8, "22" * 8}
+    # scraping one store twice must not duplicate its tree
+    out2 = trace_lib.merge_request_docs(
+        [router_doc, router_doc] + replica_docs)
+    assert out2["retained"][0]["merged_entries"] == 3
+
+
 def test_reservation_qgen_reports_current_generation():
     srv = reservation.Server(1)
     addr = srv.start()
@@ -602,6 +643,228 @@ def test_concurrent_mixed_tenant_requests_route_correctly(live_mesh,
     for t in threads:
         t.join(timeout=60.0)
     assert errors == []
+
+
+# ---------------------------------------------------------------------------
+# fleet observability plane (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def _http_get(host, port, path, headers=None):
+    conn = http.client.HTTPConnection(host, port, timeout=20)
+    conn.request("GET", path, headers=headers or {})
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, resp.getheader("Content-Type"), body
+
+
+def test_fleet_endpoints_serve_federation_and_summary(live_mesh, tmp_path):
+    """The router scrapes the replica's /metrics on the health-poll
+    cadence; /fleet summarizes windowed rates and /fleet/metrics serves
+    the federated exposition (content-negotiated, replica-labeled, one
+    TYPE line per family)."""
+    router, reps = live_mesh(1, poll_interval=0.15, fleet_window_s=20.0)
+    d, _ = _make_export(tmp_path)
+    router.add_tenant("t", **_tenant_kw(d))
+    front = mesh.MeshHTTPServer(router)
+    host, port = front.start()
+    x = np.ones((1, 4), np.float32)
+    try:
+        # traffic spread over ≥2 scrape ticks so the window has a delta
+        deadline = time.monotonic() + 20.0
+        window = None
+        while time.monotonic() < deadline:
+            assert _predict_via(router, "t", x)[0] == 200
+            status, _ct, body = _http_get(host, port, "/fleet")
+            assert status == 200
+            doc = json.loads(body.decode())
+            window = (doc["replicas"].get("r0") or {}).get("window")
+            if window and window.get("rows_per_sec", 0) > 0:
+                break
+            time.sleep(0.05)
+        assert window is not None and window["rows_per_sec"] > 0
+        assert doc["enabled"] is True
+        assert doc["scrape_interval_s"] == 0.15
+        assert doc["replicas"]["r0"]["scrape"]["stale_s"] < 5.0
+        assert "findings" in doc and doc["findings"]["load_skew"] == []
+        assert any(o["signal"] == "shed_rate"
+                   for o in doc["slo_objectives"])
+
+        from tensorflowonspark_tpu.obs import httpd as _httpd
+
+        status, ctype, body = _http_get(host, port, "/fleet/metrics")
+        text = body.decode()
+        assert status == 200 and "version=0.0.4" in ctype
+        assert _httpd.validate_prometheus_text(text) == []
+        assert 'replica="r0"' in text and 'replica="router"' in text
+        assert text.count("# TYPE tfos_online_rows_total counter") == 1
+        status, ctype, body = _http_get(
+            host, port, "/fleet/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        assert status == 200 and "openmetrics" in ctype
+        assert _httpd.validate_openmetrics_text(body.decode()) == []
+        # /metrics negotiates the same way now
+        status, ctype, body = _http_get(
+            host, port, "/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        assert status == 200 and "openmetrics" in ctype
+        assert _httpd.validate_openmetrics_text(body.decode()) == []
+    finally:
+        front.stop()
+
+
+def test_fleet_opt_out_disables_the_scrape_tick(tmp_path):
+    router = _fake_router(n=2, fleet_metrics=False)
+    assert router.fleet_summary()["enabled"] is False
+    assert router.fleet.replica_ids() == []
+    router.set_fleet_enabled(True)
+    assert router.fleet_summary()["enabled"] is True
+
+
+def test_fleet_env_opt_out(monkeypatch):
+    monkeypatch.setenv("TFOS_FLEET_METRICS", "0")
+    assert mesh.fleet_metrics_default() is False
+    assert mesh.MeshRouter(expected_replicas=1)._fleet_enabled is False
+    monkeypatch.delenv("TFOS_FLEET_METRICS")
+    assert mesh.fleet_metrics_default() is True
+
+
+def test_fleet_stats_block_on_healthz(tmp_path):
+    router = _fake_router(n=1)
+    st = router.stats()
+    assert st["fleet"]["enabled"] in (True, False)
+    assert st["fleet"]["scrape"] == {}
+
+
+@pytest.mark.slow  # spawns 2 replica subprocesses (jax import each)
+def test_multiprocess_hot_replica_skew_finding_within_scrape_cadence(
+        tmp_path):
+    """The acceptance claim end-to-end: a REAL multi-process mesh (two
+    ``python -m tensorflowonspark_tpu.mesh`` replicas), all load driven
+    at one tenant → a structured ``fleet.load_skew`` finding naming the
+    hot replica, within one scrape cadence of the earliest detectable
+    window (two scrapes bracket the load, the next judgment fires) —
+    and the federated /fleet/metrics carries both replicas' genuinely
+    distinct series."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    poll = 0.5
+    router = mesh.MeshRouter(expected_replicas=2, poll_interval=poll,
+                             fail_after=4, regroup_timeout=60.0,
+                             replica_capacity_mb=64.0,
+                             fleet_window_s=10.0)
+    host, port = router.start()
+    env = dict(os.environ)
+    env[mesh.MESH_AUTH_ENV] = router.auth_token
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs, logs = [], []
+    front = None
+    try:
+        for i in range(2):
+            log = open(str(tmp_path / f"replica{i}.log"), "wb")
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                [_sys.executable, "-m", "tensorflowonspark_tpu.mesh",
+                 "--registry", f"{host}:{port}", "--replica-id", f"r{i}",
+                 "--poll-interval", "0.1"],
+                stdout=log, stderr=log, env=env, cwd=repo))
+        router.await_replicas(timeout=120.0)
+        da, wa = _make_export(tmp_path, "hot_model", scale=1.0)
+        db, _wb = _make_export(tmp_path, "cold_model", scale=2.0)
+        rid_hot = router.add_tenant(
+            "hot", wait_applied_s=60.0,
+            **_tenant_kw(da, flush_ms=2.0, max_pending_mb=8.0))
+        rid_cold = router.add_tenant(
+            "cold", wait_applied_s=60.0,
+            **_tenant_kw(db, flush_ms=2.0, max_pending_mb=1.0))
+        assert rid_hot != rid_cold
+        x = np.ones((1, 4), np.float32)
+        assert _predict_via(router, "hot", x)[0] == 200  # warm the path
+        assert _predict_via(router, "cold", x)[0] == 200
+
+        stop = threading.Event()
+        errors: list[str] = []
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    s, doc, _ = _predict_via(router, "hot", x)
+                    if s != 200:
+                        errors.append(f"status {s}: {doc}")
+                        return
+                except Exception as e:  # noqa: BLE001
+                    errors.append(repr(e))
+                    return
+
+        threads = [threading.Thread(target=hammer, daemon=True)
+                   for _ in range(4)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        detect_s = None
+        finding = None
+        while time.monotonic() - t0 < 15.0:
+            report = router.check_fleet()
+            hits = [f for f in report["load_skew"]
+                    if f["replica"] == rid_hot]
+            if hits:
+                detect_s = time.monotonic() - t0
+                finding = hits[0]
+                break
+            time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert errors == [], errors[:3]
+        assert finding is not None, "no fleet.load_skew finding fired"
+        # two scrapes bracket the load by 2×cadence; the finding must be
+        # visible within ONE further cadence (plus subprocess-CI slack)
+        assert detect_s <= 3 * poll + 1.0, detect_s
+        assert finding["finding"] == "fleet.load_skew"
+        assert finding["rows_per_sec"] > finding[
+            "fleet_median_rows_per_sec"]
+        assert finding["window_s"] == 10.0
+
+        # the federated exposition carries both replicas' DISTINCT
+        # series (multi-process: separate registries, unlike live_mesh)
+        from tensorflowonspark_tpu.obs import httpd as _httpd
+
+        front = mesh.MeshHTTPServer(router)
+        fhost, fport = front.start()
+        status, _ct, body = _http_get(fhost, fport, "/fleet/metrics")
+        text = body.decode()
+        assert status == 200
+        assert _httpd.validate_prometheus_text(text) == []
+        for rid in ("r0", "r1"):
+            assert f'tfos_online_requests_total{{replica="{rid}"}}' \
+                in text
+        assert text.count("# TYPE tfos_online_requests_total counter") \
+            == 1
+    finally:
+        if front is not None:
+            front.stop()
+        try:
+            router.stop(stop_replicas=True)
+        except Exception:
+            pass
+        for proc in procs:
+            try:
+                proc.terminate()
+                proc.wait(timeout=10)
+            except Exception:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+        try:
+            router.server.stop()
+        except Exception:
+            pass
+        for log in logs:
+            log.close()
 
 
 def test_health_stale_window_configurable_via_env(monkeypatch):

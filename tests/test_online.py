@@ -876,6 +876,9 @@ def test_stats_admission_block_aggregates_tenants(export_dir):
         assert w["shed_rate"] == 0.0
         assert adm["saturation"] == pytest.approx(
             adm["pending_bytes"] / adm["max_pending_bytes"], abs=1e-4)
+        # ISSUE 15: uptime context for the fleet view (a young replica
+        # with a low compile-cache warm ratio is an EXPECTED cold start)
+        assert doc["uptime_s"] is not None and doc["uptime_s"] >= 0.0
     finally:
         srv.stop()
 

@@ -106,7 +106,19 @@ its config identity (model geometry, page size, slot count, ladder,
 SLOs, device and host-CPU counts), and both latency p99s
 (``decode_ttft_ms_p99`` / ``decode_itl_ms_p99``) at or under their SLOs;
 the throughput is regression-judged higher-is-better and the two latency
-p99s LOWER-is-better, all within one decode config identity.
+p99s LOWER-is-better, all within one decode config identity.  From round
+``--require-fleet-from`` (default 17, the round that introduced the fleet
+observability plane) the primary half must carry ``fleet_overhead_frac``
+— the A/B-measured router-p99 cost of the fleet collector (scrape+judge
+on vs off) — as a fraction in [-1, 1], or an explicit ``null`` +
+``fleet_reason``; a numeric value must ship its config identity (replica
+and client counts, request volume, scrape cadence, host CPU count — the
+scrape thread competes with routing for cores), a numeric
+``fleet_skew_detect_s`` at or under ``3 × fleet_scrape_interval_s + 1``
+(two cadences bracket the induced hot-replica window, one further
+cadence fires the ``fleet.load_skew`` finding; the 1s is subprocess
+slack), and ``fleet_metrics_valid`` true (the federated
+``/fleet/metrics`` exposition schema-validated in-run).
 
 Usage::
 
@@ -168,6 +180,10 @@ DEFAULT_REQUIRE_COLDSTART_FROM = 15
 #: (``decode_tokens_per_sec``, introduced with token-level continuous
 #: batching over the paged KV-cache pool)
 DEFAULT_REQUIRE_DECODE_FROM = 16
+#: first round whose primary half must carry the fleet-observability
+#: microbench (``fleet_overhead_frac``, introduced with the federated
+#: metrics / SLO burn-rate / load-skew plane on the mesh router)
+DEFAULT_REQUIRE_FLEET_FROM = 17
 #: |stage_sum / wall - 1| beyond this fails the artifact: a breakdown that
 #: does not add up is decoration, not attribution
 DEFAULT_FLIGHT_TOLERANCE = 0.15
@@ -239,6 +255,14 @@ _DECODE_IDENT_KEYS = ("decode_clients", "decode_requests",
                       "decode_max_seqs", "decode_prefill_buckets",
                       "decode_ttft_slo_ms", "decode_itl_slo_ms",
                       "decode_devices", "decode_host_cpus")
+_FLEET_KEY = "fleet_overhead_frac"
+#: the fleet microbench's config identity: the collector's router-p99
+#: cost and its detection latency are only comparable at the same
+#: replica/client counts, request volume, scrape cadence and host CPU
+#: count (the scrape thread competes with routing for cores)
+_FLEET_IDENT_KEYS = ("fleet_replicas", "fleet_clients",
+                     "fleet_rows_total", "fleet_scrape_interval_s",
+                     "fleet_host_cpus")
 #: decode latency p99s regression-gated LOWER-is-better beside the
 #: throughput (a scheduler change that buys tokens/sec by doubling the
 #: tail is a regression, not a win)
@@ -359,7 +383,8 @@ def validate_half(half: dict[str, Any], *,
                   require_mesh: bool = False,
                   require_step: bool = False,
                   require_coldstart: bool = False,
-                  require_decode: bool = False) -> list[str]:
+                  require_decode: bool = False,
+                  require_fleet: bool = False) -> list[str]:
     """Schema problems of one measured result (a wrapper's half)."""
     problems = []
     for key in _REQUIRED_HALF_KEYS:
@@ -664,6 +689,64 @@ def validate_half(half: dict[str, Any], *,
                         f"{lkey} {p99} exceeds {slo_key} {slo}: a "
                         "tokens/sec claimed at an SLO it missed is not "
                         "a measurement")
+    # fleet-observability microbench: host-side multi-process like the
+    # mesh one, so a degraded-accelerator round still owes it; null +
+    # 'fleet_reason' always satisfies.  A numeric overhead must be a
+    # sane fraction, carry its config identity, prove the induced
+    # hot-replica skew was detected within one scrape cadence of the
+    # earliest detectable window, and prove the federated exposition
+    # validated — a collector whose cost is unbounded, whose detector
+    # is slower than the re-balancing loop it feeds, or whose
+    # federation emits invalid exposition is not an observability plane
+    if require_fleet or _FLEET_KEY in half:
+        if _FLEET_KEY not in half:
+            problems.append(
+                f"missing {_FLEET_KEY!r} (fleet-observability microbench "
+                "is part of the schema from r17: measure it or stamp an "
+                "explicit null + 'fleet_reason')")
+        elif half[_FLEET_KEY] is None and "fleet_reason" not in half:
+            problems.append(
+                f"{_FLEET_KEY!r} is null without a 'fleet_reason'")
+        elif isinstance(half.get(_FLEET_KEY), (int, float)):
+            if not -1.0 <= half[_FLEET_KEY] <= 1.0:
+                problems.append(
+                    f"{_FLEET_KEY!r} {half[_FLEET_KEY]} is not a "
+                    "fraction in [-1, 1] — it is (p99_on − p99_off) / "
+                    "p99_off")
+            missing = [k for k in _FLEET_IDENT_KEYS if k not in half]
+            if missing:
+                problems.append(
+                    f"{_FLEET_KEY!r} without its config identity "
+                    f"({', '.join(missing)}) — collector overhead and "
+                    "detection latency are only comparable within one "
+                    "replica/client/cadence/CPU-count config")
+            detect = half.get("fleet_skew_detect_s")
+            cadence = half.get("fleet_scrape_interval_s")
+            if not isinstance(detect, (int, float)):
+                problems.append(
+                    f"{_FLEET_KEY!r} without a numeric "
+                    "'fleet_skew_detect_s' — the detection claim is the "
+                    "plane's whole point")
+            elif isinstance(cadence, (int, float)) \
+                    and detect > 3 * cadence + 1.0:
+                problems.append(
+                    f"fleet_skew_detect_s {detect} exceeds "
+                    f"3 × {cadence}s cadence + 1s: the load-skew "
+                    "finding fired later than one cadence past the "
+                    "earliest detectable window")
+            if half.get("fleet_metrics_valid") is not True:
+                problems.append(
+                    "fleet_metrics_valid is "
+                    f"{half.get('fleet_metrics_valid')!r}: a federated "
+                    "/fleet/metrics that was not schema-validated (or "
+                    "failed) cannot back the stamped number")
+        elif half[_FLEET_KEY] is not None:
+            # neither null nor numeric (e.g. a JSON string): every fleet
+            # requirement above hangs off the numeric branch, so without
+            # this a forged value would skip the whole r17 block
+            problems.append(
+                f"{_FLEET_KEY!r} must be numeric or an explicit null "
+                f"(got {half[_FLEET_KEY]!r})")
     # request-tracing overhead: A/B-measured on the online path, so a
     # degraded-accelerator round still owes it; null + reason always
     # satisfies (e.g. TFOS_TRACE_REQUESTS=0 runs have no A to B against)
@@ -844,7 +927,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
          require_mesh_from: int = DEFAULT_REQUIRE_MESH_FROM,
          require_step_from: int = DEFAULT_REQUIRE_STEP_FROM,
          require_coldstart_from: int = DEFAULT_REQUIRE_COLDSTART_FROM,
-         require_decode_from: int = DEFAULT_REQUIRE_DECODE_FROM
+         require_decode_from: int = DEFAULT_REQUIRE_DECODE_FROM,
+         require_fleet_from: int = DEFAULT_REQUIRE_FLEET_FROM
          ) -> dict[str, Any]:
     """Run the gate over a trajectory; returns the verdict document."""
     checks: list[dict[str, Any]] = []
@@ -898,6 +982,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                           and art["n"] >= require_coldstart_from)
             require_dc = (label == "primary"
                           and art["n"] >= require_decode_from)
+            require_fo = (label == "primary"
+                          and art["n"] >= require_fleet_from)
             for problem in validate_half(half, require_roofline=require_rf,
                                          require_feed=require_fd,
                                          require_serving=require_sv,
@@ -907,7 +993,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                                          require_mesh=require_ms,
                                          require_step=require_st,
                                          require_coldstart=require_cs,
-                                         require_decode=require_dc):
+                                         require_decode=require_dc,
+                                         require_fleet=require_fo):
                 check(f"schema:{name}:{label}",
                       "fail" if is_newest else "warn", problem)
             # flight breakdowns ride the primary half with the microbench
@@ -1218,6 +1305,8 @@ def main(argv: list[str] | None = None) -> int:
                    default=DEFAULT_REQUIRE_COLDSTART_FROM)
     p.add_argument("--require-decode-from", type=int,
                    default=DEFAULT_REQUIRE_DECODE_FROM)
+    p.add_argument("--require-fleet-from", type=int,
+                   default=DEFAULT_REQUIRE_FLEET_FROM)
     args = p.parse_args(argv)
     paths = args.paths or discover(args.repo)
     if not paths:
@@ -1237,7 +1326,8 @@ def main(argv: list[str] | None = None) -> int:
                require_mesh_from=args.require_mesh_from,
                require_step_from=args.require_step_from,
                require_coldstart_from=args.require_coldstart_from,
-               require_decode_from=args.require_decode_from)
+               require_decode_from=args.require_decode_from,
+               require_fleet_from=args.require_fleet_from)
     print(json.dumps(doc))
     return 1 if doc["verdict"] == "fail" else 0
 
