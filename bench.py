@@ -275,6 +275,19 @@ def _parse_args(argv=None):
                         "schema-validated — through N replica PROCESSES "
                         "behind the real router (host-side, no "
                         "accelerator involved)")
+    p.add_argument("--incident", action="store_true",
+                   help="measure the fleet incident plane: router p99 "
+                        "A/B'd journal-on/off (incident_overhead_frac, "
+                        "expected at the noise floor — journal events "
+                        "are control-plane transitions, never "
+                        "per-request rows), then SIGKILL a replica "
+                        "under traceparent-armed SLO-breaching load and "
+                        "reconstruct ONE causally-ordered timeline from "
+                        "the spool via tools/incident.py: death event "
+                        "with the corpse's stamped last-flush, "
+                        "generation-fenced regroup, ≥1 exemplar-linked "
+                        "recovered trace (host-side, no accelerator "
+                        "involved)")
     p.add_argument("--step-collectives", action="store_true",
                    help="A/B the bucketed, overlapped gradient-collective "
                         "train step against the monolithic GSPMD step on "
@@ -2292,6 +2305,337 @@ def measure_fleet_obs(replicas: int = 2, clients: int = 6,
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def measure_incident(replicas: int = 2, clients: int = 6,
+                     reqs_per_client: int = 40, feature_dim: int = 64,
+                     batch_size: int = 32, flush_ms: float = 2.0,
+                     pairs: int = 3,
+                     deadline: "_Deadline | None" = None) -> dict:
+    """Incident-plane microbench (ISSUE 16): the journal's cost and the
+    black-box forensics claim, through a REAL multi-process mesh.
+
+    Phases:
+
+    1. **Overhead A/B** — ``pairs`` alternating (journal-off,
+       journal-on) closed loops of ``clients`` threads through
+       ``MeshRouter.route_predict``; ``incident_overhead_frac`` is the
+       median over pairs of ``(p99_on − p99_off) / p99_off``.  Journal
+       events are control-plane transitions, never per-request rows, so
+       the per-request cost is one ``enabled()`` check — the acceptance
+       claim is that this sits at the noise floor.  The toggle flips
+       ``TFOS_JOURNAL`` in the router process (the replicas journal
+       throughout: their data path has no per-request emission either).
+    2. **Chaos forensics** — traceparent-armed load against a
+       microscopic-SLO tenant until ``slo.burn`` fires (journaled as
+       ``slo.fire`` with exemplars, black-box bundles broadcast to the
+       replicas), then SIGKILL the tenant's replica and reconstruct the
+       incident from the spool with ``tools/incident.py``:
+       ``incident_timeline_valid`` stamps True only when the merged
+       timeline validates, is causally ordered, spans router AND
+       corpse, carries the death event with the corpse's stamped
+       last-flush, the generation-fenced regroup, and ≥ 1
+       exemplar-linked recovered trace.  ``incident_death_latency_s``
+       is SIGKILL → the regroup landing (detection + fence, the
+       forensic horizon).
+
+    Host-side and CPU-capable like the other serving microbenches.
+    """
+    import shutil
+    import subprocess as _subprocess
+    import tempfile as _tempfile
+    import threading
+
+    import numpy as np
+
+    from tensorflowonspark_tpu import compat, mesh
+    from tensorflowonspark_tpu.obs import journal as _journal_mod
+    from tensorflowonspark_tpu.obs import trace as _trace_mod
+
+    _tools = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools")
+    if _tools not in sys.path:
+        sys.path.insert(0, _tools)
+    import check_trace as _check_trace
+    import incident as _incident
+
+    rng = np.random.default_rng(16)
+    w = (rng.standard_normal((feature_dim, 4)).astype(np.float32)
+         * (2.0 / feature_dim) ** 0.5)
+    rows_total = clients * reqs_per_client
+    feats = rng.standard_normal(
+        (rows_total, feature_dim)).astype(np.float32)
+
+    def lin_fwd(state, batch):
+        return {"score": batch["x"] @ state["params"]["w"]}
+
+    def remaining() -> float:
+        return deadline.remaining() if deadline is not None else 1e9
+
+    tmpdir = _tempfile.mkdtemp(prefix="tfos_incident_")
+    spool = os.path.join(tmpdir, "spool")
+    os.makedirs(spool)
+    prev_env = {k: os.environ.get(k)
+                for k in ("TFOS_JOURNAL", _journal_mod.JOURNAL_DIR_ENV)}
+    router = None
+    procs: list = []
+    logs: list = []
+    try:
+        os.environ["TFOS_JOURNAL"] = "1"
+        _journal_mod.configure(spool_dir=spool, flush_interval_s=0.2)
+        export = os.path.join(tmpdir, "export")
+        compat.export_saved_model(
+            {"params": {"w": w}}, export, forward_fn=lin_fwd,
+            example_batch={"x": np.zeros((2, feature_dim), np.float32)})
+
+        poll = 0.3
+        router = mesh.MeshRouter(
+            expected_replicas=replicas, poll_interval=poll,
+            fail_after=3, regroup_timeout=60.0,
+            replica_capacity_mb=256.0, min_replicas=1,
+            fleet_window_s=5.0)
+        host, port = router.start()
+        env = dict(os.environ)
+        env[mesh.MESH_AUTH_ENV] = router.auth_token
+        env["TFOS_JOURNAL"] = "1"
+        env[_journal_mod.JOURNAL_DIR_ENV] = spool
+        env["JAX_PLATFORMS"] = "cpu"
+        for i in range(replicas):
+            log = open(os.path.join(tmpdir, f"replica{i}.log"), "wb")
+            logs.append(log)
+            procs.append(_subprocess.Popen(
+                [sys.executable, "-m", "tensorflowonspark_tpu.mesh",
+                 "--registry", f"{host}:{port}", "--replica-id", f"i{i}",
+                 "--poll-interval", "0.1"],
+                stdout=log, stderr=log, env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__))))
+        router.await_replicas(
+            timeout=min(180.0, max(60.0, remaining() - 120.0)))
+
+        import json as _json
+
+        # plain tenant for the A/B (no SLO: the off half must not differ
+        # from the on half in anything but the journal toggle)
+        router.add_tenant(
+            "ab", wait_applied_s=60.0, export_dir=export,
+            batch_size=batch_size,
+            bucket_sizes=[max(1, batch_size // 8), batch_size],
+            input_mapping={"x": "x"}, flush_ms=flush_ms,
+            max_pending_mb=64.0)
+        bodies = [
+            _json.dumps({"tenant": "ab",
+                         "inputs": {"x": feats[ri:ri + 1].tolist()}}
+                        ).encode()
+            for ri in range(rows_total)]
+
+        def via_router(ri) -> None:
+            status, _ct, body, _extra = router.route_predict(
+                bodies[ri], {})
+            if status != 200:
+                raise RuntimeError(
+                    f"router returned {status}: {body[:200]}")
+
+        def closed_loop() -> list:
+            lats: list[float] = []
+            errs: list[str] = []
+            lock = threading.Lock()
+
+            def client(ci: int) -> None:
+                try:
+                    mine = []
+                    for k in range(reqs_per_client):
+                        ri = ci * reqs_per_client + k
+                        t0 = time.perf_counter()
+                        via_router(ri)
+                        mine.append(time.perf_counter() - t0)
+                    with lock:
+                        lats.extend(mine)
+                except Exception as e:
+                    with lock:
+                        errs.append(f"client {ci}: {e!r}")
+
+            threads = [threading.Thread(target=client, args=(ci,),
+                                        daemon=True)
+                       for ci in range(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300.0)
+            if errs or any(t.is_alive() for t in threads):
+                raise RuntimeError("; ".join(errs[:3]) or "wedged caller")
+            if len(lats) != rows_total:
+                raise RuntimeError(
+                    f"lost replies: {len(lats)}/{rows_total}")
+            return lats
+
+        closed_loop()  # warm every layer + client thread, un-timed
+
+        # -- phase 1: journal-off vs journal-on router p99 -------------------
+        # alternate which half runs first each pair (residual warm-up /
+        # drift bias cancels instead of riding one side), then pool the
+        # samples per side: a per-pair p99 over a few hundred samples is
+        # 2-3 tail events of scheduler jitter, the pooled p99 is not
+        all_on: list[float] = []
+        all_off: list[float] = []
+        for pair in range(pairs):
+            if remaining() < 90:
+                raise RuntimeError("wall budget exhausted mid-A/B")
+            order = ("0", "1") if pair % 2 == 0 else ("1", "0")
+            for toggle in order:
+                os.environ["TFOS_JOURNAL"] = toggle
+                (all_off if toggle == "0" else all_on).extend(
+                    closed_loop())
+            os.environ["TFOS_JOURNAL"] = "1"
+        p_off = float(np.percentile(all_off, 99))
+        p_on = float(np.percentile(all_on, 99))
+        overhead = (p_on - p_off) / p_off
+
+        # -- phase 2: SIGKILL under load → reconstructed incident ------------
+        if remaining() < 60:
+            raise RuntimeError("wall budget exhausted before the chaos "
+                               "phase")
+        # microscopic slo_ms: every request breaches → traces retained,
+        # exemplars on the histogram, burn objective red-hot
+        victim = router.add_tenant(
+            "slo", wait_applied_s=60.0, export_dir=export,
+            input_mapping={"x": "x"}, slo_ms=0.0001, flush_ms=flush_ms,
+            max_pending_mb=64.0)
+        slo_body = _json.dumps(
+            {"tenant": "slo",
+             "inputs": {"x": feats[:1].tolist()}}).encode()
+        t0 = time.monotonic()
+        burned = False
+        while time.monotonic() - t0 < 30.0:
+            ctx = _trace_mod.TraceContext.new()
+            status, _ct, _rb, _extra = router.route_predict(
+                slo_body, {"traceparent": ctx.traceparent()})
+            if status not in (200, 429, 503):
+                raise RuntimeError(f"slo tenant returned {status}")
+            if any(f["finding"] == "slo.burn"
+                   for f in router.check_fleet()["slo_burn"]):
+                burned = True
+                break
+            time.sleep(0.02)
+        if not burned:
+            raise RuntimeError("slo.burn never fired under load")
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 15.0:
+            if any(e["type"] == "slo.fire"
+                   for e in _journal_mod.get_journal().tail(200)):
+                break
+            time.sleep(0.1)
+        else:
+            raise RuntimeError("slo.burn finding never journaled as "
+                               "slo.fire")
+
+        # the slo.burn fire also broadcast mesh:blackbox — wait for the
+        # VICTIM's anomaly bundle to land before killing it: the bundle
+        # carries its retained breach traces, the exemplars' other half
+        vic_node = f"mesh-replica-{victim}"
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 20.0:
+            if _journal_mod.blackbox_files(spool, node=vic_node):
+                break
+            time.sleep(0.1)
+        else:
+            raise RuntimeError("victim never dumped its anomaly "
+                               "black-box bundle")
+
+        idx = int(victim[1:]) if victim[1:].isdigit() else 0
+        kill_t0 = time.monotonic()
+        os.kill(procs[idx].pid, __import__("signal").SIGKILL)
+        death_latency = None
+        while time.monotonic() - kill_t0 < 60.0:
+            st = router.stats()
+            if st["generation"] >= 1 and st["state"] == "watching":
+                death_latency = time.monotonic() - kill_t0
+                break
+            time.sleep(0.2)
+        if death_latency is None:
+            raise RuntimeError("regroup never landed after SIGKILL")
+        _journal_mod.get_journal().flush()
+        _journal_mod.blackbox_dump("bench incident wrap-up",
+                                   spool_dir=spool)
+
+        out = _incident.reconstruct(spool)
+        s = out["summary"]
+        problems = _check_trace.validate_doc(out["timeline"])
+        problems += [] if s["ordered"] else ["events out of causal order"]
+        if "driver" not in s["nodes"]:
+            problems.append("router missing from the timeline")
+        if f"mesh-replica-{victim}" not in s["nodes"]:
+            problems.append("corpse missing from the timeline")
+        deaths = [d for d in s["deaths"] if d["replica"] == victim]
+        if not deaths or deaths[0]["gen"] < 1:
+            problems.append("no generation-fenced death event")
+        elif not deaths[0]["corpse"] \
+                or not deaths[0]["corpse"].get("events_flushed"):
+            problems.append("death event missing the corpse's stamped "
+                            "last-flush")
+        if not any(victim in (r["lost"] or []) for r in s["regroups"]):
+            problems.append("no regroup naming the lost replica")
+        if not s["linked"]:
+            problems.append("no journaled exemplar resolved to a "
+                            "recovered trace")
+        if problems:
+            raise RuntimeError(
+                f"incident reconstruction failed: {problems[:3]}")
+
+        return {
+            "incident_overhead_frac": round(overhead, 4),
+            "incident_router_p99_ms": round(p_on * 1000, 3),
+            "incident_router_p99_ms_off": round(p_off * 1000, 3),
+            "incident_timeline_valid": True,
+            "incident_death_latency_s": round(death_latency, 3),
+            "incident_journal_events": s["events"],
+            "incident_bundles": len(s["bundles"]),
+            "incident_linked_traces": len(s["linked"]),
+            "incident_replicas": replicas,
+            "incident_clients": clients,
+            "incident_rows_total": rows_total,
+            "incident_host_cpus": os.cpu_count(),
+        }
+    finally:
+        if router is not None:
+            try:
+                router.stop(stop_replicas=True)
+            except Exception:
+                pass
+        for proc in procs:
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except Exception:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+        if router is not None:
+            try:
+                router.server.stop()
+            except Exception:
+                pass
+        for log in logs:
+            try:
+                log.close()
+            except Exception:
+                pass
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        try:
+            # un-point the spool (cfg "" → None) so later rounds don't
+            # write into the removed tmpdir
+            _journal_mod.configure(spool_dir="")
+        except Exception:
+            pass
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def _stamp_fleet(result: dict, deadline: _Deadline) -> None:
     """Stamp the fleet-observability microbench into the headline
     result.
@@ -2317,6 +2661,34 @@ def _stamp_fleet(result: dict, deadline: _Deadline) -> None:
             result["fleet_overhead_frac"] = None
             result["fleet_reason"] = (
                 f"fleet-observability microbench failed: {e!r}"[:200])
+            sp.set(ok=False, error=str(e)[:200])
+
+
+def _stamp_incident(result: dict, deadline: _Deadline) -> None:
+    """Stamp the incident-plane microbench into the headline result.
+
+    Host-side like the fleet microbench (replica subprocesses on this
+    box, CPU capable).  The schema is total from r18: failure or an
+    exhausted wall budget stamps an explicit null + ``incident_reason``
+    (``tools/bench_gate.py --require-incident-from``)."""
+    from tensorflowonspark_tpu import obs
+
+    if deadline.remaining() < 150:
+        result["incident_overhead_frac"] = None
+        result["incident_reason"] = ("wall budget exhausted before the "
+                                     "incident-plane microbench")
+        return
+    with obs.span("bench.incident") as sp:
+        try:
+            result.update(measure_incident(deadline=deadline))
+            sp.set(ok=True,
+                   overhead_frac=result.get("incident_overhead_frac"),
+                   death_latency_s=result.get(
+                       "incident_death_latency_s"))
+        except Exception as e:
+            result["incident_overhead_frac"] = None
+            result["incident_reason"] = (
+                f"incident-plane microbench failed: {e!r}"[:200])
             sp.set(ok=False, error=str(e)[:200])
 
 
@@ -3409,6 +3781,16 @@ def main() -> None:
         print(json.dumps(result))
         return
 
+    if args.incident:
+        # host-side multi-process incident-plane measurement: no
+        # accelerator, no probe
+        result = {"metric": "incident_overhead_frac", "unit": "fraction"}
+        _stamp_incident(result, deadline)
+        result["value"] = result.get("incident_overhead_frac")
+        _write_trace_artifact(result)
+        print(json.dumps(result))
+        return
+
     if args.recovery:
         # host-side elastic-recovery measurement: no accelerator, no probe
         result = {"metric": "recovery_seconds", "unit": "seconds"}
@@ -3523,6 +3905,7 @@ def main() -> None:
     _stamp_recovery(result, deadline)
     _stamp_mesh(result, deadline)
     _stamp_fleet(result, deadline)
+    _stamp_incident(result, deadline)
     _stamp_step_collectives(result, deadline)
     _stamp_compile_cache(result, deadline)
     if not probe.get("ok"):

@@ -388,6 +388,13 @@ def sync() -> int:
         if n:
             logger.info("compile cache: pushed %d new entries to %s", n,
                         _STATE["remote_ns"])
+            try:
+                from tensorflowonspark_tpu.obs import journal as _journal
+
+                _journal.emit("compile_cache.spool", entries=n,
+                              remote_ns=str(_STATE["remote_ns"])[:200])
+            except Exception:  # pragma: no cover - best effort
+                pass
         return n
 
 

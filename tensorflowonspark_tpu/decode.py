@@ -70,6 +70,7 @@ from typing import Any, Mapping, Sequence
 
 import numpy as np
 
+from tensorflowonspark_tpu.obs import journal as _journal
 from tensorflowonspark_tpu.obs import trace as _trace
 from tensorflowonspark_tpu.online import Rejected, ShedWindow
 
@@ -195,6 +196,10 @@ class DecodeStream:
 
     def cancel(self) -> None:
         self._req.cancelled = True
+        _journal.emit("decode.cancel", slot=self._req.slot,
+                      generated=self._req.generated,
+                      **({"trace_id": self.trace_id}
+                         if self.trace_id else {}))
 
     def __iter__(self):
         return self.tokens()
@@ -728,6 +733,11 @@ class DecodeEngine:
         self._active += 1
         self._seq_lens[slot] = req.prompt_len
         self._tokens[slot] = tok
+        _journal.emit(
+            "decode.admit", slot=slot, pages=len(pages),
+            prompt_len=req.prompt_len,
+            queue_s=round(req.t_admit - req.t_submit, 6),
+            **({"trace_id": req.rt.ctx.trace_id} if req.rt else {}))
         self._emit(req, tok)
         if req.generated >= req.max_new_tokens or (
                 self.eos_id is not None and tok == self.eos_id):
@@ -765,13 +775,26 @@ class DecodeEngine:
         req.generated += 1
         if req.ttft_s is None:
             req.ttft_s = now - req.t_submit
-            self._ttft_hist.observe(req.ttft_s)
+            # exemplar only on an SLO-breaching observation of an armed
+            # request: a breach guarantees _finish retains the trace
+            # ("slo_breach"), so a dashboard click through the exemplar
+            # always lands on a trace that exists (the online tier's
+            # retained-only exemplar rule)
+            self._ttft_hist.observe(
+                req.ttft_s,
+                exemplar=({"trace_id": req.rt.ctx.trace_id}
+                          if req.rt is not None
+                          and req.ttft_s > self.ttft_slo_s else None))
             with self._lock:
                 self._ttft_window.note(req.ttft_s)
         else:
             itl = now - req.t_last
             req.max_itl_s = max(req.max_itl_s, itl)
-            self._itl_hist.observe(itl)
+            self._itl_hist.observe(
+                itl,
+                exemplar=({"trace_id": req.rt.ctx.trace_id}
+                          if req.rt is not None
+                          and itl > self.itl_slo_s else None))
             with self._lock:
                 self._itl_window.note(itl)
             if req.rt is not None and req.generated <= _MAX_TOKEN_SPANS:
@@ -795,6 +818,10 @@ class DecodeEngine:
             req.pages = []
         self._pages_used_g.set(self.pool.used_pages)
         self._active_g.set(self._active)
+        _journal.emit(
+            "decode.retire", slot=slot, status=status,
+            tokens=req.generated,
+            **({"trace_id": req.rt.ctx.trace_id} if req.rt else {}))
         self._finish(req, status, err)
 
     def _finish(self, req: _DecodeRequest, status: str,

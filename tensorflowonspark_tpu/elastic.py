@@ -57,6 +57,7 @@ import time
 from typing import Any, Callable
 
 from tensorflowonspark_tpu import obs, reservation
+from tensorflowonspark_tpu.obs import journal as _journal
 
 logger = logging.getLogger(__name__)
 
@@ -443,6 +444,15 @@ class ElasticSupervisor:
         obs.counter("elastic_lost_nodes_total").inc(len(lost_new))
         obs.event("elastic.regrouped", gen=gen, lost=",".join(lost_new),
                   barrier_seconds=round(barrier_s, 3))
+        # journal under the NEW fence (see mesh.regroup): deaths and the
+        # bump itself happened-after the barrier
+        _journal.get_journal().set_generation(gen)
+        for node in lost_new:
+            _journal.emit("replica.death", replica=node, gen=gen,
+                          reason=reason, plane="elastic")
+        _journal.emit("elastic.regroup", gen=gen, lost=lost_new,
+                      survivors=record["nodes"],
+                      barrier_seconds=round(barrier_s, 3))
         # recovery_seconds completes asynchronously: survivors stamp their
         # first post-restore step on the kv; blocking the regroup (and the
         # feed replay behind it) on that stamp would *inflate* the very

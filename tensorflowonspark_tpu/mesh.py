@@ -88,6 +88,7 @@ from typing import Any, Mapping, Sequence
 
 from tensorflowonspark_tpu import elastic, obs, reservation
 from tensorflowonspark_tpu.obs import fleet as _fleet
+from tensorflowonspark_tpu.obs import journal as _journal
 from tensorflowonspark_tpu.obs import trace as _trace
 
 logger = logging.getLogger(__name__)
@@ -102,6 +103,11 @@ MESH_JOIN_PREFIX = "mesh:join:"
 MESH_APPLIED_PREFIX = "mesh:applied:"
 #: graceful fleet shutdown broadcast
 MESH_STOP_KEY = "mesh:stop"
+#: black-box capture broadcast (router → replicas): an epoch-stamped
+#: command telling every replica to spool a black-box bundle NOW — fired
+#: on anomaly findings (slo.burn) so breach-retained traces reach disk
+#: while their owner is still alive to dump them
+MESH_BLACKBOX_KEY = "mesh:blackbox"
 
 #: env var carrying the mesh auth token into replica processes (an argv
 #: token would be visible in ``ps``)
@@ -377,6 +383,7 @@ class MeshRouter:
         #: finding keys that already fired an obs event (re-fires only
         #: after the finding clears and re-appears)
         self._fleet_fired: set[tuple] = set()
+        self._blackbox_epoch = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -399,6 +406,9 @@ class MeshRouter:
                 self._replicas[rid] = _Replica(rid, meta)
             self.state = "watching"
             self._replicas_up.set(len(self._replicas))
+            member_ids = sorted(self._replicas)
+        for rid in member_ids:
+            _journal.emit("replica.join", replica=rid, gen=0)
         if self._thread is None:
             self._thread = threading.Thread(
                 target=self._watch, name="tfos-mesh-router-watch",
@@ -528,6 +538,10 @@ class MeshRouter:
         self.server.kv_put(MESH_PLACEMENT_KEY, {
             "version": self._version, "gen": self.generation,
             "assignments": assignments, "ts": time.time()})
+        _journal.emit("placement.publish", version=self._version,
+                      gen=self.generation,
+                      tenants=sum(len(v) for v in assignments.values()),
+                      replicas=len(assignments))
         return self._version
 
     def _await_applied(self, tenant: str, rid: str, version: int,
@@ -655,7 +669,41 @@ class MeshRouter:
                     k: v for k, v in f.items()
                     if k != "finding" and isinstance(
                         v, (str, int, float, bool))})
+                _journal.emit(
+                    "slo.fire", objective=f.get("objective"),
+                    tenant=f.get("tenant"), signal=f.get("signal"),
+                    burn_fast=f.get("burn_fast"),
+                    burn_slow=f.get("burn_slow"),
+                    exemplars=f.get("exemplars") or [])
+                # anomaly-triggered black-box capture: replicas dump
+                # their trace rings and retained requests to the spool
+                # on their next poll, while the exemplar-cited traces
+                # are still in memory — a later SIGKILL then loses
+                # nothing the incident merge needs
+                self.request_blackbox(
+                    f"slo.burn {f.get('objective')} "
+                    f"tenant={f.get('tenant')}")
+        for key in self._fleet_fired - fired:
+            # episodic clear: the objective burned last tick and no
+            # longer does — the journal's fire/clear pair brackets the
+            # incident window tools/incident.py reconstructs
+            if key[0] == "slo.burn":
+                _journal.emit("slo.clear", objective=key[1],
+                              tenant=key[2])
         self._fleet_fired = fired
+
+    def request_blackbox(self, reason: str) -> int:
+        """Broadcast an epoch-stamped black-box capture command: every
+        replica spools a bundle (journal tail + trace ring + retained
+        requests + flight + metrics) on its next poll.  Fired
+        automatically when an ``slo.burn`` finding opens; callable by
+        operators/benches for on-demand fleet capture.  Returns the
+        epoch."""
+        self._blackbox_epoch += 1
+        self.server.kv_put(MESH_BLACKBOX_KEY, {
+            "epoch": self._blackbox_epoch, "reason": str(reason)[:200],
+            "ts": time.time()})
+        return self._blackbox_epoch
 
     def slo_objectives(self) -> list[Any]:
         """The declarative objective set: explicit objectives passed at
@@ -770,6 +818,40 @@ class MeshRouter:
         if openmetrics:
             return self.fleet.to_openmetrics(extra=extra)
         return self.fleet.to_prometheus(extra=extra)
+
+    def fleet_events(self, since: str | None = None,
+                     limit: int = 500) -> dict[str, Any]:
+        """The ``GET /fleet/events`` body: the federated journal.
+
+        Merges this process's journal ring with every process's spooled
+        events under the shared journal dir (``TFOS_JOURNAL_DIR``) into
+        ONE total causal order (the hybrid key — see
+        :mod:`tensorflowonspark_tpu.obs.journal`), strictly after the
+        ``since`` cursor when given, capped at ``limit``.  The reply's
+        ``cursor`` names the last returned event: pass it back as
+        ``since`` to page forward; ``more`` says whether the cap
+        truncated."""
+        j = _journal.get_journal()
+        sources = [j.snapshot()]
+        spool = j.spool_dir or os.environ.get(_journal.JOURNAL_DIR_ENV)
+        if spool:
+            sources.append(_journal.read_spool(spool))
+        events = _journal.merge_events(*sources)
+        if since:
+            key = _journal.decode_cursor(since)
+            if key is not None:
+                events = [e for e in events
+                          if _journal.order_key(e) > key]
+        total = len(events)
+        limit = max(1, int(limit))
+        events = events[:limit]
+        return {
+            "events": events,
+            "count": len(events),
+            "more": total > len(events),
+            "cursor": (_journal.encode_cursor(events[-1])
+                       if events else (since or None)),
+        }
 
     def _refresh_applied(self) -> None:
         try:
@@ -924,6 +1006,33 @@ class MeshRouter:
         obs.event("mesh.regrouped", gen=gen, lost=",".join(lost_new),
                   joined=",".join(join_ids),
                   barrier_seconds=round(barrier_s, 3))
+        # journal the membership change under the NEW generation fence:
+        # these events happened-after the barrier, and the fence in their
+        # ordering key is what keeps them after every survivor's gen-N-1
+        # events even across clock skew
+        _journal.get_journal().set_generation(gen)
+        spool = _journal.get_journal().spool_dir \
+            or os.environ.get(_journal.JOURNAL_DIR_ENV)
+        for rid in lost_new:
+            # stamp what the corpse last managed to flush (its spooled
+            # journal tail + newest valid black-box bundle) into the
+            # death event — the death record names the dead process's
+            # last words, or says explicitly that there were none
+            corpse = None
+            if spool:
+                try:
+                    corpse = _journal.corpse_bundle(
+                        spool, f"mesh-replica-{rid}")
+                except Exception:  # forensics must not fail the regroup
+                    corpse = None
+            _journal.emit("replica.death", replica=rid, gen=gen,
+                          reason=reason, corpse=corpse)
+        for rid in join_ids:
+            _journal.emit("replica.join", replica=rid, gen=gen,
+                          joined=True)
+        _journal.emit("mesh.regroup", gen=gen, lost=lost_new,
+                      joined=join_ids, survivors=survivor_ids,
+                      barrier_seconds=round(barrier_s, 3))
         return record
 
     # -- data path -----------------------------------------------------------
@@ -997,6 +1106,8 @@ class MeshRouter:
             if tshed is not None:
                 tshed.inc()
             ra = max(0.05, cfg["flush_ms"] / 1000.0)
+            _journal.emit("admission.shed", tenant=tenant, replica=rid,
+                          where="router", why=shed_why[:200])
             if rt is not None:
                 rt.add("route", time.perf_counter() - t0,
                        outcome="shed", replica=rid, why=shed_why)
@@ -1236,6 +1347,10 @@ class MeshHTTPServer:
       rates and latency quantiles, scrape freshness, capacity context,
       and the current findings (load skew / capacity / compile cache /
       SLO burn);
+    - ``GET /fleet/events`` — the federated journal: every process's
+      control-plane events merged into one causally-ordered timeline,
+      paginated with ``?since=<cursor>&limit=N``
+      (:meth:`MeshRouter.fleet_events`);
     - ``GET /debug/requests`` — router+replica span trees merged by
       trace id (slowest-first).
     """
@@ -1251,6 +1366,7 @@ class MeshHTTPServer:
                 "/metrics": httpd.with_headers(self._metrics),
                 "/fleet": self._fleet,
                 "/fleet/metrics": httpd.with_headers(self._fleet_metrics),
+                "/fleet/events": httpd.with_query(self._fleet_events),
                 "/debug/requests": self._debug_requests,
             },
             post_routes={"/v1/predict": router.route_predict},
@@ -1281,6 +1397,16 @@ class MeshHTTPServer:
         return (200, httpd.OPENMETRICS_CONTENT_TYPE if om
                 else httpd.PROMETHEUS_CONTENT_TYPE,
                 self.router.fleet_metrics_text(openmetrics=om))
+
+    def _fleet_events(self, query: dict) -> tuple:
+        try:
+            limit = int(query.get("limit", 500))
+        except (TypeError, ValueError):
+            return (400, "application/json",
+                    json.dumps({"error": "limit must be an integer"}))
+        return (200, "application/json",
+                json.dumps(self.router.fleet_events(
+                    since=query.get("since") or None, limit=limit)))
 
     def _debug_requests(self) -> tuple:
         return (200, "application/json",
@@ -1321,7 +1447,9 @@ class ReplicaAgent:
       add/remove-tenant diff against the local server, then confirmed on
       ``mesh:applied:<id>`` (the router routes only confirmed
       assignments);
-    - ``mesh:stop``: graceful fleet shutdown.
+    - ``mesh:stop``: graceful fleet shutdown;
+    - ``mesh:blackbox``: epoch-stamped capture command — spool a
+      black-box bundle now (anomaly-triggered forensics).
     """
 
     def __init__(self, replica_id: str, registry_addr, auth_token: str,
@@ -1344,6 +1472,7 @@ class ReplicaAgent:
         # ElasticWorker discipline)
         self._client = reservation.Client(self.registry_addr, auth_token,
                                           retries=0)
+        self._blackbox_seen = 0
 
     def _meta(self) -> dict[str, Any]:
         host, port = self.http.address
@@ -1363,6 +1492,12 @@ class ReplicaAgent:
             client.register(meta)
             logger.info("replica %s registered with %s", self.replica_id,
                         self.registry_addr)
+        try:  # pre-start capture commands are not news
+            cmd = client.get(MESH_BLACKBOX_KEY, timeout=0.0)
+            if isinstance(cmd, dict):
+                self._blackbox_seen = int(cmd.get("epoch") or 0)
+        except Exception:
+            pass
         self.state = "serving"
         self._thread = threading.Thread(
             target=self._poll, name=f"tfos-mesh-agent-{self.replica_id}",
@@ -1394,9 +1529,29 @@ class ReplicaAgent:
                         return
                 self._apply_placement_if_newer()
                 self._check_stop()
+                self._check_blackbox()
             except Exception as e:  # the loop must survive anything
                 logger.debug("mesh agent %s poll failed: %s",
                              self.replica_id, e)
+
+    def _check_blackbox(self) -> None:
+        """Honor a ``mesh:blackbox`` capture command exactly once per
+        epoch.  Commands published before this agent started are not
+        news (``start()`` primes the seen-epoch), and a dump failure is
+        swallowed — forensics must never take down the data plane."""
+        try:
+            cmd = self._client.get(MESH_BLACKBOX_KEY, timeout=0.0)
+        except Exception:
+            return
+        if not isinstance(cmd, dict):
+            return
+        epoch = int(cmd.get("epoch") or 0)
+        if epoch <= self._blackbox_seen:
+            return
+        self._blackbox_seen = epoch
+        _journal.blackbox_dump(
+            f"fleet anomaly: {cmd.get('reason', '?')}",
+            replica=self.replica_id, epoch=epoch)
 
     def _handle_regroup(self, cmd: dict[str, Any]) -> None:
         gen = int(cmd["gen"])
@@ -1409,6 +1564,15 @@ class ReplicaAgent:
             self.last_error = f"declared lost in generation {gen}"
             obs.event("mesh.replica_fenced", replica=self.replica_id,
                       gen=gen)
+            # the fence is this process's last scene: journal it, dump
+            # the black box (an anomaly verdict was just passed on us),
+            # and flush so the router's death stamping finds both
+            _journal.emit("replica.fenced", replica=self.replica_id,
+                          gen=gen)
+            _journal.blackbox_dump(
+                f"fenced lost in generation {gen}",
+                replica=self.replica_id)
+            _journal.get_journal().flush()
             self._stop.set()
             self._done.set()
             return
@@ -1424,6 +1588,9 @@ class ReplicaAgent:
                                         self.auth_token, generation=gen)
             client.register(self._meta())
         self.generation = gen
+        _journal.get_journal().set_generation(gen)
+        _journal.emit("replica.join", replica=self.replica_id, gen=gen,
+                      rejoin=True)
         obs.counter("mesh_rejoins_total").inc()
         logger.info("replica %s re-registered under generation %d",
                     self.replica_id, gen)
@@ -1479,6 +1646,10 @@ class ReplicaAgent:
             "version": version, "gen": self.generation,
             "tenants": sorted(self._applied_cfgs),
             "errors": errors, "ts": time.time()})
+        _journal.emit("placement.applied", replica=self.replica_id,
+                      version=version, gen=self.generation,
+                      tenants=len(self._applied_cfgs),
+                      errors=len(errors))
         self._applied_version = version
 
     def _check_stop(self) -> None:
@@ -1529,6 +1700,12 @@ def replica_main(argv: list[str] | None = None) -> int:
     from tensorflowonspark_tpu import online
 
     obs.configure(node=f"mesh-replica-{args.replica_id}")
+    # journal identity + SIGTERM black box: the spool (TFOS_JOURNAL_DIR)
+    # is what survives a SIGKILL; the signal dump covers graceful-ish
+    # deaths.  Fast flush cadence — a replica's story is short and the
+    # whole point is that the tail reaches disk before the end
+    _journal.configure(node=f"mesh-replica-{args.replica_id}",
+                       flush_interval_s=0.25)
     srv = online.OnlineServer()
     http_srv = online.OnlineHTTPServer(srv, host=args.http_host,
                                        port=args.http_port)
@@ -1542,6 +1719,10 @@ def replica_main(argv: list[str] | None = None) -> int:
         agent.stop()
 
     signal.signal(signal.SIGTERM, _sigterm)
+    # chain the black-box dump OVER the stop handler — installing the
+    # dump first and then registering _sigterm would overwrite the
+    # chain and a SIGTERMed replica would die without its bundle
+    _journal.install_signal_dump()
     agent.start(join=args.join)
     logger.info("replica %s serving on %s (registry %s)",
                 args.replica_id, http_srv.url(), args.registry)
@@ -1552,6 +1733,7 @@ def replica_main(argv: list[str] | None = None) -> int:
         agent.stop()
     http_srv.stop()
     srv.stop()
+    _journal.get_journal().flush()
     return 2 if agent.state == "lost" else 0
 
 

@@ -50,6 +50,20 @@ histograms) live in the same registry
 (:mod:`tensorflowonspark_tpu.online`,
 :mod:`tensorflowonspark_tpu.decode`).
 
+And the fleet incident plane (ISSUE 16 tentpole):
+
+- **event journal** (:mod:`.journal`) — every control-plane transition
+  (placement flips + applied confirmations, replica join/death/regroup
+  with its generation fence, admission sheds, ``slo.burn`` fire/clear,
+  compile-cache spools, decode slot lifecycle) appended as a typed event
+  with a hybrid ``(gen, ts, node, pid, seq)`` ordering key so one total
+  causal order survives clock skew; cadence-flushed through the fs seam
+  (``TFOS_JOURNAL_DIR``) so it survives SIGKILL; federated with
+  since-cursor pagination on ``GET /fleet/events``; black-box crash
+  dumps bundle journal tail + trace ring + flight records + metrics on
+  SIGTERM/anomaly; ``tools/incident.py`` merges it all into one
+  Perfetto timeline.  ``TFOS_JOURNAL=0`` disables.
+
 Instrumented out of the box: cluster lifecycle (``TFCluster`` /
 ``TFSparkNode`` bootstrap, reserve, probe, shutdown), the trainer
 (``trainer.Trainer`` init + step counters, optional ``jax.profiler`` step
@@ -66,6 +80,7 @@ from tensorflowonspark_tpu.obs import (  # noqa: F401
     fleet,
     flight,
     httpd,
+    journal,
     roofline,
     trace,
 )
@@ -105,7 +120,8 @@ from tensorflowonspark_tpu.obs.trace import (  # noqa: F401
 )
 
 __all__ = [
-    "anomaly", "chrome", "fleet", "flight", "httpd", "roofline", "trace",
+    "anomaly", "chrome", "fleet", "flight", "httpd", "journal",
+    "roofline", "trace",
     "Counter", "Gauge", "Histogram", "Registry",
     "counter", "gauge", "histogram", "get_registry",
     "merge_snapshots", "merged_to_prometheus", "relabel_snapshot",

@@ -141,6 +141,30 @@ def _parse_value(s: str) -> float:
     return float(s)
 
 
+_EXEMPLAR_RE = re.compile(
+    r"^\{(?P<labels>(?:[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""
+    r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*)?)\}"
+    r"\s+(?P<value>\S+)(?:\s+(?P<ts>\S+))?\s*$")
+_EXEMPLAR_LABEL_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_exemplar(s: str) -> list | None:
+    """`` {trace_id="..."} value [ts]`` → the registry's snapshot shape
+    ``[labels, value, ts]``; None when malformed (dropped, not fatal)."""
+    m = _EXEMPLAR_RE.match(s.strip())
+    if m is None:
+        return None
+    try:
+        value = _parse_value(m.group("value"))
+        ts = float(m.group("ts")) if m.group("ts") else 0.0
+    except ValueError:
+        return None
+    labels = {k: _registry._unescape(v)
+              for k, v in _EXEMPLAR_LABEL_RE.findall(m.group("labels"))}
+    return [labels, value, ts]
+
+
 def parse_exposition(text: str, prefix: str = "tfos_") -> dict[str, Any]:
     """Prometheus text exposition → a registry-snapshot-shaped dict.
 
@@ -151,10 +175,13 @@ def parse_exposition(text: str, prefix: str = "tfos_") -> dict[str, Any]:
     from family names so the parsed snapshot keys match what
     ``Registry.snapshot()`` would produce locally.  Histogram families
     are reassembled from their ``_bucket``/``_sum``/``_count`` samples
-    (cumulative buckets, ``le`` kept as ``"+Inf"`` or a float); exemplar
-    annotations are ignored (federation carries values, not traces).
-    Unknown lines are skipped rather than fatal — a scrape must survive
-    a foreign exporter's extensions.
+    (cumulative buckets, ``le`` kept as ``"+Inf"`` or a float); bucket
+    exemplar annotations (`` # {trace_id="..."} value ts``) are RETAINED
+    into the snapshot's ``exemplars`` map (ISSUE 16: federation carries
+    the trace link, so a fleet-level ``slo.burn`` finding can name the
+    tail request that filled the bucket) — a malformed exemplar is
+    dropped, never fatal.  Unknown lines are skipped rather than fatal —
+    a scrape must survive a foreign exporter's extensions.
     """
     from tensorflowonspark_tpu.obs.httpd import _split_exemplar
 
@@ -170,7 +197,7 @@ def parse_exposition(text: str, prefix: str = "tfos_") -> dict[str, Any]:
             if len(parts) >= 4 and parts[1] == "TYPE":
                 types[parts[2]] = parts[3]
             continue
-        line, _exemplar = _split_exemplar(line)
+        line, exemplar_s = _split_exemplar(line)
         m = _split_sample(line)
         if m is None:
             continue
@@ -197,6 +224,12 @@ def parse_exposition(text: str, prefix: str = "tfos_") -> dict[str, Any]:
             if part == "_bucket" and le is not None:
                 bound = "+Inf" if le == "+Inf" else float(le)
                 h["buckets"][bound] = value
+                if exemplar_s:
+                    ex = _parse_exemplar(exemplar_s)
+                    if ex is not None:
+                        # keyed by the le STRING exactly as the registry
+                        # exports it — re-emission and merge round-trip
+                        h.setdefault("exemplars", {})[le] = ex
             elif part == "_sum":
                 h["sum"] = value
             elif part == "_count":
@@ -211,9 +244,12 @@ def parse_exposition(text: str, prefix: str = "tfos_") -> dict[str, Any]:
         buckets = sorted(
             h["buckets"].items(),
             key=lambda kv: float("inf") if kv[0] == "+Inf" else kv[0])
-        snap["histograms"][key] = {
+        doc = {
             "buckets": [[le, int(n)] for le, n in buckets],
             "sum": h["sum"], "count": h["count"]}
+        if h.get("exemplars"):
+            doc["exemplars"] = h["exemplars"]
+        snap["histograms"][key] = doc
     return snap
 
 
@@ -321,7 +357,13 @@ class FleetCollector:
                        timeout: float) -> str:
         conn = http.client.HTTPConnection(host, port, timeout=timeout)
         try:
-            conn.request("GET", "/metrics")
+            # ask for the OpenMetrics flavor: it is the one that carries
+            # bucket exemplars, and parse_exposition retains them so the
+            # SLO burn engine can name the tail traces behind a finding.
+            # A replica that only speaks classic text ignores the header
+            # and everything still parses
+            conn.request("GET", "/metrics", headers={
+                "Accept": "application/openmetrics-text"})
             resp = conn.getresponse()
             body = resp.read()
             if resp.status != 200:
@@ -799,6 +841,50 @@ def _bad_fraction(obj: Objective, fw: dict[str, Any]
     return bad / offered, offered
 
 
+def burn_exemplars(collector: FleetCollector, obj: Objective,
+                   cap: int = 5) -> list[dict[str, Any]]:
+    """Exemplar trace links behind one burning latency objective.
+
+    Reads each replica's LATEST scraped snapshot (the ring head — the
+    windowed deltas carry counts, not exemplars) and collects the
+    objective family's bucket exemplars whose observed value actually
+    breached the threshold, newest first, capped at ``cap``.  Every
+    exemplar the registry records rides a RETAINED trace (the emitters'
+    retained-only rule), so each ``trace_id`` here resolves on the
+    owning replica's ``/debug/requests``.  Counter signals (shed/error
+    rate) have no exemplars — empty list."""
+    if obj.signal not in _SIGNAL_HISTS:
+        return []
+    fam, labeled = _SIGNAL_HISTS[obj.signal]
+    thresh_s = (obj.threshold_ms or 0.0) / 1000.0
+    out: list[dict[str, Any]] = []
+    for rid in collector.replica_ids():
+        latest = collector.latest(rid)
+        if latest is None:
+            continue
+        for series, h in (latest[1].get("histograms") or {}).items():
+            name, labels = _registry.split_series(series)
+            if name != fam:
+                continue
+            if labeled and obj.tenant \
+                    and labels.get("tenant") != obj.tenant:
+                continue
+            for _le_s, ex in (h.get("exemplars") or {}).items():
+                try:
+                    ex_labels, value, ts = ex
+                    value = float(value)
+                except (TypeError, ValueError):
+                    continue
+                tid = (ex_labels or {}).get("trace_id")
+                if not tid or value <= thresh_s:
+                    continue
+                out.append({"trace_id": tid, "replica": rid,
+                            "value_ms": round(value * 1000, 3),
+                            "ts": ts})
+    out.sort(key=lambda e: -(e.get("ts") or 0.0))
+    return out[:cap]
+
+
 def evaluate_slo(collector: FleetCollector,
                  objectives: Sequence[Objective],
                  now: float | None = None,
@@ -806,7 +892,10 @@ def evaluate_slo(collector: FleetCollector,
                  ) -> list[dict[str, Any]]:
     """Judge every objective over its fast AND slow windows; returns the
     ``slo.burn`` findings that fired (module doc: both windows must
-    burn — the corroboration requirement)."""
+    burn — the corroboration requirement).  A latency-signal finding
+    carries an ``exemplars`` list (:func:`burn_exemplars`) when the
+    scraped snapshots hold breaching bucket exemplars — the link from
+    the alert straight to the tail-sampled trace trees."""
     now = time.time() if now is None else float(now)
     findings: list[dict[str, Any]] = []
     windows: dict[float, dict[str, Any]] = {}
@@ -828,6 +917,7 @@ def evaluate_slo(collector: FleetCollector,
         burn_slow = slow_bad / obj.budget
         if burn_fast >= obj.burn_threshold \
                 and burn_slow >= obj.burn_threshold:
+            exemplars = burn_exemplars(collector, obj)
             findings.append({
                 "finding": "slo.burn",
                 "objective": obj.name,
@@ -843,6 +933,9 @@ def evaluate_slo(collector: FleetCollector,
                 "fast_window_s": obj.fast_window_s,
                 "slow_window_s": obj.slow_window_s,
                 "burn_threshold": obj.burn_threshold,
+                # added only when present: the exemplar-free finding
+                # shape is unchanged for existing consumers
+                **({"exemplars": exemplars} if exemplars else {}),
             })
     return findings
 

@@ -72,6 +72,21 @@ def with_headers(fn: Callable[[Any], tuple]) -> Callable[[], tuple]:
     return route
 
 
+def with_query(fn: Callable[[dict], tuple]) -> Callable[[], tuple]:
+    """Mark a GET route as wanting the parsed query parameters.
+
+    The handler strips the query string before route lookup (a path is a
+    path), so a route that paginates — ``/fleet/events?since=<cursor>``
+    — opts in with this marker and is called as ``fn(query)`` with a
+    flat ``{key: last_value}`` dict (repeated keys keep the last value,
+    the usual single-valued-parameter reading)."""
+    def route(query):
+        return fn(query)
+
+    route.wants_query = True  # type: ignore[attr-defined]
+    return route
+
+
 def wants_openmetrics(headers: Any) -> bool:
     """Does the scraper's Accept header ask for the OpenMetrics flavor?"""
     accept = (headers.get("Accept", "") if headers is not None else "") or ""
@@ -121,6 +136,14 @@ class ObservabilityServer:
                 if getattr(route, "wants_headers", False):
                     headers = self.headers
                     self._run_route(path, lambda: route(headers))
+                elif getattr(route, "wants_query", False):
+                    from urllib.parse import parse_qs
+
+                    raw = self.path.split("?", 1)
+                    qs = parse_qs(raw[1], keep_blank_values=True) \
+                        if len(raw) == 2 else {}
+                    query = {k: v[-1] for k, v in qs.items()}
+                    self._run_route(path, lambda: route(query))
                 else:
                     self._run_route(path, route)
 
@@ -298,20 +321,31 @@ def validate_prometheus_text(text: str, *,
 
     Exemplar annotations (`` # {trace_id="..."} value [ts]``) are
     accepted on ``_bucket`` sample lines in either mode and validated for
-    syntax; ``openmetrics=True`` additionally requires the terminal
-    ``# EOF`` line (and nothing after it) — use
+    syntax and the OpenMetrics 128-rune label budget; label blocks are
+    parsed quote-aware (a ``}`` or ``#`` inside a quoted value never
+    splits the line) and checked against the ``name="escaped value"``
+    pair grammar.  ``openmetrics=True`` additionally requires the
+    terminal ``# EOF`` line (and nothing after it) — use
     :func:`validate_openmetrics_text` for that entry point.
     """
     import re
 
     problems: list[str] = []
     typed: dict[str, str] = {}
+    # the label block is quote-aware: a '}' inside a quoted value (e.g.
+    # path="a}b") must not terminate it early
     sample_re = re.compile(
-        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+        r"(\{(?:[^\"{}]|\"(?:[^\"\\]|\\.)*\")*\})?\s+(\S+)$")
+    label_block_re = re.compile(
+        r"^\{(?:[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""
+        r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*,?)?\}$")
     exemplar_re = re.compile(
         r"^\{([a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""
         r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*)?\}"
         r"\s+(\S+)(\s+\S+)?$")
+    exemplar_label_re = re.compile(
+        r"([a-zA-Z_][a-zA-Z0-9_]*)=\"((?:[^\"\\]|\\.)*)\"")
     saw_eof = False
     for i, line in enumerate(text.splitlines()):
         line = line.rstrip()
@@ -344,6 +378,10 @@ def validate_prometheus_text(text: str, *,
         if not m:
             problems.append(f"{where}: unparseable sample {line!r}")
             continue
+        if m.group(2) and not label_block_re.match(m.group(2)):
+            problems.append(
+                f"{where}: malformed label block {m.group(2)!r} "
+                "(expected name=\"escaped value\" pairs)")
         value = m.group(3)
         if value not in ("+Inf", "-Inf", "NaN"):
             try:
@@ -375,6 +413,12 @@ def validate_prometheus_text(text: str, *,
                     problems.append(
                         f"{where}: non-numeric exemplar value "
                         f"{em.group(2)!r}")
+                runes = sum(len(k) + len(v) for k, v in
+                            exemplar_label_re.findall(em.group(1) or ""))
+                if runes > 128:
+                    problems.append(
+                        f"{where}: exemplar label set is {runes} runes "
+                        "(OpenMetrics caps name+value length at 128)")
     if openmetrics and not saw_eof:
         problems.append("missing the terminal '# EOF' line (OpenMetrics "
                         "requires it)")

@@ -178,13 +178,40 @@ def _series_max() -> int:
         return _DEFAULT_SERIES_MAX
 
 
+_LABEL_NAME_OK_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_LABEL_NAME_BAD_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _safe_label(name: str) -> str:
+    """Coerce an arbitrary string into a legal Prometheus label name.
+
+    Caller-supplied label keys (tenant ids, exemplar annotations) can
+    carry characters the exposition grammar forbids; emitting them
+    verbatim would poison the whole scrape.  Invalid runes become ``_``,
+    a leading digit gets an underscore prefix, empty becomes ``_``.
+    Distinct unsafe names may collide after sanitization — that loses a
+    label dimension, never the exposition."""
+    name = str(name)
+    if _LABEL_NAME_OK_RE.match(name):
+        return name
+    name = _LABEL_NAME_BAD_RE.sub("_", name) or "_"
+    if name[0].isdigit():
+        name = "_" + name
+    return name
+
+
 def series_key(name: str, labels: dict[str, str] | None) -> str:
     """Full series key: ``name{k="v",...}`` with sorted, escaped labels
-    (the snapshot/merge key AND the exposition series identity)."""
+    (the snapshot/merge key AND the exposition series identity).  Label
+    names are sanitized (:func:`_safe_label`) so no caller-supplied key
+    can emit an unparseable series."""
     if not labels:
         return name
+    safe: dict[str, str] = {}
+    for k, v in sorted(labels.items()):  # collisions: last raw key wins
+        safe[_safe_label(k)] = v
     inner = ",".join(f'{k}="{_escape(v)}"'
-                     for k, v in sorted(labels.items()))
+                     for k, v in sorted(safe.items()))
     return f"{name}{{{inner}}}"
 
 
@@ -379,15 +406,37 @@ def _fmt(v: float) -> str:
     return str(int(f)) if f == int(f) else repr(f)
 
 
+#: OpenMetrics cap on an exemplar's combined label name+value runes
+_EXEMPLAR_LABEL_BUDGET = 128
+
+
 def _exemplar_suffix(h: dict[str, Any], le_s: str) -> str:
     """OpenMetrics exemplar annotation for one bucket line ('' if none):
-    `` # {trace_id="..."} value timestamp``."""
+    `` # {trace_id="..."} value timestamp``.
+
+    The spec caps an exemplar's combined label name+value length at 128
+    runes; oversized values are truncated (before escaping, so no escape
+    sequence is ever cut in half) rather than rejected — a too-chatty
+    label must not cost the trace linkage."""
     ex = (h.get("exemplars") or {}).get(le_s)
     if not ex:
         return ""
     ex_labels, ex_value, ex_ts = ex
-    inner = ",".join(f'{k}="{_escape(v)}"'
-                     for k, v in sorted((ex_labels or {}).items()))
+    budget = _EXEMPLAR_LABEL_BUDGET
+    items: list[tuple[str, str]] = []
+    # trace_id claims budget first — it IS the linkage — then the rest
+    # in sorted order; emission order stays sorted below
+    ordered = sorted((ex_labels or {}).items(),
+                     key=lambda kv: (kv[0] != "trace_id", kv[0]))
+    for k, v in ordered:
+        k, v = _safe_label(k), str(v)
+        room = budget - len(k)
+        if room <= 0:  # not even the name fits: drop the label
+            continue
+        v = v[:room]
+        budget -= len(k) + len(v)
+        items.append((k, v))
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in sorted(items))
     out = " # {" + inner + "} " + _fmt(ex_value)
     if ex_ts:
         out += f" {round(float(ex_ts), 3)}"

@@ -95,6 +95,10 @@ def _capacity_from_env() -> int:
 _TRACEPARENT_RE = re.compile(
     r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
 
+#: longest header worth inspecting: the 55-char version-00 form plus
+#: generous room for future-version members; anything longer is hostile
+_TRACEPARENT_MAX_LEN = 512
+
 _TRACE_ID_RE = re.compile(r"^[0-9a-f]{32}$")
 _SPAN_ID_RE = re.compile(r"^[0-9a-f]{16}$")
 
@@ -163,11 +167,23 @@ def parse_traceparent(header: str | None) -> TraceContext | None:
 
     Lenient by design (tracing must never fail a request): bad version,
     all-zero ids, wrong field sizes all return None — the request simply
-    starts a fresh trace instead of erroring.
+    starts a fresh trace instead of erroring.  Oversized headers are
+    rejected outright (bounded work on hostile input); future-version
+    headers with extra dash-separated members parse their first four
+    fields per the W3C forward-compatibility rule.
     """
     if not header or not isinstance(header, str):
         return None
-    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if len(header) > _TRACEPARENT_MAX_LEN:  # bound work on hostile input
+        return None
+    value = header.strip().lower()
+    # W3C forward compatibility: versions above 00 may append extra
+    # dash-separated members — parse the first four fields, ignore the
+    # rest.  Version 00 is exactly four fields; trailing data rejects.
+    head, _, rest = value.partition("-")
+    if head != "00" and rest.count("-") > 2:
+        value = "-".join([head] + rest.split("-")[:3])
+    m = _TRACEPARENT_RE.match(value)
     if not m or m.group(1) == "ff":
         return None
     trace_id, span_id = m.group(2), m.group(3)

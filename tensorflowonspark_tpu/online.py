@@ -105,6 +105,7 @@ from typing import Any, Callable, Mapping, Sequence
 
 import numpy as np
 
+from tensorflowonspark_tpu.obs import journal as _journal
 from tensorflowonspark_tpu.obs import trace as _trace
 
 logger = logging.getLogger(__name__)
@@ -935,6 +936,13 @@ class OnlineServer:
                 self._pending_bytes_g.inc(nbytes)
                 self._cond.notify()
         if shed_exc is not None:
+            # cold path: journal the verdict (admission sheds are a
+            # control-plane transition — the incident timeline needs the
+            # moment pressure crossed the byte bound, per tenant)
+            _journal.emit("admission.shed", tenant=tenant,
+                          where="replica",
+                          pending_bytes=pending_bytes,
+                          max_pending_bytes=ts.max_pending_bytes)
             if tracing:
                 # sheds are ALWAYS captured, armed or not (this cold path
                 # can afford to arm retroactively).  "How long it sat

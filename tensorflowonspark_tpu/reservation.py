@@ -226,6 +226,16 @@ class Server:
             self._regroups[gen] = res
             self.generation = gen
             self._gen_lock.notify_all()
+        try:
+            # lazy import: reservation is the bottom layer and must not
+            # import obs at module scope; the journal records the fence
+            # opening — the happens-before edge the total order leans on
+            from tensorflowonspark_tpu.obs import journal as _journal
+
+            _journal.emit("generation.begin", gen=gen, expected=count,
+                          parked=len(parked))
+        except Exception:  # pragma: no cover - observability best effort
+            pass
         for meta in parked:
             logger.info(
                 "absorbing parked registration of executor %s into "

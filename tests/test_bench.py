@@ -38,6 +38,9 @@ def _run_bench(argv, env_extra, timeout):
 
 
 class TestOutageProofing(unittest.TestCase):
+    @pytest.mark.slow  # ~150 s: full bench subprocess against a wedged
+    # probe — the fast degraded-path coverage lives in the null-result
+    # cases below
     def test_wedged_chip_yields_degraded_json_within_budget(self):
         # Simulated outage: every accelerator-path child (probe + primaries)
         # sleeps forever, exactly like the round-4 wedged tunnel; only the
@@ -83,6 +86,8 @@ class TestOutageProofing(unittest.TestCase):
             proc.stderr.count("child sleeping"), 2,
             "primary children ran despite a failed probe")
 
+    @pytest.mark.slow  # ~180 s: two full bench subprocess halves across
+    # a reprobe window
     def test_flapping_chip_wins_second_half_back(self):
         # Round-5 outage mode: the chip wedges and RECOVERS (a healthy
         # window was observed mid-wedge).  First accelerator child (the

@@ -1582,3 +1582,102 @@ def test_fleet_judged_even_on_degraded_newest(tmp_path):
     verdict = bench_gate.gate([_write(tmp_path, "BENCH_r17.json", half)])
     assert verdict["verdict"] == "fail"
     assert any("fraction in [-1, 1]" in r for r in verdict["reasons"])
+
+
+# -- incident plane (ISSUE 16) -----------------------------------------------
+
+
+def _incident_fields(overhead=0.01, **extra):
+    fields = {"incident_overhead_frac": overhead,
+              "incident_router_p99_ms": 22.1,
+              "incident_router_p99_ms_off": 21.9,
+              "incident_timeline_valid": True,
+              "incident_death_latency_s": 1.4,
+              "incident_journal_events": 87,
+              "incident_bundles": 3,
+              "incident_linked_traces": 2,
+              "incident_replicas": 2, "incident_clients": 6,
+              "incident_rows_total": 240, "incident_host_cpus": 1}
+    fields.update(extra)
+    return fields
+
+
+def _r18(**extra):
+    """A round-18-complete primary half: r17 + the incident-plane
+    microbench."""
+    half = _r17(**_incident_fields())
+    half.update(extra)
+    return half
+
+
+def test_incident_field_required_on_primary_from_round_18(tmp_path):
+    # round 17: grandfathered — no incident microbench owed
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r17.json", _r17())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # round 18+: the primary must carry it (or explicit null + reason)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r18.json", _r17())])
+    assert verdict["verdict"] == "fail"
+    assert any("incident_overhead_frac" in r for r in verdict["reasons"])
+    # complete round 18 passes
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r18.json", _r18())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # explicit null + reason satisfies (e.g. wall budget exhausted)
+    half = _r17(incident_overhead_frac=None,
+                incident_reason="wall budget exhausted before the "
+                                "incident-plane microbench")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r18.json", half)])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # bare null does not
+    half = _r17(incident_overhead_frac=None)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r18.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("incident_reason" in r for r in verdict["reasons"])
+
+
+def test_incident_overhead_bound_and_string_rejection(tmp_path):
+    """(p99_on − p99_off)/p99_off outside [-1, 1] is a measurement bug;
+    a string value must not slide past the whole r18 block."""
+    verdict = bench_gate.gate([_write(
+        tmp_path, "BENCH_r18.json",
+        _r18(**_incident_fields(overhead=2.0)))])
+    assert verdict["verdict"] == "fail"
+    assert any("fraction in [-1, 1]" in r for r in verdict["reasons"])
+    # a small negative (noise-centered A/B — the acceptance claim IS
+    # the noise floor) is legitimate
+    verdict = bench_gate.gate([_write(
+        tmp_path, "BENCH_r18.json",
+        _r18(**_incident_fields(overhead=-0.005)))])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    half = _r18(incident_overhead_frac="0.01")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r18.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("must be numeric or an explicit null" in r
+               for r in verdict["reasons"])
+
+
+def test_incident_value_without_config_identity_fails(tmp_path):
+    half = _r18()
+    del half["incident_replicas"]
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r18.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("config identity" in r and "incident_replicas" in r
+               for r in verdict["reasons"])
+
+
+def test_incident_chaos_proof_gated(tmp_path):
+    """The chaos pass is the plane's whole point: an unvalidated
+    timeline, a missing death latency, or zero exemplar-linked traces
+    each fail the artifact."""
+    half = _r18(incident_timeline_valid=False)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r18.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("incident_timeline_valid" in r for r in verdict["reasons"])
+    half = _r18()
+    del half["incident_death_latency_s"]
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r18.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("incident_death_latency_s" in r for r in verdict["reasons"])
+    half = _r18(incident_linked_traces=0)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r18.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("incident_linked_traces" in r for r in verdict["reasons"])

@@ -194,3 +194,60 @@ def test_requests_cli_mode(tmp_path):
         [_sys.executable, tool, "--requests", str(bad)],
         capture_output=True, text=True)
     assert proc.returncode == 1
+
+
+# -- journal mode (ISSUE 16) -------------------------------------------------
+
+
+def _journal_ev(**over):
+    ev = {"type": "replica.join", "ts": 1.0, "gen": 0, "seq": 0,
+          "node": "driver", "pid": 1, "attrs": {}}
+    ev.update(over)
+    return ev
+
+
+def test_journal_doc_validates_schema_and_total_order():
+    good = [
+        _journal_ev(),
+        _journal_ev(type="slo.fire", ts=2.0, seq=1,
+                    attrs={"exemplars": [{"trace_id": "ab" * 16,
+                                          "value_ms": 3.2}]}),
+        # gen fence: an EARLIER wall clock at a later generation is in
+        # order — that is the whole point of the hybrid key
+        _journal_ev(type="mesh.regroup", ts=1.5, gen=1, seq=2),
+    ]
+    assert check_trace.validate_journal_doc(good) == []
+    # a /fleet/events page wraps the same list
+    assert check_trace.validate_journal_doc(
+        {"events": good, "cursor": "x", "more": False}) == []
+    # violations: unknown type, colon node, bad exemplar id, disorder
+    probs = check_trace.validate_journal_doc([_journal_ev(type="nope")])
+    assert any("unknown event type" in p for p in probs)
+    probs = check_trace.validate_journal_doc([_journal_ev(node="a:b")])
+    assert any("colon-free" in p for p in probs)
+    probs = check_trace.validate_journal_doc(
+        [_journal_ev(type="slo.fire",
+                     attrs={"exemplars": [{"trace_id": "zz"}]})])
+    assert any("trace_id" in p for p in probs)
+    probs = check_trace.validate_journal_doc([good[2], good[0]])
+    assert any("out of (gen, ts" in p for p in probs)
+
+
+def test_journal_cli_mode_reads_spool_jsonl(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tool = os.path.join(repo, "tools", "check_trace.py")
+    spool = tmp_path / "journal-driver-1.jsonl"
+    with open(spool, "w") as f:
+        f.write(json.dumps(_journal_ev()) + "\n")
+        f.write('{"type": "torn')  # crash-torn tail: skipped, not fatal
+    proc = subprocess.run(
+        [sys.executable, tool, "--journal", str(spool)],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    bad = tmp_path / "page.json"
+    bad.write_text(json.dumps({"events": [_journal_ev(type="nope")]}))
+    proc = subprocess.run(
+        [sys.executable, tool, "--journal", str(bad)],
+        capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "unknown event type" in proc.stderr

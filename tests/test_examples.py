@@ -107,6 +107,8 @@ def test_resnet_spark_example_tfrecord_pipeline(tmp_path, capsys):
     assert "cluster total:" in out and "images/sec" in out
 
 
+@pytest.mark.slow  # ~160 s: Inception-v3 compile dominates; the resnet
+# variants above keep the example path in tier-1
 def test_inception_spark_example_synthetic(capsys):
     """Acceptance config #3 names both architectures; --arch inception_v3
     runs the same DP example on the Inception-v3 zoo entry."""

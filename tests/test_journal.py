@@ -1,0 +1,564 @@
+"""Fleet incident plane: journal ordering, durability, black-box dumps,
+``/fleet/events`` pagination, and the incident-timeline merge (ISSUE 16).
+
+The fast cases are pure in-process unit tests plus two tiny subprocesses
+(SIGKILL / SIGTERM durability — the chaos discipline of ``tests/chaos.py``
+applied to the journal's own spool).  The slow case is the end-to-end
+chaos proof: a real multi-process mesh, one replica SIGKILLed under load,
+and ``tools/incident.py`` reconstructing one causally-ordered timeline
+spanning router and corpse.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import mesh, online
+from tensorflowonspark_tpu.obs import journal, trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import check_trace  # noqa: E402
+import incident  # noqa: E402
+
+
+# -- ordering ----------------------------------------------------------------
+
+
+def test_append_shapes_validates_and_sequences(tmp_path):
+    j = journal.Journal(node="n1", spool_dir=str(tmp_path))
+    with pytest.raises(ValueError):
+        j.append("not.a.event.type")
+    a = j.append("replica.join", replica="r0")
+    b = j.append("admission.shed", tenant="t", why="pressure")
+    assert a["type"] == "replica.join" and a["node"] == "n1"
+    assert a["attrs"] == {"replica": "r0"}
+    assert b["seq"] > a["seq"]  # per-process program order
+    assert b["ts"] >= a["ts"]  # monotonic clamp
+    assert set(a) == {"type", "ts", "gen", "seq", "node", "pid", "attrs"}
+
+
+def test_generation_fence_beats_clock_skew():
+    """The acceptance ordering claim: a corpse whose clock runs 30 s
+    behind still sorts AFTER the regroup that fenced it, because the
+    generation field is the leading key — wall clock only orders within
+    a generation."""
+    now = time.time()
+    router = [
+        {"type": "placement.publish", "ts": now, "gen": 0, "seq": 1,
+         "node": "driver", "pid": 1, "attrs": {}},
+        {"type": "mesh.regroup", "ts": now + 1.0, "gen": 1, "seq": 2,
+         "node": "driver", "pid": 1, "attrs": {"lost": ["r0"]}},
+    ]
+    corpse = [
+        # stamped at gen 1 by a clock 30 s in the past
+        {"type": "replica.fenced", "ts": now - 30.0, "gen": 1, "seq": 1,
+         "node": "mesh-replica-r0", "pid": 2, "attrs": {}},
+        {"type": "replica.join", "ts": now - 31.0, "gen": 0, "seq": 0,
+         "node": "mesh-replica-r0", "pid": 2, "attrs": {}},
+    ]
+    merged = journal.merge_events(router, corpse)
+    types = [e["type"] for e in merged]
+    # every gen-1 event sorts after every gen-0 event, even though the
+    # corpse's gen-1 fence is wall-clock-stamped 31 s BEFORE the
+    # router's gen-0 publish; within gen 1 wall clock orders as usual
+    assert types == ["replica.join", "placement.publish",
+                     "replica.fenced", "mesh.regroup"], types
+    keys = [journal.order_key(e) for e in merged]
+    assert keys == sorted(keys)
+
+
+def test_merge_events_dedups_on_process_identity():
+    ev = {"type": "slo.fire", "ts": 1.0, "gen": 0, "seq": 7,
+          "node": "driver", "pid": 9, "attrs": {}}
+    merged = journal.merge_events([ev], [dict(ev)], [dict(ev)])
+    assert len(merged) == 1
+
+
+def test_cursor_roundtrip_and_forgiving_decode():
+    ev = {"type": "slo.fire", "ts": 123.456789, "gen": 3, "seq": 42,
+          "node": "driver", "pid": 10, "attrs": {}}
+    cur = journal.encode_cursor(ev)
+    assert journal.decode_cursor(cur) == journal.order_key(ev)
+    for bad in ("", "junk", "1:2", "x:y:z:w:v", None):
+        assert journal.decode_cursor(bad) is None
+    with pytest.raises(ValueError):  # ":" would corrupt every cursor
+        journal.Journal(node="ok").configure(node="a:b")
+
+
+def test_ring_bound_counts_drops(tmp_path, monkeypatch):
+    monkeypatch.setenv("TFOS_JOURNAL", "1")
+    j = journal.Journal(node="tiny", capacity=16)
+    for i in range(40):
+        j.append("decode.admit", slot=i)
+    st = j.stats()
+    assert st["ring"] == 16
+    assert len(j.tail(100)) == 16
+    # ring keeps the NEWEST events
+    assert j.tail(1)[0]["attrs"]["slot"] == 39
+
+
+def test_disabled_journal_appends_nothing(monkeypatch):
+    monkeypatch.setenv("TFOS_JOURNAL", "0")
+    j = journal.Journal(node="off")
+    assert j.append("replica.join") is None
+    assert j.tail(10) == []
+    monkeypatch.setenv("TFOS_JOURNAL", "1")
+    assert j.append("replica.join") is not None
+
+
+# -- durability --------------------------------------------------------------
+
+
+def test_spool_flush_roundtrip_and_torn_tail(tmp_path):
+    j = journal.Journal(node="w", spool_dir=str(tmp_path),
+                        flush_interval_s=0.0)
+    for i in range(5):
+        j.append("decode.retire", slot=i, status="done")
+    j.flush()
+    path = j.spool_path()
+    assert os.path.exists(path)
+    # a SIGKILL mid-append leaves a torn trailing line — readers must
+    # return every complete event and skip the tear, not error
+    with open(path, "ab") as f:
+        f.write(b'{"type": "decode.retire", "ts": 1.0, "se')
+    events = journal.read_spool_file(path)
+    assert [e["attrs"]["slot"] for e in events] == [0, 1, 2, 3, 4]
+    # corrupt middle lines are skipped too
+    with open(path, "ab") as f:
+        f.write(b"\nnot json at all\n")
+    assert len(journal.read_spool_file(path)) == 5
+    assert journal.read_spool(str(tmp_path)) == events
+
+
+_KILL_CHILD = textwrap.dedent("""
+    import os, signal, sys
+    os.environ["TFOS_JOURNAL"] = "1"
+    from tensorflowonspark_tpu.obs import journal
+    j = journal.Journal(node="victim", spool_dir=sys.argv[1],
+                        flush_interval_s=0.0)
+    for i in range(20):
+        j.append("decode.admit", slot=i)
+    j.flush()
+    for i in range(5):  # unflushed tail: at most one cadence may vanish
+        j.append("decode.retire", slot=i, status="done")
+    {finale}
+    print("READY", flush=True)
+    import time
+    time.sleep(60)
+""")
+
+
+def _run_child(tmp_path, finale, sig=None):
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _KILL_CHILD.format(finale=finale),
+         str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.stdout.readline().strip() == "READY"
+    if sig is not None:
+        os.kill(proc.pid, sig)
+    proc.wait(timeout=60)
+    return proc
+
+
+def test_sigkill_loses_at_most_the_unflushed_cadence(tmp_path):
+    """Black-box recovery after SIGKILL: everything cadence-flushed
+    before the kill is readable; the torn tail never corrupts it."""
+    _run_child(tmp_path, "pass", sig=signal.SIGKILL)
+    events = journal.read_spool(str(tmp_path), node="victim")
+    admits = [e for e in events if e["type"] == "decode.admit"]
+    assert len(admits) == 20  # the flushed prefix fully survives
+    keys = [journal.order_key(e) for e in events]
+    assert keys == sorted(keys)
+
+
+def test_sigterm_black_box_dump_chains_and_bundles(tmp_path):
+    """``install_signal_dump`` turns SIGTERM into a digest-verified
+    black-box bundle carrying the journal tail (flushed or not)."""
+    proc = _run_child(tmp_path, "journal.install_signal_dump(j)",
+                      sig=signal.SIGTERM)
+    assert proc.returncode != 0
+    paths = journal.blackbox_files(str(tmp_path), node="victim")
+    assert len(paths) == 1
+    doc = journal.read_blackbox(paths[0])
+    assert doc is not None and doc["schema"] == journal.BLACKBOX_SCHEMA
+    assert "SIGTERM" in doc["reason"] or "15" in doc["reason"]
+    types = {e["type"] for e in doc["events"]}
+    assert "decode.retire" in types  # the unflushed tail made the bundle
+
+
+def test_blackbox_tamper_rejected(tmp_path, monkeypatch):
+    monkeypatch.setenv("TFOS_JOURNAL", "1")
+    j = journal.Journal(node="bb", spool_dir=str(tmp_path),
+                        flush_interval_s=0.0)
+    j.append("slo.fire", objective="o")
+    path = journal.blackbox_dump("testing", journal=j,
+                                 spool_dir=str(tmp_path))
+    assert journal.read_blackbox(path) is not None
+    with open(path, "r+b") as f:  # flip one payload byte
+        f.seek(10)
+        c = f.read(1)
+        f.seek(10)
+        f.write(b"X" if c != b"X" else b"Y")
+    assert journal.read_blackbox(path) is None  # digest mismatch
+
+
+def test_corpse_bundle_reports_last_flush(tmp_path, monkeypatch):
+    monkeypatch.setenv("TFOS_JOURNAL", "1")
+    j = journal.Journal(node="mesh-replica-r7", spool_dir=str(tmp_path),
+                        flush_interval_s=0.0)
+    j.append("replica.join", replica="r7")
+    j.append("replica.fenced", replica="r7")
+    j.flush()
+    journal.blackbox_dump("fenced", journal=j, spool_dir=str(tmp_path))
+    corpse = journal.corpse_bundle(str(tmp_path), "mesh-replica-r7")
+    assert corpse is not None
+    assert corpse["events_flushed"] >= 2
+    assert corpse["last_cursor"]
+    assert corpse["blackbox"] and corpse["blackbox_reason"] == "fenced"
+    assert journal.corpse_bundle(str(tmp_path), "never-lived") is None
+
+
+# -- /fleet/events -----------------------------------------------------------
+
+
+class _Replica:
+    def __init__(self, rid, addr, token):
+        self.srv = online.OnlineServer()
+        self.http = online.OnlineHTTPServer(self.srv)
+        self.http.start()
+        self.srv.start()
+        self.agent = mesh.ReplicaAgent(rid, addr, token, self.srv,
+                                       self.http, poll_interval=0.1)
+        self.agent.start()
+
+    def kill(self):
+        self.agent._stop.set()
+        self.http.stop()
+        self.srv.stop()
+
+    def stop(self):
+        self.agent.stop()
+        self.http.stop()
+        self.srv.stop()
+
+
+def test_fleet_events_pagination_spans_a_death(tmp_path, monkeypatch):
+    """The federated feed: join events at gen 0, then a kill → death +
+    regroup at gen 1, paged with since-cursors in one total order."""
+    monkeypatch.setenv("TFOS_JOURNAL", "1")
+    # hermetic global journal: the process-wide ring (and its
+    # never-backwards generation fence) outlives earlier tests' routers
+    # — without a fresh instance the first death event in total order
+    # may belong to a previous test's regroup
+    monkeypatch.setattr(journal, "_JOURNAL", journal.Journal())
+    router = mesh.MeshRouter(expected_replicas=2, poll_interval=0.2,
+                             fail_after=2, regroup_timeout=20.0,
+                             replica_capacity_mb=64.0)
+    addr = router.start()
+    reps = [_Replica(f"j{i}", addr, router.auth_token) for i in range(2)]
+    try:
+        router.await_replicas(timeout=30.0)
+        reps[0].kill()
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if router.stats()["generation"] == 1:
+                break
+            time.sleep(0.1)
+        assert router.stats()["generation"] == 1
+
+        # page through with a 2-event window; the pages concatenate to
+        # the full feed in strictly ascending causal order
+        pages, cursor, guard = [], None, 0
+        while True:
+            doc = router.fleet_events(since=cursor, limit=2)
+            assert doc["count"] == len(doc["events"]) <= 2
+            pages.extend(doc["events"])
+            cursor = doc["cursor"]
+            guard += 1
+            assert guard < 100
+            if not doc["more"]:
+                break
+        full = router.fleet_events(limit=1000)["events"]
+        assert [journal.order_key(e) for e in pages] == \
+            [journal.order_key(e) for e in full]
+        keys = [journal.order_key(e) for e in full]
+        assert keys == sorted(keys)
+        types = [e["type"] for e in full]
+        assert types.count("replica.join") >= 2
+        assert "replica.death" in types and "mesh.regroup" in types
+        death = next(e for e in full if e["type"] == "replica.death")
+        regroup = next(e for e in full if e["type"] == "mesh.regroup")
+        assert death["gen"] == 1 and regroup["gen"] == 1
+        assert death["attrs"]["replica"] == "j0"
+        # a bad cursor reads from the start, never errors
+        assert router.fleet_events(since="garbage")["count"] == \
+            len(full)
+    finally:
+        router.stop()
+        for rep in reps:
+            rep.stop()
+
+
+# -- incident merge ----------------------------------------------------------
+
+
+def _seed_incident_spool(tmp_path):
+    """A two-process incident: router journal + skewed corpse journal +
+    a black-box bundle whose retained trace matches the slo.fire
+    exemplar."""
+    spool = str(tmp_path)
+    tid = "ab" * 16
+    jr = journal.Journal(node="driver", spool_dir=spool,
+                         flush_interval_s=0.0)
+    jr.append("placement.publish", version=1, gen=0, tenants=1,
+              replicas=2)
+    jr.append("slo.fire", objective="t-latency", tenant="t",
+              exemplars=[{"trace_id": tid, "replica": "mesh-replica-x",
+                          "value_ms": 120.0}])
+    jr.set_generation(1)
+    jr.append("mesh.regroup", gen=1, lost=["x"], joined=[],
+              survivors=["y"])
+    jr.append("replica.death", gen=1, replica="x", reason="missed poll",
+              corpse={"spool": spool})
+    jr.flush()
+
+    jc = journal.Journal(node="mesh-replica-x", spool_dir=spool,
+                         flush_interval_s=0.0)
+    jc.set_generation(1)
+    jc.append("replica.fenced", ts=time.time() - 30.0, replica="x")
+    rt = trace.RequestTrace("predict", node="mesh-replica-x")
+    rt.ctx.trace_id = tid
+    rt.finish("slo_breach")
+    trace.get_trace_store().commit(rt, retain="slo_breach")
+    journal.blackbox_dump("fenced", journal=jc, spool_dir=spool)
+    jc.flush()
+    return spool, tid
+
+
+def test_incident_reconstruct_is_ordered_linked_and_valid(tmp_path,
+                                                          monkeypatch):
+    monkeypatch.setenv("TFOS_JOURNAL", "1")
+    spool, tid = _seed_incident_spool(tmp_path)
+    out = incident.reconstruct(spool)
+    assert check_trace.validate_doc(out["timeline"]) == []
+    s = out["summary"]
+    assert s["ordered"] is True
+    assert s["nodes"] == ["driver", "mesh-replica-x"]
+    assert s["deaths"] and s["deaths"][0]["gen"] == 1
+    assert s["regroups"] and s["regroups"][0]["gen"] == 1
+    assert tid in s["exemplars"] and s["linked"] == [tid]
+    # both processes render as named tracks in the merged timeline
+    names = {e["args"]["name"] for e in out["timeline"]["traceEvents"]
+             if e.get("ph") == "M"}
+    assert {"driver", "mesh-replica-x"} <= names
+
+
+def test_incident_cli_window_and_determinism(tmp_path, monkeypatch):
+    monkeypatch.setenv("TFOS_JOURNAL", "1")
+    spool, _tid = _seed_incident_spool(tmp_path)
+    out1 = str(tmp_path / "a.json")
+    out2 = str(tmp_path / "b.json")
+    assert incident.main([spool, "-o", out1, "--validate"]) == 0
+    assert incident.main([spool, "-o", out2]) == 0
+    with open(out1, "rb") as f1, open(out2, "rb") as f2:
+        assert f1.read() == f2.read()  # byte-identical merges
+    # the 10 s burn window: anchored on the slo.fire, the 30 s-skewed
+    # fenced instant falls outside and is excluded
+    win = str(tmp_path / "win.json")
+    assert incident.main([spool, "--around", "last:slo.fire",
+                          "--window", "10", "-o", win,
+                          "--validate"]) == 0
+    with open(win) as f:
+        doc = json.load(f)
+    names = [e["name"] for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert "slo.fire" in names and "replica.fenced" not in names
+    # anchoring on an event type that never fired is a usage error
+    assert incident.main([spool, "--around", "last:decode.cancel"]) == 2
+
+
+# -- the chaos proof ---------------------------------------------------------
+
+
+def _chaos_fwd(state, batch):
+    return {"score": batch["x"] @ state["params"]["w"]}
+
+
+def _make_export(tmp_path, name="exp", scale=1.0, dim=4):
+    """Self-describing export — the only model form that can cross the
+    router→replica process boundary (mirrors tests/test_mesh.py)."""
+    from tensorflowonspark_tpu import compat
+
+    w = (np.arange(dim * 3, dtype=np.float32).reshape(dim, 3) / 10.0
+         * scale)
+    export_dir = str(tmp_path / name)
+    compat.export_saved_model(
+        {"params": {"w": w}}, export_dir, forward_fn=_chaos_fwd,
+        example_batch={"x": np.zeros((2, dim), np.float32)})
+    return export_dir, w
+
+
+@pytest.mark.slow  # spawns 2 replica subprocesses + SIGKILL chaos
+def test_chaos_sigkill_replica_reconstructs_incident_timeline(tmp_path):
+    """The ISSUE 16 acceptance proof: SIGKILL a real replica process
+    under load, then reconstruct ONE causally-ordered timeline spanning
+    router and corpse — death event with the corpse's stamped bundle,
+    generation-fenced regroup, and an exemplar-linked trace."""
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool)
+    os.environ["TFOS_JOURNAL"] = "1"
+    journal.configure(spool_dir=spool, flush_interval_s=0.1)
+
+    poll = 0.3
+    router = mesh.MeshRouter(expected_replicas=2, poll_interval=poll,
+                             fail_after=3, regroup_timeout=60.0,
+                             replica_capacity_mb=64.0,
+                             fleet_window_s=5.0)
+    host, port = router.start()
+    env = dict(os.environ)
+    env[mesh.MESH_AUTH_ENV] = router.auth_token
+    env["TFOS_JOURNAL"] = "1"
+    env["TFOS_JOURNAL_DIR"] = spool
+    env["JAX_PLATFORMS"] = "cpu"
+    procs, logs = [], []
+    try:
+        for i in range(2):
+            log = open(str(tmp_path / f"replica{i}.log"), "wb")
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "tensorflowonspark_tpu.mesh",
+                 "--registry", f"{host}:{port}", "--replica-id",
+                 f"c{i}", "--poll-interval", "0.1"],
+                stdout=log, stderr=log, env=env, cwd=REPO))
+        router.await_replicas(timeout=120.0)
+        d, _w = _make_export(tmp_path)
+        # microscopic slo_ms: every request breaches → traces retained,
+        # exemplars on the latency histogram, burn objective red-hot
+        rid = router.add_tenant(
+            "t", wait_applied_s=60.0, export_dir=d,
+            input_mapping={"x": "x"}, slo_ms=0.0001, flush_ms=2.0,
+            max_pending_mb=8.0)
+        x = np.ones((1, 4), np.float32)
+        body = json.dumps({"tenant": "t",
+                           "inputs": {"x": x.tolist()}}).encode()
+        t0 = time.monotonic()
+        burned = False
+        while time.monotonic() - t0 < 30.0:
+            # an inbound context arms capture unconditionally — every
+            # breached request then retains its trace, the exemplar's
+            # other half
+            ctx = trace.TraceContext.new()
+            status, _ct, _rb, _extra = router.route_predict(
+                body, {"traceparent": ctx.traceparent()})
+            assert status in (200, 429, 503), status
+            if any(f["finding"] == "slo.burn"
+                   for f in router.check_fleet()["slo_burn"]):
+                burned = True
+                break
+            time.sleep(0.02)
+        assert burned, "slo.burn never fired under load"
+        # let the fleet tick journal the finding (slo.fire event)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 15.0:
+            if any(e["type"] == "slo.fire"
+                   for e in journal.get_journal().tail(200)):
+                break
+            time.sleep(0.1)
+
+        victim = rid  # kill the replica hosting the tenant
+        # the slo.burn fire also broadcast mesh:blackbox — wait for the
+        # victim's anomaly bundle (its retained breach traces, the
+        # exemplars' other half) to land in the spool before killing it
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 20.0:
+            if journal.blackbox_files(spool,
+                                      node=f"mesh-replica-{victim}"):
+                break
+            time.sleep(0.1)
+        assert journal.blackbox_files(
+            spool, node=f"mesh-replica-{victim}"), \
+            "victim never dumped its anomaly black-box bundle"
+        vic_proc = procs[0] if rid == "c0" else procs[1]
+        os.kill(vic_proc.pid, signal.SIGKILL)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            st = router.stats()
+            if st["generation"] >= 1 and st["state"] == "watching":
+                break
+            time.sleep(0.2)
+        assert router.stats()["generation"] >= 1
+        journal.get_journal().flush()
+        journal.blackbox_dump("chaos proof wrap-up",
+                              spool_dir=spool)
+
+        out = incident.reconstruct(spool)
+        assert check_trace.validate_doc(out["timeline"]) == []
+        s = out["summary"]
+        assert s["ordered"] is True
+        # spans router AND corpse
+        assert "driver" in s["nodes"]
+        assert f"mesh-replica-{victim}" in s["nodes"]
+        # death event at the fenced generation, corpse stamped
+        death = next(d for d in s["deaths"] if d["replica"] == victim)
+        assert death["gen"] >= 1
+        assert death["corpse"] is not None
+        assert death["corpse"]["events_flushed"] > 0
+        regroup = next(r for r in s["regroups"]
+                       if victim in (r["lost"] or []))
+        assert regroup["gen"] == death["gen"]
+        # ≥1 exemplar-linked trace survives into the timeline
+        assert s["exemplars"], "no exemplar-linked trace ids journaled"
+        assert s["linked"], (
+            "no journaled exemplar resolved to a recovered trace")
+
+        # SIGTERM the survivor: the signal chain must dump a black-box
+        # bundle BEFORE the stop handler kills the process (regression:
+        # replica_main once registered its stop handler AFTER
+        # install_signal_dump, overwriting the chain — a SIGTERMed
+        # replica died bundle-less)
+        survivor = "c1" if victim == "c0" else "c0"
+        sur_proc = procs[1] if victim == "c0" else procs[0]
+        pre = len(journal.blackbox_files(
+            spool, node=f"mesh-replica-{survivor}"))
+        sur_proc.send_signal(signal.SIGTERM)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 20.0:
+            files = journal.blackbox_files(
+                spool, node=f"mesh-replica-{survivor}")
+            if len(files) > pre:
+                break
+            time.sleep(0.1)
+        files = journal.blackbox_files(
+            spool, node=f"mesh-replica-{survivor}")
+        assert len(files) > pre, \
+            "SIGTERMed survivor never dumped its black-box bundle"
+        sig_bundle = journal.read_blackbox(files[-1])
+        assert sig_bundle is not None
+        assert sig_bundle["reason"].startswith("signal ")
+    finally:
+        try:
+            router.stop(stop_replicas=True)
+        except Exception:
+            pass
+        for proc in procs:
+            try:
+                proc.kill()
+            except Exception:
+                pass
+        try:
+            router.server.stop()
+        except Exception:
+            pass
+        for log in logs:
+            log.close()

@@ -23,7 +23,11 @@ def test_registry_lists_all():
     )
 
 
-@pytest.mark.parametrize("name", ALL_MODELS)
+@pytest.mark.parametrize(
+    "name",
+    [pytest.param(n, marks=pytest.mark.slow) if n == "inception_v3"
+     else n for n in ALL_MODELS])  # inception: ~85 s of compile; its
+# canonical-config coverage is slow-marked below for the same reason
 def test_model_trains_and_predicts(name):
     t = Trainer(name, mesh_config=MeshConfig(dp=8), learning_rate=1e-2)
     batch = t.module_lib.example_batch(t.config, batch_size=16)
@@ -312,6 +316,7 @@ def test_inception_canonical_stem_shapes():
     assert out_infer.shape == (2, 1000)
 
 
+@pytest.mark.slow  # ~88 s: aux-head compile; stem-shape coverage stays fast
 def test_inception_canonical_trains():
     """The canonical tiny config trains with the aux-weighted loss and
     serves a single-logits forward through the Trainer path."""

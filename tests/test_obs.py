@@ -622,3 +622,97 @@ def test_validator_does_not_missplit_hash_inside_label_value():
             'm{path="/a # b"} 1\n'
             'm{path="/a # {x"} 2\n')
     assert httpd.validate_prometheus_text(text) == []
+
+
+# ---------------------------------------------------------------------------
+# hostile-input hardening (ISSUE 16): traceparent, label names, exemplars
+# ---------------------------------------------------------------------------
+
+
+def test_traceparent_hostile_and_future_version_inputs():
+    """Malformed or hostile headers reject cheaply; future W3C versions
+    parse their first four fields (the spec's forward-compat rule)."""
+    good_tail = "ab" * 16 + "-" + "cd" * 8 + "-01"
+    # future version: extra dash-separated members are ignored
+    fut = trace_lib.parse_traceparent("01-" + good_tail + "-extra-stuff")
+    assert fut is not None and fut.trace_id == "ab" * 16
+    # version 00 is exactly four fields: trailing members reject
+    assert trace_lib.parse_traceparent("00-" + good_tail + "-extra") is None
+    # version ff is forbidden by the spec even with extra members
+    assert trace_lib.parse_traceparent("ff-" + good_tail + "-x") is None
+    # oversized header: bounded rejection, no regex work on megabytes
+    assert trace_lib.parse_traceparent(
+        "01-" + good_tail + "-" + "a" * 600) is None
+    assert trace_lib.parse_traceparent("00-" + "a" * 4096) is None
+
+
+def test_label_names_sanitized_in_series_key():
+    """A label NAME with exposition-breaking runes must never reach the
+    text format: invalid runes map to '_', a leading digit is prefixed,
+    and colliding raw names resolve deterministically (last raw key
+    wins) instead of emitting a duplicate label."""
+    from tensorflowonspark_tpu.obs import httpd
+
+    key = reg.series_key("m_total", {"bad name": "v1", "0lead": "v2"})
+    fam, labels = reg.split_series(key)
+    assert fam == "m_total"
+    assert labels == {"bad_name": "v1", "_0lead": "v2"}
+    # collision: both sanitize to 'a_b'; one survives, deterministically
+    key = reg.series_key("m_total", {"a b": "first", "a:b": "second"})
+    _, labels = reg.split_series(key)
+    assert labels == {"a_b": "second"}
+    # the sanitized series must render into a VALID exposition
+    r = reg.Registry()
+    r.counter("m_total", labels={"bad name": "v"}).inc()
+    text = reg.snapshot_to_prometheus(r.snapshot())
+    assert 'bad_name="v"' in text
+    assert httpd.validate_prometheus_text(text) == []
+
+
+def test_exemplar_label_budget_keeps_trace_id():
+    """OpenMetrics caps exemplar label runes at 128: oversized exemplar
+    labels are truncated/dropped but the trace_id — the whole point of
+    the exemplar — always survives intact."""
+    import re
+
+    from tensorflowonspark_tpu.obs import httpd
+
+    r = reg.Registry()
+    h = r.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+    h.observe(0.05, exemplar={"trace_id": "ab" * 16,
+                              "note": "x" * 500, "z" * 60: "y" * 60})
+    om = r.to_openmetrics()
+    assert 'trace_id="' + "ab" * 16 + '"' in om
+    assert httpd.validate_openmetrics_text(om) == []
+    # the emitted exemplar obeys the 128-rune budget
+    for line in om.splitlines():
+        if " # {" in line:
+            labels = re.findall(r'([a-zA-Z0-9_]+)="([^"]*)"',
+                                line.split(" # {", 1)[1])
+            assert sum(len(k) + len(v) for k, v in labels) <= 128
+            assert dict(labels)["trace_id"] == "ab" * 16
+            break
+    else:
+        raise AssertionError("no exemplar line emitted")
+
+
+def test_exposition_validator_catches_malformed_labels_and_fat_exemplars():
+    """The quote-aware validator: a label block that is not name="value"
+    pairs is flagged, and an exemplar past the 128-rune budget is
+    flagged with the bound in the message."""
+    from tensorflowonspark_tpu.obs import httpd
+
+    bad_block = ('# TYPE m counter\n'
+                 'm{tenant=unquoted} 1\n')
+    assert any("label block" in p
+               for p in httpd.validate_prometheus_text(bad_block))
+    fat = ('# TYPE m histogram\n'
+           'm_bucket{le="+Inf"} 1 # {trace_id="' + "ab" * 16 + '",'
+           'note="' + "x" * 200 + '"} 0.05\n'
+           '# EOF\n')
+    assert any("128" in p
+               for p in httpd.validate_openmetrics_text(fat))
+    # a value containing '}' or spaces inside quotes must NOT trip it
+    ok = ('# TYPE m counter\n'
+          'm{q="a } b, c=d"} 1\n')
+    assert httpd.validate_prometheus_text(ok) == []

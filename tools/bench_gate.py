@@ -118,7 +118,18 @@ scrape thread competes with routing for cores), a numeric
 (two cadences bracket the induced hot-replica window, one further
 cadence fires the ``fleet.load_skew`` finding; the 1s is subprocess
 slack), and ``fleet_metrics_valid`` true (the federated
-``/fleet/metrics`` exposition schema-validated in-run).
+``/fleet/metrics`` exposition schema-validated in-run).  From round
+``--require-incident-from`` (default 18, the round that introduced the
+incident plane) the primary half must carry ``incident_overhead_frac``
+— the A/B-measured router-p99 cost of the event journal (on vs off) —
+as a fraction in [-1, 1], or an explicit ``null`` +
+``incident_reason``; a numeric value must ship its config identity
+(replica/client counts, request volume, host CPU count),
+``incident_timeline_valid`` true (the in-run SIGKILL chaos pass: one
+causally-ordered timeline spanning router and corpse, with the death
+event, the generation-fenced regroup, and ≥ 1 exemplar-linked
+recovered trace — reconstructed by ``tools/incident.py``), a numeric
+``incident_death_latency_s``, and ``incident_linked_traces`` ≥ 1.
 
 Usage::
 
@@ -184,6 +195,10 @@ DEFAULT_REQUIRE_DECODE_FROM = 16
 #: microbench (``fleet_overhead_frac``, introduced with the federated
 #: metrics / SLO burn-rate / load-skew plane on the mesh router)
 DEFAULT_REQUIRE_FLEET_FROM = 17
+#: first round whose primary half must carry the incident-plane
+#: microbench (``incident_overhead_frac``, introduced with the
+#: causally-ordered event journal + black-box dumps + tail forensics)
+DEFAULT_REQUIRE_INCIDENT_FROM = 18
 #: |stage_sum / wall - 1| beyond this fails the artifact: a breakdown that
 #: does not add up is decoration, not attribution
 DEFAULT_FLIGHT_TOLERANCE = 0.15
@@ -263,6 +278,12 @@ _FLEET_KEY = "fleet_overhead_frac"
 _FLEET_IDENT_KEYS = ("fleet_replicas", "fleet_clients",
                      "fleet_rows_total", "fleet_scrape_interval_s",
                      "fleet_host_cpus")
+_INCIDENT_KEY = "incident_overhead_frac"
+#: the incident microbench's config identity: the journal's router-p99
+#: cost is only comparable at the same replica/client counts, request
+#: volume and host CPU count
+_INCIDENT_IDENT_KEYS = ("incident_replicas", "incident_clients",
+                        "incident_rows_total", "incident_host_cpus")
 #: decode latency p99s regression-gated LOWER-is-better beside the
 #: throughput (a scheduler change that buys tokens/sec by doubling the
 #: tail is a regression, not a win)
@@ -384,7 +405,8 @@ def validate_half(half: dict[str, Any], *,
                   require_step: bool = False,
                   require_coldstart: bool = False,
                   require_decode: bool = False,
-                  require_fleet: bool = False) -> list[str]:
+                  require_fleet: bool = False,
+                  require_incident: bool = False) -> list[str]:
     """Schema problems of one measured result (a wrapper's half)."""
     problems = []
     for key in _REQUIRED_HALF_KEYS:
@@ -747,6 +769,64 @@ def validate_half(half: dict[str, Any], *,
             problems.append(
                 f"{_FLEET_KEY!r} must be numeric or an explicit null "
                 f"(got {half[_FLEET_KEY]!r})")
+    # incident-plane microbench: host-side multi-process like the fleet
+    # one, so a degraded-accelerator round still owes it; null +
+    # 'incident_reason' always satisfies.  A numeric overhead must be a
+    # sane fraction, carry its config identity, and prove the in-run
+    # chaos pass: SIGKILL under load reconstructed into ONE
+    # causally-ordered timeline with the death event, the fenced
+    # regroup, and an exemplar-linked recovered trace — a journal whose
+    # cost is unbounded or whose forensics cannot reconstruct the
+    # incident it exists for is not an incident plane
+    if require_incident or _INCIDENT_KEY in half:
+        if _INCIDENT_KEY not in half:
+            problems.append(
+                f"missing {_INCIDENT_KEY!r} (incident-plane microbench "
+                "is part of the schema from r18: measure it or stamp an "
+                "explicit null + 'incident_reason')")
+        elif half[_INCIDENT_KEY] is None \
+                and "incident_reason" not in half:
+            problems.append(
+                f"{_INCIDENT_KEY!r} is null without an "
+                "'incident_reason'")
+        elif isinstance(half.get(_INCIDENT_KEY), (int, float)):
+            if not -1.0 <= half[_INCIDENT_KEY] <= 1.0:
+                problems.append(
+                    f"{_INCIDENT_KEY!r} {half[_INCIDENT_KEY]} is not a "
+                    "fraction in [-1, 1] — it is (p99_on − p99_off) / "
+                    "p99_off")
+            missing = [k for k in _INCIDENT_IDENT_KEYS if k not in half]
+            if missing:
+                problems.append(
+                    f"{_INCIDENT_KEY!r} without its config identity "
+                    f"({', '.join(missing)}) — journal overhead is only "
+                    "comparable within one replica/client/CPU-count "
+                    "config")
+            if half.get("incident_timeline_valid") is not True:
+                problems.append(
+                    "incident_timeline_valid is "
+                    f"{half.get('incident_timeline_valid')!r}: a "
+                    "SIGKILL chaos pass that was not reconstructed and "
+                    "validated in-run cannot back the stamped number")
+            if not isinstance(half.get("incident_death_latency_s"),
+                              (int, float)):
+                problems.append(
+                    f"{_INCIDENT_KEY!r} without a numeric "
+                    "'incident_death_latency_s' — the forensic horizon "
+                    "(SIGKILL → fenced regroup) is part of the claim")
+            linked = half.get("incident_linked_traces")
+            if not (isinstance(linked, int) and linked >= 1):
+                problems.append(
+                    "incident_linked_traces is "
+                    f"{linked!r}: without ≥1 exemplar-linked recovered "
+                    "trace the timeline answers 'what died' but never "
+                    "'what the user felt'")
+        elif half[_INCIDENT_KEY] is not None:
+            # neither null nor numeric: keep the forged-value door shut
+            # like the fleet block above
+            problems.append(
+                f"{_INCIDENT_KEY!r} must be numeric or an explicit null "
+                f"(got {half[_INCIDENT_KEY]!r})")
     # request-tracing overhead: A/B-measured on the online path, so a
     # degraded-accelerator round still owes it; null + reason always
     # satisfies (e.g. TFOS_TRACE_REQUESTS=0 runs have no A to B against)
@@ -928,7 +1008,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
          require_step_from: int = DEFAULT_REQUIRE_STEP_FROM,
          require_coldstart_from: int = DEFAULT_REQUIRE_COLDSTART_FROM,
          require_decode_from: int = DEFAULT_REQUIRE_DECODE_FROM,
-         require_fleet_from: int = DEFAULT_REQUIRE_FLEET_FROM
+         require_fleet_from: int = DEFAULT_REQUIRE_FLEET_FROM,
+         require_incident_from: int = DEFAULT_REQUIRE_INCIDENT_FROM
          ) -> dict[str, Any]:
     """Run the gate over a trajectory; returns the verdict document."""
     checks: list[dict[str, Any]] = []
@@ -984,6 +1065,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                           and art["n"] >= require_decode_from)
             require_fo = (label == "primary"
                           and art["n"] >= require_fleet_from)
+            require_in = (label == "primary"
+                          and art["n"] >= require_incident_from)
             for problem in validate_half(half, require_roofline=require_rf,
                                          require_feed=require_fd,
                                          require_serving=require_sv,
@@ -994,7 +1077,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                                          require_step=require_st,
                                          require_coldstart=require_cs,
                                          require_decode=require_dc,
-                                         require_fleet=require_fo):
+                                         require_fleet=require_fo,
+                                         require_incident=require_in):
                 check(f"schema:{name}:{label}",
                       "fail" if is_newest else "warn", problem)
             # flight breakdowns ride the primary half with the microbench
@@ -1307,6 +1391,8 @@ def main(argv: list[str] | None = None) -> int:
                    default=DEFAULT_REQUIRE_DECODE_FROM)
     p.add_argument("--require-fleet-from", type=int,
                    default=DEFAULT_REQUIRE_FLEET_FROM)
+    p.add_argument("--require-incident-from", type=int,
+                   default=DEFAULT_REQUIRE_INCIDENT_FROM)
     args = p.parse_args(argv)
     paths = args.paths or discover(args.repo)
     if not paths:
@@ -1327,7 +1413,8 @@ def main(argv: list[str] | None = None) -> int:
                require_step_from=args.require_step_from,
                require_coldstart_from=args.require_coldstart_from,
                require_decode_from=args.require_decode_from,
-               require_fleet_from=args.require_fleet_from)
+               require_fleet_from=args.require_fleet_from,
+               require_incident_from=args.require_incident_from)
     print(json.dumps(doc))
     return 1 if doc["verdict"] == "fail" else 0
 

@@ -24,10 +24,19 @@ one root; the root's parent may be the upstream caller's span), the
 parent graph is acyclic, and ``batch_mates`` lists are well-formed
 foreign trace ids (never the trace's own).
 
+``--journal`` switches to the incident-journal schema (a spool
+``journal-*.jsonl`` file, a ``GET /fleet/events`` page, or a bare event
+list): every event carries a known ``type``, numeric non-negative
+``ts``/``gen``/``seq``, integer ``pid``, a colon-free ``node``, a dict
+``attrs``; any ``trace_id`` in attrs (or in an ``exemplars`` list — the
+``slo.fire`` shape) is well-formed W3C hex; and the sequence is in
+journal total order (``(gen, ts, node, pid, seq)``).
+
 Usage::
 
     python tools/check_trace.py TRACE.json [TRACE2.json ...]
     python tools/check_trace.py --requests REQUESTS.json [...]
+    python tools/check_trace.py --journal JOURNAL.jsonl [...]
 
 Exit code 0 when every file validates, 1 otherwise (problems on stderr).
 Wired into tier-1 via ``tests/test_check_trace.py`` so a malformed event
@@ -256,26 +265,158 @@ def validate_requests_doc(doc: object) -> list[str]:
     return problems
 
 
-def validate_file(path: str, requests: bool = False) -> list[str]:
+def _journal_event_types() -> frozenset:
+    """The typed vocabulary, imported from the journal module itself so
+    the validator can never drift from the emitter."""
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tensorflowonspark_tpu.obs import journal
+    return journal.EVENT_TYPES
+
+
+def _validate_journal_event(ev: object, where: str,
+                            types: frozenset) -> list[str]:
+    problems: list[str] = []
+    if not isinstance(ev, dict):
+        return [f"{where}: not an object"]
+    etype = ev.get("type")
+    if etype not in types:
+        problems.append(f"{where}: unknown event type {etype!r}")
+    for field in ("ts", "gen", "seq"):
+        v = ev.get(field)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+            problems.append(f"{where}: {field!r} must be a non-negative "
+                            f"number, got {v!r}")
+    if not isinstance(ev.get("pid"), int):
+        problems.append(f"{where}: 'pid' must be an int, "
+                        f"got {ev.get('pid')!r}")
+    node = ev.get("node")
+    if not isinstance(node, str) or not node or ":" in node:
+        problems.append(f"{where}: 'node' must be a non-empty colon-free "
+                        f"string, got {node!r}")
+    attrs = ev.get("attrs")
+    if not isinstance(attrs, dict):
+        problems.append(f"{where}: 'attrs' must be an object")
+        return problems
+    tid = attrs.get("trace_id")
+    if tid is not None and not (isinstance(tid, str)
+                                and TRACE_ID_RE.match(tid)):
+        problems.append(f"{where}: malformed attrs.trace_id {tid!r} "
+                        "(32 lowercase hex)")
+    # the ``slo.fire`` shape: exemplar links into retained traces
+    exemplars = attrs.get("exemplars")
+    if exemplars is not None:
+        if not isinstance(exemplars, list):
+            problems.append(f"{where}: 'attrs.exemplars' must be a list")
+        else:
+            for i, ex in enumerate(exemplars):
+                if not isinstance(ex, dict):
+                    problems.append(
+                        f"{where}: exemplars[{i}] not an object")
+                    continue
+                ex_tid = ex.get("trace_id")
+                if not (isinstance(ex_tid, str)
+                        and TRACE_ID_RE.match(ex_tid)):
+                    problems.append(
+                        f"{where}: exemplars[{i}] malformed trace_id "
+                        f"{ex_tid!r} (32 lowercase hex)")
+    return problems
+
+
+def validate_journal_doc(doc: object) -> list[str]:
+    """Validate an incident-journal document.
+
+    Accepts a ``GET /fleet/events`` body (``{"events": [...]}``), a bare
+    event list (a parsed spool file), or a single event object.  Checks
+    the per-event schema against :data:`journal.EVENT_TYPES` plus the
+    total-order invariant: events must be sorted by the hybrid key
+    ``(gen, ts, node, pid, seq)`` — the contract every merged feed and
+    paginated page upholds.
+    """
+    if isinstance(doc, dict) and "events" in doc:
+        events = doc["events"]
+        if not isinstance(events, list):
+            return ["'events' must be a list"]
+    elif isinstance(doc, list):
+        events = doc
+    elif isinstance(doc, dict):
+        events = [doc]
+    else:
+        return [f"top level must be an object or list, got "
+                f"{type(doc).__name__}"]
+    types = _journal_event_types()
+    problems: list[str] = []
+    prev_key = None
+    for i, ev in enumerate(events):
+        where = f"events[{i}]"
+        evp = _validate_journal_event(ev, where, types)
+        problems.extend(evp)
+        if evp:
+            continue
+        key = (int(ev["gen"]), float(ev["ts"]), ev["node"], ev["pid"],
+               int(ev["seq"]))
+        if prev_key is not None and key < prev_key:
+            problems.append(
+                f"{where}: events out of (gen, ts, node, pid, seq) "
+                "order — the journal merge is supposed to be total")
+        prev_key = key
+    return problems
+
+
+def _load_journal_file(path: str) -> object:
+    """A journal file is either one JSON document or spool JSONL."""
+    with open(path) as f:
+        text = f.read()
     try:
-        with open(path) as f:
-            doc = json.load(f)
+        return json.loads(text)
+    except json.JSONDecodeError:
+        pass
+    events = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            events.append(json.loads(line))
+        except json.JSONDecodeError:
+            # a torn tail (crash mid-append) is expected; a torn line in
+            # the MIDDLE would be silently skipped here too, but the
+            # journal reader already counts those — the validator's job
+            # is the schema of what survives
+            continue
+    return events
+
+
+def validate_file(path: str, requests: bool = False,
+                  journal: bool = False) -> list[str]:
+    try:
+        if journal:
+            doc = _load_journal_file(path)
+        else:
+            with open(path) as f:
+                doc = json.load(f)
     except (OSError, json.JSONDecodeError) as e:
         return [f"cannot read/parse {path}: {e}"]
+    if journal:
+        return validate_journal_doc(doc)
     return validate_requests_doc(doc) if requests else validate_doc(doc)
 
 
 def main(argv: list[str]) -> int:
-    requests = False
+    requests = journal = False
     if argv and argv[0] == "--requests":
         requests = True
+        argv = argv[1:]
+    elif argv and argv[0] == "--journal":
+        journal = True
         argv = argv[1:]
     if not argv:
         print(__doc__, file=sys.stderr)
         return 2
     rc = 0
     for path in argv:
-        problems = validate_file(path, requests=requests)
+        problems = validate_file(path, requests=requests, journal=journal)
         if problems:
             rc = 1
             for p in problems:

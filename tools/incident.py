@@ -1,0 +1,334 @@
+#!/usr/bin/env python
+"""Incident forensics: merge a spool dir into one Perfetto timeline.
+
+Reads everything the fleet incident plane left behind in a journal spool
+directory — cadence-flushed journal files (``journal-<node>-<pid>.jsonl``)
+and digest-verified black-box bundles (``blackbox-<node>-<pid>-<ms>.json``
+written on crash/SIGTERM/fence) — and deterministically merges them into
+one Chrome-trace/Perfetto document:
+
+- every journal event becomes an ``"i"`` instant on its node's process
+  track (thread lane = originating pid), named by its event type, with
+  the event's attrs, generation, and causal cursor in ``args``;
+- trace-ring events and retained request spans recovered from black-box
+  bundles become ``"X"`` spans on the corpse's track (already in
+  microseconds; deduplicated across bundles);
+- each bundle's flight-recorder report is summarized as one
+  ``flight.report`` instant so stage attribution survives next to the
+  death event.
+
+Journal timestamps are epoch **seconds** (the spool contract); tracer and
+request spans are epoch **microseconds** (the Chrome-trace contract) —
+the merge converts journal events so everything shares one wall-clock
+axis.  Ordering is total and deterministic: ``obs.chrome.merge`` sorts
+nodes driver-first and events by ``(ts, pid, tid, name)``, so identical
+spools always produce byte-identical timelines, and the output passes
+``tools/check_trace.py``.
+
+Usage::
+
+    python tools/incident.py SPOOL_DIR -o incident.json
+    python tools/incident.py SPOOL_DIR --around 1754500000.5 --window 10
+    python tools/incident.py SPOOL_DIR --around last:slo.fire --summary
+
+``--around`` centers the timeline on an epoch-seconds instant — or on
+the last journal event of a type (``last:slo.fire``,
+``last:replica.death``) — keeping only events inside ``±window/2``
+seconds: the "what happened in the 10 s around this burn" view.
+``--summary`` prints the incident digest (deaths with stamped corpse
+bundles, generation fences, exemplar trace ids and whether their span
+trees were recovered) that ``bench.py --incident`` and the chaos tests
+assert on.
+
+Exit code 0 on success (and, with ``--validate``, a clean schema check);
+2 on an empty/unreadable spool.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tensorflowonspark_tpu.obs import chrome, journal  # noqa: E402
+
+#: journal event types that mark an incident epicenter for ``last:<type>``
+ANCHOR_TYPES = ("slo.fire", "replica.death", "blackbox.dump")
+
+
+def collect(spool_dir: str) -> dict[str, Any]:
+    """Read a spool dir into ``{"events", "bundles"}``.
+
+    Journal events are the union of every flushed spool file and every
+    bundle's last-N tail (the tail covers whatever the final cadence
+    flush never got to write before SIGKILL), deduplicated on
+    ``(node, pid, seq)`` and totally ordered by the hybrid key.  Bundles
+    that fail their sha256 sidecar check are skipped, not fatal.
+    """
+    events = journal.read_spool(spool_dir)
+    bundles: list[dict[str, Any]] = []
+    for path in journal.blackbox_files(spool_dir):
+        doc = journal.read_blackbox(path)
+        if doc is not None:
+            doc["_path"] = path
+            bundles.append(doc)
+    tails = [b.get("events") or [] for b in bundles]
+    if any(tails):
+        events = journal.merge_events(events, *tails)
+    return {"events": events, "bundles": bundles}
+
+
+def resolve_anchor(events: list[dict[str, Any]],
+                   around: str | float | None) -> float | None:
+    """Turn ``--around`` into an epoch-seconds center, or None."""
+    if around is None:
+        return None
+    if isinstance(around, (int, float)):
+        return float(around)
+    s = str(around)
+    if s.startswith("last:"):
+        etype = s[5:]
+        anchored = [e for e in events if e.get("type") == etype]
+        if not anchored:
+            raise ValueError(f"no {etype!r} event in the journal to "
+                             "anchor --around on")
+        return float(anchored[-1]["ts"])
+    return float(s)
+
+
+def _window_bounds(center: float | None,
+                   window_s: float) -> tuple[float, float]:
+    if center is None:
+        return float("-inf"), float("inf")
+    half = max(0.0, float(window_s)) / 2.0
+    return center - half, center + half
+
+
+def _journal_instant(ev: dict[str, Any]) -> dict[str, Any]:
+    args: dict[str, Any] = dict(ev.get("attrs") or {})
+    if ev.get("gen") is not None:
+        args["gen"] = ev["gen"]
+    args["cursor"] = journal.encode_cursor(ev)
+    return {
+        "name": str(ev.get("type", "journal.event")),
+        "ph": "i",
+        "ts": float(ev.get("ts", 0.0)) * 1e6,  # seconds -> microseconds
+        "tid": int(ev.get("pid") or 0),
+        "attrs": args,
+    }
+
+
+def build_timeline(events: list[dict[str, Any]],
+                   bundles: list[dict[str, Any]],
+                   around: float | None = None,
+                   window_s: float = 10.0) -> dict[str, Any]:
+    """Merge journal events + bundle spans into one Chrome-trace doc."""
+    lo, hi = _window_bounds(around, window_s)
+    lo_us, hi_us = lo * 1e6, hi * 1e6
+    by_node: dict[str, list[dict[str, Any]]] = {}
+
+    def lane(node: Any) -> list[dict[str, Any]]:
+        return by_node.setdefault(str(node or "?"), [])
+
+    for ev in events:
+        ts = float(ev.get("ts", 0.0))
+        if not (lo <= ts <= hi):
+            continue
+        lane(ev.get("node")).append(_journal_instant(ev))
+
+    seen_spans: set = set()  # dedup across overlapping bundles
+    for b in bundles:
+        node = b.get("node") or "?"
+        for tev in b.get("trace") or []:
+            if not isinstance(tev, dict):
+                continue
+            ts = tev.get("ts")
+            if not isinstance(ts, (int, float)) or not (
+                    lo_us <= ts <= hi_us):
+                continue
+            key = ("ring", tev.get("node") or node, tev.get("pid"),
+                   tev.get("tid"), ts, tev.get("name"), tev.get("ph"))
+            if key in seen_spans:
+                continue
+            seen_spans.add(key)
+            lane(tev.get("node") or node).append(tev)
+        for req in b.get("requests") or []:
+            if not isinstance(req, dict):
+                continue
+            for sp in req.get("spans") or []:
+                if not isinstance(sp, dict):
+                    continue
+                ts = sp.get("ts")
+                if not isinstance(ts, (int, float)) or not (
+                        lo_us <= ts <= hi_us):
+                    continue
+                key = ("req", sp.get("trace_id"), sp.get("span_id"))
+                if key in seen_spans:
+                    continue
+                seen_spans.add(key)
+                lane(sp.get("node") or node).append(sp)
+        flight = b.get("flight") or {}
+        bts = float(b.get("ts") or 0.0)
+        if flight and lo <= bts <= hi:
+            lane(node).append({
+                "name": "flight.report",
+                "ph": "i",
+                "ts": bts * 1e6,
+                "tid": int(b.get("pid") or 0),
+                "attrs": {"planes": sorted(flight),
+                          "reason": b.get("reason")},
+            })
+    return chrome.merge(by_node)
+
+
+def _exemplar_ids(events: list[dict[str, Any]]) -> list[str]:
+    """Every trace id the journal links to — slo.fire exemplars plus
+    decode admit/retire/cancel breach stamps — in first-seen order."""
+    out: list[str] = []
+    seen: set = set()
+    for ev in events:
+        attrs = ev.get("attrs") or {}
+        cands: list[Any] = [attrs.get("trace_id")]
+        for ex in attrs.get("exemplars") or []:
+            if isinstance(ex, dict):
+                cands.append(ex.get("trace_id"))
+        for tid in cands:
+            if isinstance(tid, str) and tid and tid not in seen:
+                seen.add(tid)
+                out.append(tid)
+    return out
+
+
+def _recovered_ids(bundles: list[dict[str, Any]]) -> set:
+    """Trace ids whose span trees survive in some black-box bundle."""
+    got: set = set()
+    for b in bundles:
+        for req in b.get("requests") or []:
+            if isinstance(req, dict) and req.get("trace_id"):
+                got.add(req["trace_id"])
+        for tev in b.get("trace") or []:
+            if isinstance(tev, dict) and tev.get("trace_id"):
+                got.add(tev["trace_id"])
+    return got
+
+
+def summarize(events: list[dict[str, Any]],
+              bundles: list[dict[str, Any]]) -> dict[str, Any]:
+    """The incident digest the chaos proof asserts on.
+
+    ``deaths`` carries each ``replica.death`` with its stamped corpse
+    bundle; ``regroups`` each generation fence; ``exemplars`` maps the
+    journal's linked trace ids to whether a bundle recovered their span
+    trees (``linked`` = intersection, the "exemplar-linked trace"
+    acceptance bit).  ``ordered`` re-checks the total order end to end.
+    """
+    deaths = [e for e in events if e.get("type") == "replica.death"]
+    regroups = [e for e in events
+                if e.get("type") in ("mesh.regroup", "elastic.regroup")]
+    keys = [journal.order_key(e) for e in events]
+    exemplar_ids = _exemplar_ids(events)
+    recovered = _recovered_ids(bundles)
+    return {
+        "events": len(events),
+        "nodes": sorted({str(e.get("node") or "?") for e in events}),
+        "generations": sorted({int(e.get("gen") or 0) for e in events}),
+        "ordered": keys == sorted(keys),
+        "deaths": [{"replica": (e.get("attrs") or {}).get("replica"),
+                    "gen": e.get("gen"),
+                    "reason": (e.get("attrs") or {}).get("reason"),
+                    "corpse": (e.get("attrs") or {}).get("corpse")}
+                   for e in deaths],
+        "regroups": [{"type": e.get("type"), "gen": e.get("gen"),
+                      "lost": (e.get("attrs") or {}).get("lost"),
+                      "joined": (e.get("attrs") or {}).get("joined")}
+                     for e in regroups],
+        "bundles": [{"node": b.get("node"), "reason": b.get("reason"),
+                     "gen": b.get("gen"), "path": b.get("_path")}
+                    for b in bundles],
+        "exemplars": exemplar_ids,
+        "linked": sorted(t for t in exemplar_ids if t in recovered),
+    }
+
+
+def reconstruct(spool_dir: str, around: str | float | None = None,
+                window_s: float = 10.0) -> dict[str, Any]:
+    """One-call API for tests and ``bench.py --incident``: returns
+    ``{"timeline", "summary"}`` for a spool dir."""
+    src = collect(spool_dir)
+    center = resolve_anchor(src["events"], around)
+    return {
+        "timeline": build_timeline(src["events"], src["bundles"],
+                                   around=center, window_s=window_s),
+        "summary": summarize(src["events"], src["bundles"]),
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge a journal spool dir into one Perfetto "
+                    "timeline")
+    ap.add_argument("spool", help="journal spool directory "
+                    "(TFOS_JOURNAL_DIR of the incident run)")
+    ap.add_argument("-o", "--output", default=None,
+                    help="write the Chrome-trace JSON here "
+                    "(default: <spool>/incident.json)")
+    ap.add_argument("--around", default=None,
+                    help="center: epoch seconds, or last:<event-type> "
+                    f"(e.g. {', '.join('last:' + t for t in ANCHOR_TYPES)})")
+    ap.add_argument("--window", type=float, default=10.0,
+                    help="window width in seconds around --around "
+                    "(default 10)")
+    ap.add_argument("--summary", action="store_true",
+                    help="print the incident digest JSON to stdout")
+    ap.add_argument("--validate", action="store_true",
+                    help="schema-check the emitted timeline with "
+                    "tools/check_trace.py")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.spool):
+        print(f"incident: no spool dir at {args.spool}", file=sys.stderr)
+        return 2
+    src = collect(args.spool)
+    if not src["events"] and not src["bundles"]:
+        print(f"incident: spool {args.spool} holds no journal files or "
+              "black-box bundles", file=sys.stderr)
+        return 2
+    try:
+        center = resolve_anchor(src["events"], args.around)
+    except ValueError as e:
+        print(f"incident: {e}", file=sys.stderr)
+        return 2
+    doc = build_timeline(src["events"], src["bundles"], around=center,
+                         window_s=args.window)
+    out = args.output or os.path.join(args.spool, "incident.json")
+    with open(out, "w") as f:
+        json.dump(doc, f, sort_keys=True, separators=(",", ":"))
+    n = sum(1 for e in doc["traceEvents"] if e.get("ph") != "M")
+    print(f"incident: wrote {out} ({n} events, "
+          f"{len(src['bundles'])} black-box bundles)")
+    if args.validate:
+        _tools = os.path.dirname(os.path.abspath(__file__))
+        if _tools not in sys.path:
+            sys.path.insert(0, _tools)
+        import check_trace
+
+        problems = check_trace.validate_doc(doc)
+        for p in problems:
+            print(f"incident: {out}: {p}", file=sys.stderr)
+        if problems:
+            return 1
+        print(f"incident: {out}: schema OK")
+    if args.summary:
+        print(json.dumps(summarize(src["events"], src["bundles"]),
+                         indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
