@@ -295,6 +295,13 @@ def _parse_args(argv=None):
                         "output-equality check, and allreduce overlap "
                         "efficiency against the delivered ICI bandwidth "
                         "(null + reason on a single device)")
+    p.add_argument("--collectives", action="store_true",
+                   help="compare the reduce-scatter + sharded-update + "
+                        "all-gather exchange against the bucketed "
+                        "all-reduce: analytic bytes ratio (numeric on any "
+                        "box), 4-step output equality, and rows/sec both "
+                        "ways on ≥2 local devices (equality and "
+                        "throughput null + reason on a single device)")
     p.add_argument("--recovery", action="store_true",
                    help="measure executor-loss recovery: seconds from "
                         "SIGKILLing one of three trainers mid-run to the "
@@ -3158,6 +3165,183 @@ def measure_step_collectives(steps: int = 8, batch_per_device: int = 64,
     return out
 
 
+def measure_collectives(steps: int = 8, batch_per_device: int = 64,
+                        hidden: int = 128, depth: int = 6) -> dict:
+    """The sharded-weight-update collectives comparison (ISSUE 17, r19):
+    reduce-scatter + in-region 1/N optimizer update + parameter
+    all-gather, vs the PR 12 bucketed all-reduce structure.
+
+    Two claims, accounted separately:
+
+    1. **analytic bytes** (``collectives_bytes_ratio``): the
+       ``collective_bytes_per_step`` model's gradient-EXCHANGE ratio
+       (scatter path / allreduce path) for this toy model's parameter
+       tree.  The model needs no second device, so the ratio is numeric
+       on every box — evaluated at ``collectives_model_world`` (the real
+       device count, floored at 8 so the 1-device CI box still exercises
+       the asymptotic claim) and gated < 1 by ``tools/bench_gate.py
+       --require-collectives-from`` within config identity (platform,
+       devices, dcn_world, model, grad/bucket sizing, update-shard mode);
+    2. **measured equivalence + throughput**: with ≥ 2 local devices the
+       sharded-update step's 4-step loss trajectory must match the
+       all-reduce step's within the established f32 tolerances BEFORE any
+       throughput is stamped (``collectives_equality: "fail"`` stamps no
+       numbers — broken, not fast), then ``collectives_rows_per_sec``
+       times the sharded step.  On a single device both stamp null +
+       ``collectives_reason`` — real wall-clock deferred to hardware,
+       per the r12/r14 discipline.
+    """
+    from tensorflowonspark_tpu import util
+
+    util.ensure_jax_platform()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.parallel import (
+        MeshConfig,
+        build_mesh,
+        collectives,
+        create_train_state,
+        infer_param_sharding,
+        make_bucketed_train_step,
+        shard_batch,
+    )
+
+    n_dev = jax.device_count()
+    batch_size = batch_per_device * max(1, n_dev)
+    update_shard = collectives.sharded_update_enabled()
+    out: dict = {
+        "collectives_bytes_ratio": None,
+        "collectives_equality": None,
+        "collectives_rows_per_sec": None,
+        "collectives_platform": jax.default_backend(),
+        "collectives_devices": n_dev,
+        "collectives_model": f"mlp_h{hidden}x{depth}",
+        "collectives_batch_size": batch_size,
+        "collectives_update_shard": bool(update_shard),
+    }
+
+    rng = np.random.RandomState(0)
+    params: dict = {}
+    for i in range(depth):
+        params[f"layer{i}"] = {
+            "w": jnp.asarray(rng.randn(hidden, hidden) / np.sqrt(hidden),
+                             jnp.float32),
+            "b": jnp.zeros((hidden,), jnp.float32)}
+    params["head"] = {
+        "w": jnp.asarray(rng.randn(hidden, 4) / np.sqrt(hidden),
+                         jnp.float32),
+        "b": jnp.zeros((4,), jnp.float32)}
+    param_leaves = jax.tree_util.tree_leaves(params)
+    grad_bytes = sum(collectives.leaf_bytes(leaf) for leaf in param_leaves)
+    bucket_bytes = max(16 * 1024, grad_bytes // 4)
+    # floor low enough that the hidden×hidden kernels (64 KiB) take the
+    # scatter path while the bias vectors ride replicated — the mixed
+    # plan the analytic model and the HLO tests exercise
+    scatter_min = 1024
+    out["collectives_grad_mb"] = round(grad_bytes / (1024 * 1024), 4)
+    out["collectives_bucket_mb"] = round(bucket_bytes / (1024 * 1024), 4)
+
+    # analytic bytes: numeric on every box (the model is the claim the
+    # gate ratchets; wall-clock is a separate, hardware-gated claim)
+    model_world = max(n_dev, 8)
+    dcn_world = 1
+    if n_dev >= 2:
+        mesh = build_mesh(MeshConfig(dp=n_dev))
+        _stages, dcn_world, _reason = collectives.scatter_stages(mesh, None)
+    comm = collectives.collective_bytes_per_step(
+        param_leaves, model_world, scatter_min_bytes=scatter_min,
+        dcn_world=dcn_world, update_shard=update_shard)
+    out["collectives_model_world"] = model_world
+    out["collectives_dcn_world"] = dcn_world
+    out["collectives_bytes_ratio"] = round(comm["exchange_ratio"], 4)
+    mb = 1024.0 * 1024.0
+    out["collectives_allreduce_mb"] = round(
+        comm["allreduce"]["exchange"] / mb, 4)
+    out["collectives_scatter_mb"] = round(
+        comm["scatter"]["exchange"] / mb, 4)
+    out["collectives_gather_mb"] = round(comm["scatter"]["gather"] / mb, 4)
+    out["collectives_scatter_leaves"] = comm["n_scatter_leaves"]
+
+    if n_dev < 2:
+        out["collectives_reason"] = (
+            "single device: no cross-replica exchange to reduce-scatter; "
+            "bytes ratio is analytic at model_world="
+            f"{model_world}, wall-clock deferred to hardware")
+        return out
+
+    def loss_fn(p, batch):
+        h = batch["x"]
+        for i in range(depth):
+            h = jnp.tanh(h @ p[f"layer{i}"]["w"] + p[f"layer{i}"]["b"])
+        pred = h @ p["head"]["w"] + p["head"]["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    batch = {"x": rng.randn(batch_size, hidden).astype(np.float32),
+             "y": rng.randn(batch_size, 4).astype(np.float32)}
+    optimizer = optax.adamw(1e-3)
+    shardings = infer_param_sharding(params, mesh)
+
+    def fresh_state():
+        return create_train_state(
+            jax.tree_util.tree_map(jnp.copy, params), optimizer)
+
+    sb = shard_batch(mesh, batch)
+    allred = make_bucketed_train_step(
+        loss_fn, optimizer, mesh, shardings, fresh_state(), batch,
+        donate=False, bucket_bytes=bucket_bytes, update_shard=False)
+    sharded = make_bucketed_train_step(
+        loss_fn, optimizer, mesh, shardings, fresh_state(), batch,
+        donate=False, bucket_bytes=bucket_bytes, update_shard=update_shard,
+        scatter_min_bytes=scatter_min)
+    out["collectives_n_scatter_buckets"] = sharded.n_scatter_buckets
+    out["collectives_n_replicated_buckets"] = sharded.n_replicated_buckets
+
+    # equivalence BEFORE throughput: a fast wrong answer is worthless
+    trajectories = {}
+    for name, step_fn in (("allreduce", allred), ("sharded", sharded)):
+        st, losses = fresh_state(), []
+        for _ in range(4):
+            st, loss = step_fn(st, sb)
+            losses.append(float(np.asarray(jax.device_get(loss))))
+        trajectories[name] = losses
+    try:
+        np.testing.assert_allclose(trajectories["sharded"],
+                                   trajectories["allreduce"],
+                                   rtol=5e-5, atol=1e-7)
+        out["collectives_equality"] = "pass"
+    except AssertionError as e:
+        out["collectives_equality"] = "fail"
+        out["collectives_equality_detail"] = str(e)[-300:]
+        out["collectives_reason"] = (
+            "sharded-update step diverged from the bucketed all-reduce "
+            "step: throughput not stamped")
+        return out
+
+    def timed(step_fn) -> float:
+        st = fresh_state()
+        loss = None
+        for _ in range(2):
+            st, loss = step_fn(st, sb)
+        float(np.asarray(jax.device_get(loss)))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            st, loss = step_fn(st, sb)
+        float(np.asarray(jax.device_get(loss)))
+        return time.perf_counter() - t0
+
+    dt_sharded = timed(sharded)
+    dt_allred = timed(allred)
+    out["collectives_rows_per_sec"] = round(
+        steps * batch_size / dt_sharded, 1)
+    out["collectives_rows_per_sec_allreduce"] = round(
+        steps * batch_size / dt_allred, 1)
+    out["collectives_steps"] = steps
+    return out
+
+
 def _coldstart_child(cfg_path: str) -> None:
     """Child half of ``measure_compile_cache``: ONE fleet cold start.
 
@@ -3435,6 +3619,33 @@ def _stamp_step_collectives(result: dict, deadline: _Deadline) -> None:
             result["step_rows_per_sec"] = None
             result["step_reason"] = (
                 f"step-collectives microbench failed: {e!r}"[:200])
+            sp.set(ok=False, error=str(e)[:200])
+
+
+def _stamp_collectives(result: dict, deadline: _Deadline) -> None:
+    """Stamp the sharded-weight-update collectives comparison (r19).
+
+    The analytic bytes ratio is numeric on every box; equality and
+    throughput need ≥ 2 local devices and otherwise stamp null +
+    ``collectives_reason`` (``tools/bench_gate.py`` requires the fields
+    from r19)."""
+    from tensorflowonspark_tpu import obs
+
+    if deadline.remaining() < 60:
+        result["collectives_bytes_ratio"] = None
+        result["collectives_reason"] = ("wall budget exhausted before "
+                                        "collectives microbench")
+        return
+    with obs.span("bench.collectives") as sp:
+        try:
+            result.update(measure_collectives())
+            sp.set(ok=True,
+                   bytes_ratio=result.get("collectives_bytes_ratio"),
+                   equality=result.get("collectives_equality"))
+        except Exception as e:
+            result["collectives_bytes_ratio"] = None
+            result["collectives_reason"] = (
+                f"collectives microbench failed: {e!r}"[:200])
             sp.set(ok=False, error=str(e)[:200])
 
 
@@ -3820,6 +4031,17 @@ def main() -> None:
         print(json.dumps(result))
         return
 
+    if args.collectives:
+        # analytic bytes model + local-device-set A/B: no probe (the
+        # bytes ratio is numeric even on one device; wall-clock nulls
+        # with a reason there)
+        result = {"metric": "collectives_bytes_ratio", "unit": "ratio"}
+        _stamp_collectives(result, deadline)
+        result["value"] = result.get("collectives_bytes_ratio")
+        _write_trace_artifact(result)
+        print(json.dumps(result))
+        return
+
     probe = _probe_accelerator(deadline)
     probe_failed_at_start = not probe.get("ok")
     health = {"ok": bool(probe.get("ok")),
@@ -3907,6 +4129,7 @@ def main() -> None:
     _stamp_fleet(result, deadline)
     _stamp_incident(result, deadline)
     _stamp_step_collectives(result, deadline)
+    _stamp_collectives(result, deadline)
     _stamp_compile_cache(result, deadline)
     if not probe.get("ok"):
         result["probe"] = probe

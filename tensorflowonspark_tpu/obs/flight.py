@@ -90,6 +90,12 @@ STAGE_VERDICT = {
     "shard": "ingest_bound",
     "compute": "device_bound",
     "allreduce": "comm_bound",
+    # sharded weight update (reduce-scatter path): the gradient
+    # reduce-scatter and the post-update parameter all-gather are
+    # interconnect legs; the 1/N optimizer update is device work
+    "scatter": "comm_bound",
+    "gather": "comm_bound",
+    "update": "device_bound",
     "emit": "emit_bound",
     "reply": "emit_bound",
     # generative decode plane: prefill (prompt ingestion, one sequence at

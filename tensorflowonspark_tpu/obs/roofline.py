@@ -16,10 +16,15 @@ run, and publishes them beside the throughput number they contextualise —
   ``psum`` over all local devices, reported as the per-device ring
   all-reduce bandwidth ``2*S*(n-1)/n / dt`` — ``None`` with a reason on a
   single device (there is no interconnect to measure);
-- :func:`probe` runs both, never raises, and mirrors the results into the
-  process obs registry (``roofline_mem_bw_gbps`` / ``roofline_ici_bw_gbps``
-  gauges) so they ride the MetricsReporter publications like every other
-  instrument.
+- **cross-slice DCN bandwidth** (:func:`measure_dcn_bandwidth`): the same
+  collective over one device per slice, so the ring crosses only the
+  data-centre network — the figure the two-tier bucket sizing
+  (``collectives.dcn_bucket_bytes_default``) consumes; ``None`` + reason
+  on a single-slice topology;
+- :func:`probe` runs all three, never raises, and mirrors the results into
+  the process obs registry (``roofline_mem_bw_gbps`` /
+  ``roofline_ici_bw_gbps`` / ``roofline_dcn_bw_gbps`` gauges) so they ride
+  the MetricsReporter publications like every other instrument.
 
 ``bench.py`` calls :func:`probe` after its timing loop and stamps
 ``mem_bw_gbps`` / ``ici_bw_gbps`` into every BENCH JSON (explicit ``null``
@@ -225,21 +230,87 @@ def measure_ici_bandwidth(size_bytes_per_device: int | None = None,
             "array_mb_per_device": round(s * 4 / 1e6, 1)}
 
 
+def _slice_groups() -> dict[int, list]:
+    """Devices grouped by ``slice_index`` (the PJRT attribute a
+    multi-slice TPU runtime sets; absent → slice 0)."""
+    import jax
+
+    groups: dict[int, list] = {}
+    for d in jax.devices():
+        groups.setdefault(int(getattr(d, "slice_index", 0) or 0), []).append(d)
+    return groups
+
+
+def measure_dcn_bandwidth(size_bytes_per_device: int | None = None,
+                          repeats: int = 3) -> dict[str, Any]:
+    """Cross-slice (DCN-class) all-reduce bandwidth.
+
+    Groups devices by their ``slice_index`` (the PJRT attribute a
+    multi-slice TPU runtime sets; absent → slice 0) and runs the
+    :func:`measure_ici_bandwidth` collective over ONE device per slice —
+    a 1-D mesh whose only axis crosses the data-centre network, so the
+    ring traverses no ICI link and the measured figure is the DCN tier's
+    own delivered bandwidth (the number
+    ``collectives.dcn_bucket_bytes_default`` sizes cross-slice buckets
+    against).  Returns ``{"gbps": None, "reason": ...}`` on a
+    single-slice (or single-device) topology — there is no DCN to
+    measure, and stamping a number would launder an ICI figure into a
+    DCN field.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+
+    groups = _slice_groups()
+    if len(groups) < 2:
+        return {"gbps": None,
+                "reason": f"single slice ({len(jax.devices())} devices): "
+                          "no cross-slice interconnect"}
+    ring = [groups[k][0] for k in sorted(groups)]
+    n = len(ring)
+    if size_bytes_per_device is None:
+        size_bytes_per_device = _default_bytes() // 4
+    s = max(1024, int(size_bytes_per_device) // 4)
+    mesh = jax.sharding.Mesh(np.asarray(ring), ("dcn",))
+    sharded = jax.sharding.NamedSharding(mesh, P("dcn"))
+    x = jax.jit(lambda: jnp.ones((n, s), jnp.float32),
+                out_shardings=sharded)()
+    allreduce = jax.jit(mesh_lib.shard_map_compat(
+        lambda a: jax.lax.psum(a, "dcn"), mesh,
+        in_specs=P("dcn"), out_specs=P("dcn")))
+    _fetch_first_local(allreduce(x))  # compile outside the clock
+    overhead = _dispatch_overhead(repeats)
+    dt = _best_time(lambda: _fetch_first_local(allreduce(x)), repeats)
+    if dt < 2.0 * overhead:
+        return {"gbps": None, "n_slices": n,
+                "reason": "probe dominated by dispatch overhead "
+                          f"(~{overhead * 1e3:.1f} ms); raise "
+                          "TFOS_ROOFLINE_BYTES"}
+    moved = 2.0 * s * 4 * (n - 1) / n
+    return {"gbps": moved / (dt - overhead) / 1e9, "n_slices": n,
+            "array_mb_per_device": round(s * 4 / 1e6, 1)}
+
+
 def probe(size_bytes: int | None = None, repeats: int = 3,
           registry=None) -> dict[str, Any]:
     """Run the full roofline probe suite; never raises.
 
-    Returns a flat dict with ``mem_bw_gbps`` / ``ici_bw_gbps`` always
-    present (``None`` plus a ``*_reason`` when unmeasurable) and mirrors
-    the measured values into the obs registry as gauges
-    (``roofline_mem_bw_gbps``, ``roofline_mem_bw_reduction_gbps``,
-    ``roofline_ici_bw_gbps``).
+    Returns a flat dict with ``mem_bw_gbps`` / ``ici_bw_gbps`` /
+    ``dcn_bw_gbps`` always present (``None`` plus a ``*_reason`` when
+    unmeasurable) and mirrors the measured values into the obs registry
+    as gauges (``roofline_mem_bw_gbps``,
+    ``roofline_mem_bw_reduction_gbps``, ``roofline_ici_bw_gbps``,
+    ``roofline_dcn_bw_gbps``).
     """
     from tensorflowonspark_tpu.obs import registry as reg_mod
     from tensorflowonspark_tpu.obs import trace as trace_mod
 
     reg = registry if registry is not None else reg_mod.get_registry()
-    out: dict[str, Any] = {"mem_bw_gbps": None, "ici_bw_gbps": None}
+    out: dict[str, Any] = {"mem_bw_gbps": None, "ici_bw_gbps": None,
+                           "dcn_bw_gbps": None}
     t0 = time.perf_counter()
     with trace_mod.get_tracer().span("roofline.probe"):
         try:
@@ -294,5 +365,16 @@ def probe(size_bytes: int | None = None, repeats: int = 3,
         except Exception as e:
             out["ici_bw_reason"] = f"interconnect probe failed: {e!r}"[:300]
             logger.warning("roofline interconnect probe failed: %s", e)
+        try:
+            dcn = measure_dcn_bandwidth(repeats=repeats)
+            if dcn.get("gbps") is not None:
+                out["dcn_bw_gbps"] = round(dcn["gbps"], 2)
+                out["dcn_n_slices"] = dcn.get("n_slices")
+                reg.gauge("roofline_dcn_bw_gbps").set(out["dcn_bw_gbps"])
+            else:
+                out["dcn_bw_reason"] = dcn.get("reason", "unmeasurable")
+        except Exception as e:
+            out["dcn_bw_reason"] = f"DCN probe failed: {e!r}"[:300]
+            logger.warning("roofline DCN probe failed: %s", e)
     out["probe_s"] = round(time.perf_counter() - t0, 3)
     return out

@@ -8,9 +8,11 @@ XLA collectives emitted by ``pjit``/``shard_map`` over a
 """
 
 from tensorflowonspark_tpu.parallel.collectives import (  # noqa: F401
+    collective_bytes_per_step,
     ideal_serial_allreduce_seconds,
     make_bucketed_train_step,
     partition_buckets,
+    scatter_stages,
 )
 from tensorflowonspark_tpu.parallel.distributed import (  # noqa: F401
     maybe_initialize,

@@ -28,11 +28,45 @@ or the mesh/model combination requires the monolithic path.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Any, Callable
 
 from tensorflowonspark_tpu.parallel import mesh as mesh_lib
 
 logger = logging.getLogger(__name__)
+
+#: default ZeRO / sharded-update size floor in BYTES — equal to the
+#: historical ``1 << 16``-*element* threshold for f32 params, so the
+#: default behaviour is unchanged where it was tuned
+DEFAULT_ZERO_MIN_BYTES = 1 << 18
+
+
+def zero_min_bytes() -> int:
+    """Size floor (bytes) below which a leaf is not worth sharding —
+    ``TFOS_ZERO_MIN_BYTES`` override, else :data:`DEFAULT_ZERO_MIN_BYTES`.
+
+    One knob for two boundaries that must agree: ``apply_zero_sharding``'s
+    don't-bother threshold and the sharded-update scatter eligibility
+    (``shapes.update_shard_eligible``).  If they diverged, a leaf could be
+    ZeRO-sharded yet ride the replicated gradient path (memory saved, comm
+    win lost) or vice versa (a degenerate one-leaf scatter bucket for a
+    leaf whose optimizer state nobody bothered to shard)."""
+    env = os.environ.get("TFOS_ZERO_MIN_BYTES", "")
+    try:
+        return max(1, int(env)) if env else DEFAULT_ZERO_MIN_BYTES
+    except ValueError:
+        return DEFAULT_ZERO_MIN_BYTES
+
+
+def path_keys(path) -> tuple:
+    """Normalize a jax keypath to a tuple of plain strings — the matching
+    key for "optimizer-state leaf belongs to param" lookups
+    (:func:`state_shardings` and the sharded-update in-region specs,
+    ``parallel/collectives.py``)."""
+    return tuple(
+        str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k))))
+        for k in path
+    )
 
 
 def unbox(tree):
@@ -100,7 +134,7 @@ def merge_collection_shardings(collections, mesh, overrides=None):
 
 
 def state_shardings(state: TrainState, param_shardings, mesh,
-                    collection_shardings=None):
+                    collection_shardings=None, opt_param_shardings=None):
     """Shardings for the full train state.
 
     Optimizer-state leaves carry the sharding the eager ``optimizer.init``
@@ -110,6 +144,14 @@ def state_shardings(state: TrainState, param_shardings, mesh,
     Leaves without a mesh sharding (step counts, EMA decay scalars)
     replicate.
 
+    ``opt_param_shardings`` optionally substitutes a DIFFERENT param-tree
+    of shardings for that optimizer-state inheritance only (params keep
+    ``param_shardings``) — the sharded-update step stores each
+    scatter-eligible param's ``mu``/``nu`` as the dim-0 slice its
+    ``psum_scatter`` block lands on (``P((data_axes...), ...)``), so the
+    scattered gradient shard and the optimizer state meet on-device with
+    no resharding hop (``parallel/collectives.py``).
+
     ``collection_shardings`` optionally maps a collection name to a pytree
     of shardings for its leaves (e.g. wide&deep's embedding tables sharded
     over the vocab dim — the module hook ``make_collection_shardings``);
@@ -117,18 +159,16 @@ def state_shardings(state: TrainState, param_shardings, mesh,
     """
     import jax
 
-    def _norm(path) -> tuple:
-        return tuple(
-            str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k))))
-            for k in path
-        )
+    _norm = path_keys
 
     # param tree path -> (shape, sharding): optax state trees (Adam mu/nu,
     # momentum, …) embed the SAME sub-tree structure as params, so an opt
     # leaf's path ends with its param's path
     flat_params = jax.tree_util.tree_flatten_with_path(state.params)[0]
     flat_shards = jax.tree_util.tree_leaves(
-        param_shardings, is_leaf=lambda x: hasattr(x, "spec")
+        opt_param_shardings if opt_param_shardings is not None
+        else param_shardings,
+        is_leaf=lambda x: hasattr(x, "spec")
     )
     by_path = {
         _norm(path): (getattr(leaf, "shape", ()), shard)
@@ -168,22 +208,33 @@ def state_shardings(state: TrainState, param_shardings, mesh,
                       mesh_lib.replicated(mesh), col_shardings)
 
 
-def apply_zero_sharding(param_shardings, mesh, params, min_size: int = 1 << 16):
+def apply_zero_sharding(param_shardings, mesh, params,
+                        min_size: int | None = None):
     """Extend param shardings with an ``fsdp`` dimension (ZeRO / num_ps map).
 
-    For each parameter ≥ ``min_size`` elements, shard its largest
-    not-yet-sharded, fsdp-divisible dimension over ``fsdp``.
+    For each parameter at least :func:`zero_min_bytes` big (the
+    ``TFOS_ZERO_MIN_BYTES`` knob, shared with the sharded-update scatter
+    eligibility so the two boundaries cannot drift), shard its largest
+    not-yet-sharded, fsdp-divisible dimension over ``fsdp``.  An explicit
+    ``min_size`` keeps the historical ELEMENT-count semantics (tests pin
+    ``min_size=1`` to shard everything).
     """
     import jax
 
     fsdp = mesh.shape["fsdp"]
     if fsdp <= 1:
         return param_shardings
+    min_bytes = zero_min_bytes() if min_size is None else None
 
     def _one(sharding, leaf):
         shape = getattr(leaf, "shape", ())
         spec = list(sharding.spec) + [None] * (len(shape) - len(sharding.spec))
-        if getattr(leaf, "size", 0) < min_size:
+        size = getattr(leaf, "size", 0)
+        if min_bytes is not None:
+            itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", 4)
+            if size * itemsize < min_bytes:
+                return sharding
+        elif size < min_size:
             return sharding
         dims = sorted(range(len(shape)), key=lambda d: -shape[d])
         for d in dims:
@@ -230,6 +281,7 @@ def compile_step(
     sequence_axes: dict[str, int] | None = None,
     donate: bool = True,
     collection_shardings=None,
+    opt_param_shardings=None,
 ):
     """Jit an arbitrary ``state, batch -> state, loss`` step over the mesh.
 
@@ -239,11 +291,14 @@ def compile_step(
     This is the shared lower half of :func:`make_train_step`; model-zoo
     modules with a custom step (e.g. wide&deep's sparse embedding update,
     ``models/widedeep.py::make_sharded_train_step``) call it directly.
+    ``opt_param_shardings`` is threaded to :func:`state_shardings` (the
+    sharded-update step's scatter-sliced optimizer-state storage).
     """
     import jax
 
     shardings = state_shardings(state, param_shardings, mesh,
-                                collection_shardings=collection_shardings)
+                                collection_shardings=collection_shardings,
+                                opt_param_shardings=opt_param_shardings)
     batch_shardings = _batch_shardings(mesh, batch_example, sequence_axes)
 
     return _MeshBoundFn(
@@ -281,6 +336,7 @@ def make_train_step(
     donate: bool = True,
     collection_shardings=None,
     bucketed: bool | None = None,
+    mesh_config=None,
 ):
     """Compile ``state, batch -> state, loss`` over the mesh.
 
@@ -302,6 +358,11 @@ def make_train_step(
     - ``True``: force the bucketed step (raises with the reason when the
       mesh/model combination cannot support it) — the bench A/B path.
     - ``False``: force the monolithic step.
+
+    ``mesh_config`` (the :class:`mesh.MeshConfig` the mesh was built from,
+    when the caller has it) lets the bucketed step stage its collectives
+    per interconnect tier on multi-slice topologies — the ``Mesh`` object
+    itself does not record how its axes map onto ICI vs DCN.
 
     The returned step always carries ``.bucketed`` so callers (trainer
     flight attribution, bench) can see which structure compiled.
@@ -328,7 +389,8 @@ def make_train_step(
             return collectives.make_bucketed_train_step(
                 loss_fn, optimizer, mesh, param_shardings, state,
                 batch_example, sequence_axes=sequence_axes, donate=donate,
-                collection_shardings=collection_shardings)
+                collection_shardings=collection_shardings,
+                mesh_config=mesh_config)
         if bucketed:
             raise ValueError(f"bucketed train step unavailable: {reason}")
         logger.debug("monolithic train step (%s)", reason)

@@ -102,6 +102,48 @@ def signature(batch: Any, *, portable: bool = True) -> tuple:
 
 
 # ---------------------------------------------------------------------------
+# Sharded-update shape policy
+# ---------------------------------------------------------------------------
+
+
+def update_shard_eligible(shape: Sequence[int], itemsize: int, world: int,
+                          min_bytes: int) -> bool:
+    """Can a parameter of this shape take the reduce-scatter weight-update
+    path (``parallel/collectives.py``)?
+
+    Shape policy, not mechanism — which is why it lives here: the sharded
+    update stores a leaf's optimizer state as a dim-0 slice per replica
+    (``P((data_axes...), None, ...)``), and its gradient arrives as the
+    matching block of a flattened ``psum_scatter``.  The two coincide
+    without any resharding hop exactly when the leading dimension divides
+    the data-parallel world — row-major flat block *k* of a
+    ``(d0, ...)``-shaped leaf IS rows ``[k·d0/N, (k+1)·d0/N)`` iff
+    ``d0 % N == 0``.  Three conditions:
+
+    - ``shape`` is non-scalar and ``shape[0] % world == 0`` (the
+      block/slice coincidence above);
+    - ``world >= 2`` (a single replica has nothing to scatter);
+    - the leaf is at least ``min_bytes`` big — aligned with the ZeRO
+      threshold (``train.zero_min_bytes``), so leaves too small to be
+      worth sharding ride a replicated fast path instead of forcing a
+      degenerate one-leaf scatter bucket.
+
+    Every process evaluates this from static shapes only, so the whole
+    fleet derives the identical bucket schedule — the same determinism
+    contract as :func:`signature`.
+    """
+    if world < 2 or not shape:
+        return False
+    d0 = int(shape[0])
+    if d0 <= 0 or d0 % world != 0:
+        return False
+    size = 1
+    for d in shape:
+        size *= int(d)
+    return size * int(itemsize) >= int(min_bytes)
+
+
+# ---------------------------------------------------------------------------
 # Ladder resolution
 # ---------------------------------------------------------------------------
 

@@ -84,6 +84,10 @@ class Trainer:
             self.module_lib = model
             self.model_name = getattr(model, "__name__", None)
         self.config = config or self.module_lib.Config.tiny()
+        # kept beside the mesh: the Mesh object does not record which axes
+        # cross slices, and the bucketed step needs the MeshConfig to
+        # stage its collectives per interconnect tier (ICI vs DCN)
+        self.mesh_config = mesh_config
         self.mesh = build_mesh(mesh_config, devices=devices)
         self.model = self.module_lib.make_model(self.config, mesh=self.mesh)
         if optimizer is None:
@@ -187,7 +191,19 @@ class Trainer:
                 self.loss_fn, self.optimizer, self.mesh, self.param_shardings,
                 self.state, example, sequence_axes=self.sequence_axes,
                 collection_shardings=col_overrides or None,
+                mesh_config=self.mesh_config,
             )
+        # sharded-update step: the eagerly-initialized optimizer state
+        # inherited the PARAM layout, but the compiled step stores
+        # scatter-eligible moments as dim-0 shards over the data axes —
+        # reshard once here so every step (and the checkpoint template,
+        # which targets self.state) sees the expected storage layout
+        opt_sh = getattr(self.train_step, "opt_state_shardings", None)
+        if opt_sh is not None:
+            self.state = TrainState(
+                self.state.params,
+                jax.device_put(self.state.opt_state, opt_sh),
+                self.state.step, self.state.collections)
         self.eval_step = make_eval_step(
             self.forward_fn, self.mesh, self.param_shardings,
             example, sequence_axes=self.sequence_axes,
@@ -209,9 +225,10 @@ class Trainer:
         # verdict per training step
         self._flight = obs.flight.recorder("feed")
         # bucketed-collective comm model (parallel/collectives.py): the
-        # gradient bytes crossing replicas per step and the all-reduce
-        # world size, read by _allreduce_seconds() to attribute an
-        # `allreduce` flight stage against the delivered ICI bandwidth
+        # gradient bytes crossing replicas per step and the exchange world
+        # size, read by _comm_stage_seconds() to attribute the collective
+        # flight stages (`allreduce`, or `scatter`/`update`/`gather` under
+        # the sharded update) against the delivered roofline bandwidths
         self._comm_info = None
         if getattr(self.train_step, "bucketed", False):
             self._comm_info = (self.train_step.comm_bytes,
@@ -269,35 +286,72 @@ class Trainer:
         # sharing the name would bimodalize that histogram toward zero
         self._flight.add(shard=t1 - t0,
                          compute=time.perf_counter() - t1)
-        # bucketed step: the modelled gradient-exchange cost rides beside
-        # the dispatch wall as an overlapped (`_bg`) stage — on the async
+        # bucketed step: the modelled collective-stage costs ride beside
+        # the dispatch wall as overlapped (`_bg`) stages — on the async
         # path nothing blocks, so the comm is context, not critical path
-        comm_s = self._allreduce_seconds()
-        if comm_s:
-            self._flight.add(overlapped=True, allreduce=comm_s)
+        comm = self._comm_stage_seconds()
+        if comm:
+            self._flight.add(overlapped=True, **comm)
         return self._after_step(loss, batch)
 
-    def _allreduce_seconds(self) -> "float | None":
-        """Modelled serial cost of this step's gradient all-reduce: the
-        bucketed step's ``comm_bytes`` at the *delivered* interconnect
-        bandwidth the roofline probe measured (``roofline_ici_bw_gbps``
-        gauge).  ``None`` on the monolithic step or before/without a
-        probe — the attribution is only made against a measured number,
-        never a datasheet."""
-        if self._comm_info is None:
-            return None
+    def _peek_gauge(self, name: str) -> "float | None":
+        """Read a roofline gauge if a probe ever set it.  Peek, never
+        get-or-create: a trainer that merely ASKED must not mint a phantom
+        0.0 bandwidth series in processes that never ran the probe."""
         from tensorflowonspark_tpu import obs
+
+        gauge = obs.get_registry().peek(name)
+        bw = gauge.value if gauge is not None else None
+        return bw if bw and bw > 0 else None
+
+    def _comm_stage_seconds(self) -> "dict[str, float]":
+        """Modelled serial cost of this step's collective stages at the
+        *delivered* bandwidths the roofline probes measured — the
+        attribution is only made against measured numbers, never a
+        datasheet; empty on the monolithic step or before/without a probe.
+
+        All-reduce structure: one ``allreduce`` stage
+        (``comm_bytes`` ring cost at ``roofline_ici_bw_gbps``).  Sharded
+        update: the ``comm_model`` per-tier byte split priced per leg —
+        ``scatter`` (gradient reduce-scatter; ICI bytes at the ICI
+        roofline, DCN bytes at ``roofline_dcn_bw_gbps`` when probed, else
+        the ICI figure as an optimistic floor), ``gather`` (the parameter
+        all-gather, same pricing), and ``update`` (the 1/N optimizer
+        update modelled as memory-bound: ~7 passes over the local
+        param/grad/moment shards at ``roofline_mem_bw_gbps`` — AdamW
+        reads p/g/mu/nu and writes p/mu/nu)."""
+        if self._comm_info is None:
+            return {}
         from tensorflowonspark_tpu.parallel import collectives
 
-        # peek, never get-or-create: a trainer that merely ASKED must not
-        # mint a phantom 0.0 bandwidth series in processes that never
-        # ran the probe
-        gauge = obs.get_registry().peek("roofline_ici_bw_gbps")
-        bw = gauge.value if gauge is not None else None
-        if not bw or bw <= 0:
-            return None
-        return collectives.ideal_serial_allreduce_seconds(
-            self._comm_info[0], self._comm_info[1], bw)
+        step = self.train_step
+        ici_bw = self._peek_gauge("roofline_ici_bw_gbps")
+        if not getattr(step, "update_sharded", False):
+            s = collectives.ideal_serial_allreduce_seconds(
+                self._comm_info[0], self._comm_info[1], ici_bw)
+            return {"allreduce": s} if s else {}
+        model = getattr(step, "comm_model", None)
+        if not model or not ici_bw:
+            return {}
+        dcn_bw = self._peek_gauge("roofline_dcn_bw_gbps") or ici_bw
+        sc = model["scatter"]
+        out: "dict[str, float]" = {}
+        scatter_s = (sc["exchange_ici"] / (ici_bw * 1e9)
+                     + sc["exchange_dcn"] / (dcn_bw * 1e9))
+        gather_s = (sc["gather_ici"] / (ici_bw * 1e9)
+                    + sc["gather_dcn"] / (dcn_bw * 1e9))
+        if scatter_s > 0:
+            out["scatter"] = scatter_s
+        if gather_s > 0:
+            out["gather"] = gather_s
+        mem_bw = self._peek_gauge("roofline_mem_bw_gbps")
+        if mem_bw:
+            local_bytes = (model["scatter_bytes"] / max(model["world"], 1)
+                           + model["replicated_bytes"])
+            update_s = 7.0 * local_bytes / (mem_bw * 1e9)
+            if update_s > 0:
+                out["update"] = update_s
+        return out
 
     def _step_annotation(self):
         """Optional ``jax.profiler.StepTraceAnnotation`` around the jitted
@@ -425,10 +479,10 @@ class Trainer:
             # step-collectives A/B, which times the no-reduce twin.
             compute_s = time.perf_counter() - t1
             self._flight.add(shard=t1 - t0, compute=compute_s)
-            comm_s = self._allreduce_seconds()
-            if comm_s:
-                self._flight.add(overlapped=True,
-                                 allreduce=min(comm_s, compute_s))
+            comm = self._comm_stage_seconds()
+            if comm:
+                self._flight.add(overlapped=True, **{
+                    k: min(v, compute_s) for k, v in comm.items()})
         finally:
             # disarm on ANY exit: an exception a caller handles must not
             # leave a stale armed timestamp that later reads as a stall

@@ -389,6 +389,60 @@ class TestOutageProofing(unittest.TestCase):
         self.assertIsNone(result["step_rows_per_sec"])
         self.assertIn("wall budget", result["step_reason"])
 
+    def test_collectives_microbench_on_virtual_mesh(self):
+        # ISSUE 17: reduce-scatter + sharded-update vs bucketed
+        # all-reduce on the 8-device virtual CPU mesh — equality is
+        # judged BEFORE throughput, the analytic exchange ratio beats
+        # the all-reduce baseline, and the stamped half gate-validates
+        # under the r19 requirement.
+        sys.path.insert(0, os.path.dirname(BENCH))
+        import bench
+
+        out = bench.measure_collectives(
+            steps=4, batch_per_device=32, hidden=64, depth=4)
+        self.assertEqual(out["collectives_equality"], "pass")
+        self.assertGreater(out["collectives_rows_per_sec"], 0.0)
+        self.assertGreater(out["collectives_rows_per_sec_allreduce"], 0.0)
+        self.assertEqual(out["collectives_devices"], 8)
+        # the headline analytic claim: scattered exchange moves fewer
+        # bytes than the all-reduce pass over the same gradient tree
+        self.assertLess(out["collectives_bytes_ratio"], 1.0)
+        self.assertGreater(out["collectives_bytes_ratio"], 0.0)
+        self.assertGreaterEqual(out["collectives_scatter_leaves"], 1)
+        self.assertGreaterEqual(out["collectives_n_scatter_buckets"], 1)
+        sys.path.insert(0, os.path.join(os.path.dirname(BENCH), "tools"))
+        import bench_gate
+
+        half = {"metric": "m", "value": 1.0, "unit": "u",
+                "vs_baseline": 1.0, **out}
+        self.assertEqual(
+            bench_gate.validate_half(half, require_roofline=False,
+                                     require_collectives=True), [])
+
+    def test_collectives_single_device_stamps_analytic_ratio(self):
+        # the headline box: ONE device — the bytes model is still
+        # numeric (evaluated at model_world=8), but equality and
+        # throughput must be explicit null + reason, not fabricated
+        result, proc, _ = _run_bench(
+            ["--collectives"],
+            {"TFOS_HOST_DEVICE_COUNT": "1", "XLA_FLAGS": ""}, timeout=300)
+        self.assertEqual(proc.returncode, 0, proc.stderr[-2000:])
+        self.assertIsInstance(result["collectives_bytes_ratio"], float)
+        self.assertLess(result["collectives_bytes_ratio"], 1.0)
+        self.assertIsNone(result["collectives_rows_per_sec"])
+        self.assertIsNone(result["collectives_equality"])
+        self.assertIn("single device", result["collectives_reason"])
+        self.assertEqual(result["metric"], "collectives_bytes_ratio")
+
+    def test_collectives_stamp_is_total_on_exhausted_budget(self):
+        sys.path.insert(0, os.path.dirname(BENCH))
+        import bench
+
+        result = {}
+        bench._stamp_collectives(result, bench._Deadline(0.0))
+        self.assertIsNone(result["collectives_bytes_ratio"])
+        self.assertIn("wall budget", result["collectives_reason"])
+
     @pytest.mark.slow  # spawns 3 cold-start subprocesses
     def test_compile_cache_microbench_small_config(self):
         # ISSUE 13: second-process cold start through the REAL tenant

@@ -1681,3 +1681,140 @@ def test_incident_chaos_proof_gated(tmp_path):
     verdict = bench_gate.gate([_write(tmp_path, "BENCH_r18.json", half)])
     assert verdict["verdict"] == "fail"
     assert any("incident_linked_traces" in r for r in verdict["reasons"])
+
+
+# -- sharded-update collectives comparison (ISSUE 17) ------------------------
+
+
+def _collectives_fields(ratio=0.504, **extra):
+    fields = {"collectives_bytes_ratio": ratio,
+              "collectives_equality": "pass",
+              "collectives_rows_per_sec": 41000.0,
+              "collectives_rows_per_sec_allreduce": 39000.0,
+              "collectives_platform": "cpu", "collectives_devices": 8,
+              "collectives_dcn_world": 1,
+              "collectives_model": "mlp_h128x6",
+              "collectives_grad_mb": 0.3799,
+              "collectives_bucket_mb": 0.095,
+              "collectives_update_shard": True}
+    fields.update(extra)
+    return fields
+
+
+def _r19(**extra):
+    """A round-19-complete primary half: r18 + the sharded-update
+    collectives comparison."""
+    half = _r18(**_collectives_fields())
+    half.update(extra)
+    return half
+
+
+def test_collectives_field_required_on_primary_from_round_19(tmp_path):
+    # round 18: grandfathered — no collectives comparison owed
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r18.json", _r18())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # round 19+: the primary must carry it (or explicit null + reason)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r19.json", _r18())])
+    assert verdict["verdict"] == "fail"
+    assert any("collectives_bytes_ratio" in r for r in verdict["reasons"])
+    # complete round 19 passes
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r19.json", _r19())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # explicit null + reason satisfies (e.g. wall budget exhausted)
+    half = _r18(collectives_bytes_ratio=None,
+                collectives_reason="wall budget exhausted before "
+                                   "collectives microbench")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r19.json", half)])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # bare null does not
+    half = _r18(collectives_bytes_ratio=None)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r19.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("collectives_reason" in r for r in verdict["reasons"])
+
+
+def test_collectives_single_device_shape_passes(tmp_path):
+    # the 1-device headline box: analytic ratio numeric, equality and
+    # throughput null with the shared reason — a complete, honest half
+    half = _r19(collectives_equality=None,
+                collectives_rows_per_sec=None,
+                collectives_rows_per_sec_allreduce=None,
+                collectives_reason="single device: wall-clock deferred "
+                                   "to hardware")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r19.json", half)])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # but a numeric ratio with a bare null equality (no reason) fails —
+    # the half must say why the A/B could not run
+    half = _r19(collectives_equality=None, collectives_rows_per_sec=None,
+                collectives_rows_per_sec_allreduce=None)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r19.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("collectives_equality" in r for r in verdict["reasons"])
+
+
+def test_collectives_equality_fail_fails_artifact(tmp_path):
+    """A diverged sharded-update step is broken, not fast — it fails the
+    artifact even though it also stamps a legitimate-looking null
+    throughput + reason."""
+    half = _r19(collectives_equality="fail",
+                collectives_rows_per_sec=None,
+                collectives_rows_per_sec_allreduce=None,
+                collectives_reason="sharded-update step diverged from "
+                                   "the bucketed all-reduce step")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r19.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("broken, not fast" in r for r in verdict["reasons"])
+
+
+def test_collectives_ratio_bound_and_string_rejection(tmp_path):
+    """A ratio at or above 1 means the restructured exchange moves no
+    fewer bytes — not an optimization; a string value must not slide
+    past the whole r19 block."""
+    verdict = bench_gate.gate([_write(
+        tmp_path, "BENCH_r19.json",
+        _r19(**_collectives_fields(ratio=1.2)))])
+    assert verdict["verdict"] == "fail"
+    assert any("not strictly inside (0, 1)" in r
+               for r in verdict["reasons"])
+    half = _r19(collectives_bytes_ratio="0.5")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r19.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("must be numeric or an explicit null" in r
+               for r in verdict["reasons"])
+
+
+def test_collectives_value_without_config_identity_fails(tmp_path):
+    half = _r19()
+    del half["collectives_devices"]
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r19.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("config identity" in r and "collectives_devices" in r
+               for r in verdict["reasons"])
+
+
+def test_collectives_throughput_needs_its_ab_partner(tmp_path):
+    half = _r19()
+    del half["collectives_rows_per_sec_allreduce"]
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r19.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("collectives_rows_per_sec_allreduce" in r
+               for r in verdict["reasons"])
+
+
+def test_collectives_ratio_regression_within_identity_only(tmp_path):
+    # same config, worse (higher) ratio beyond 1/threshold: fail
+    paths = [
+        _write(tmp_path, "BENCH_r19.json", _r19()),
+        _write(tmp_path, "BENCH_r20.json",
+               _r19(**_collectives_fields(ratio=0.71)))]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("moves more bytes" in r for r in verdict["reasons"])
+    # a different device count is a different experiment: no comparison
+    paths = [
+        _write(tmp_path, "BENCH_r19.json", _r19()),
+        _write(tmp_path, "BENCH_r20.json",
+               _r19(**_collectives_fields(ratio=0.71,
+                                          collectives_devices=16)))]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass", verdict["reasons"]

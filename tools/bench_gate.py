@@ -131,6 +131,25 @@ event, the generation-fenced regroup, and ≥ 1 exemplar-linked
 recovered trace — reconstructed by ``tools/incident.py``), a numeric
 ``incident_death_latency_s``, and ``incident_linked_traces`` ≥ 1.
 
+From round ``--require-collectives-from`` (default 19, the round that
+introduced the reduce-scatter bucketed exchange with sharded optimizer
+updates) the primary half must carry ``collectives_bytes_ratio`` — the
+analytic gradient-EXCHANGE bytes of the scatter path over the all-reduce
+path for the toy model's parameter tree — or an explicit ``null`` +
+``collectives_reason``.  A numeric ratio must be strictly inside (0, 1):
+a scattered exchange that moves as many bytes as the all-reduce it
+replaced is not an optimization, and the ratio is the claim the gate
+ratchets (LOWER is better) within one config identity (platform, device
+count, DCN world, model geometry, gradient/bucket sizing, update-shard
+mode).  ``collectives_equality`` of ``"fail"`` FAILS the artifact
+outright — a sharded-update step whose losses diverged from the
+all-reduce step's is broken, not fast — and a numeric
+``collectives_rows_per_sec`` requires both a PASSING equality check and
+its ``collectives_rows_per_sec_allreduce`` A/B partner from the same
+run; on a single-device box equality and throughput are an explicit
+``null`` + ``collectives_reason`` while the analytic ratio stays
+numeric.
+
 Usage::
 
     python tools/bench_gate.py                  # repo-root BENCH_r*.json
@@ -199,6 +218,10 @@ DEFAULT_REQUIRE_FLEET_FROM = 17
 #: microbench (``incident_overhead_frac``, introduced with the
 #: causally-ordered event journal + black-box dumps + tail forensics)
 DEFAULT_REQUIRE_INCIDENT_FROM = 18
+#: first round whose primary half must carry the sharded-weight-update
+#: collectives comparison (``collectives_bytes_ratio``, introduced with
+#: the reduce-scatter bucketed exchange + sharded optimizer updates)
+DEFAULT_REQUIRE_COLLECTIVES_FROM = 19
 #: |stage_sum / wall - 1| beyond this fails the artifact: a breakdown that
 #: does not add up is decoration, not attribution
 DEFAULT_FLIGHT_TOLERANCE = 0.15
@@ -284,6 +307,16 @@ _INCIDENT_KEY = "incident_overhead_frac"
 #: volume and host CPU count
 _INCIDENT_IDENT_KEYS = ("incident_replicas", "incident_clients",
                         "incident_rows_total", "incident_host_cpus")
+_COLLECTIVES_KEY = "collectives_bytes_ratio"
+#: the collectives comparison's config identity: the analytic exchange
+#: ratio is a function of the parameter tree, the scatter world (device
+#: count — the model evaluates at max(devices, 8)), the DCN tier split,
+#: the eligibility/bucket sizing, and whether the sharded update is even
+#: on — a ratio computed under any other config is a different experiment
+_COLLECTIVES_IDENT_KEYS = ("collectives_platform", "collectives_devices",
+                           "collectives_dcn_world", "collectives_model",
+                           "collectives_grad_mb", "collectives_bucket_mb",
+                           "collectives_update_shard")
 #: decode latency p99s regression-gated LOWER-is-better beside the
 #: throughput (a scheduler change that buys tokens/sec by doubling the
 #: tail is a regression, not a win)
@@ -406,7 +439,8 @@ def validate_half(half: dict[str, Any], *,
                   require_coldstart: bool = False,
                   require_decode: bool = False,
                   require_fleet: bool = False,
-                  require_incident: bool = False) -> list[str]:
+                  require_incident: bool = False,
+                  require_collectives: bool = False) -> list[str]:
     """Schema problems of one measured result (a wrapper's half)."""
     problems = []
     for key in _REQUIRED_HALF_KEYS:
@@ -827,6 +861,78 @@ def validate_half(half: dict[str, Any], *,
             problems.append(
                 f"{_INCIDENT_KEY!r} must be numeric or an explicit null "
                 f"(got {half[_INCIDENT_KEY]!r})")
+    # sharded-weight-update collectives comparison: the analytic bytes
+    # ratio needs no second device, so a degraded-accelerator round
+    # still owes it; null + 'collectives_reason' satisfies only for a
+    # box where even the model could not run.  A diverged equality check
+    # fails the artifact whether or not throughput was stamped
+    if require_collectives or _COLLECTIVES_KEY in half:
+        if half.get("collectives_equality") == "fail":
+            # judged FIRST: a diverged sharded-update step also stamps
+            # null throughput + reason, and that legitimate-looking null
+            # must not launder a broken step into a passing artifact
+            problems.append(
+                "collectives_equality is 'fail': the sharded-update step "
+                "produced different losses than the all-reduce step — "
+                "broken, not fast; the artifact fails")
+        if _COLLECTIVES_KEY not in half:
+            problems.append(
+                f"missing {_COLLECTIVES_KEY!r} (sharded-update "
+                "collectives comparison is part of the schema from r19: "
+                "measure it or stamp an explicit null + "
+                "'collectives_reason')")
+        elif half[_COLLECTIVES_KEY] is None \
+                and "collectives_reason" not in half:
+            problems.append(
+                f"{_COLLECTIVES_KEY!r} is null without a "
+                "'collectives_reason'")
+        elif isinstance(half.get(_COLLECTIVES_KEY), (int, float)):
+            if not 0.0 < half[_COLLECTIVES_KEY] < 1.0:
+                problems.append(
+                    f"{_COLLECTIVES_KEY!r} {half[_COLLECTIVES_KEY]} is "
+                    "not strictly inside (0, 1) — a scattered exchange "
+                    "that moves as many bytes as the all-reduce it "
+                    "replaced is not an optimization")
+            missing = [k for k in _COLLECTIVES_IDENT_KEYS if k not in half]
+            if missing:
+                problems.append(
+                    f"{_COLLECTIVES_KEY!r} without its config identity "
+                    f"({', '.join(missing)}) — the exchange ratio is "
+                    "only comparable within one platform/device-count/"
+                    "DCN-world/model/sizing/update-shard config")
+            eq = half.get("collectives_equality")
+            if eq is None:
+                if "collectives_reason" not in half:
+                    problems.append(
+                        "'collectives_equality' is null without a "
+                        "'collectives_reason' — either the two steps "
+                        "ran A/B or the half says why they could not")
+            elif eq != "pass":
+                problems.append(
+                    f"collectives_equality is {eq!r}: a sharded-update "
+                    "step whose losses were not verified equal to the "
+                    "all-reduce step's is broken, not fast")
+            if isinstance(half.get("collectives_rows_per_sec"),
+                          (int, float)):
+                if eq != "pass":
+                    problems.append(
+                        "'collectives_rows_per_sec' stamped without a "
+                        "passing 'collectives_equality' — throughput of "
+                        "an unverified step is not a measurement")
+                if not isinstance(
+                        half.get("collectives_rows_per_sec_allreduce"),
+                        (int, float)):
+                    problems.append(
+                        "'collectives_rows_per_sec' without a numeric "
+                        "'collectives_rows_per_sec_allreduce' — the "
+                        "sharded number is only meaningful against the "
+                        "all-reduce step A/B'd in the same run")
+        elif half[_COLLECTIVES_KEY] is not None:
+            # neither null nor numeric: keep the forged-value door shut
+            # like the fleet/incident blocks above
+            problems.append(
+                f"{_COLLECTIVES_KEY!r} must be numeric or an explicit "
+                f"null (got {half[_COLLECTIVES_KEY]!r})")
     # request-tracing overhead: A/B-measured on the online path, so a
     # degraded-accelerator round still owes it; null + reason always
     # satisfies (e.g. TFOS_TRACE_REQUESTS=0 runs have no A to B against)
@@ -969,6 +1075,18 @@ def _comparable_prior_recovery(artifacts: list[dict], newest: dict,
                                       better=min)
 
 
+def _comparable_prior_collectives(artifacts: list[dict], newest: dict,
+                                  half: dict) -> tuple[float, str] | None:
+    """Best (i.e. LOWEST — the exchange ratio is bytes moved over bytes
+    the all-reduce would move) prior ``collectives_bytes_ratio`` under
+    the same platform/device/DCN/model/sizing/update-shard config.  The
+    model is host-side arithmetic: degraded-accelerator priors still
+    count."""
+    return _comparable_prior_hostside(artifacts, newest, half,
+                                      _COLLECTIVES_KEY,
+                                      _COLLECTIVES_IDENT_KEYS, better=min)
+
+
 def _comparable_prior_hostside(artifacts: list[dict], newest: dict,
                                half: dict, key: str,
                                ident_keys: tuple[str, ...],
@@ -1009,7 +1127,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
          require_coldstart_from: int = DEFAULT_REQUIRE_COLDSTART_FROM,
          require_decode_from: int = DEFAULT_REQUIRE_DECODE_FROM,
          require_fleet_from: int = DEFAULT_REQUIRE_FLEET_FROM,
-         require_incident_from: int = DEFAULT_REQUIRE_INCIDENT_FROM
+         require_incident_from: int = DEFAULT_REQUIRE_INCIDENT_FROM,
+         require_collectives_from: int = DEFAULT_REQUIRE_COLLECTIVES_FROM
          ) -> dict[str, Any]:
     """Run the gate over a trajectory; returns the verdict document."""
     checks: list[dict[str, Any]] = []
@@ -1067,6 +1186,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                           and art["n"] >= require_fleet_from)
             require_in = (label == "primary"
                           and art["n"] >= require_incident_from)
+            require_co = (label == "primary"
+                          and art["n"] >= require_collectives_from)
             for problem in validate_half(half, require_roofline=require_rf,
                                          require_feed=require_fd,
                                          require_serving=require_sv,
@@ -1078,7 +1199,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                                          require_coldstart=require_cs,
                                          require_decode=require_dc,
                                          require_fleet=require_fo,
-                                         require_incident=require_in):
+                                         require_incident=require_in,
+                                         require_collectives=require_co):
                 check(f"schema:{name}:{label}",
                       "fail" if is_newest else "warn", problem)
             # flight breakdowns ride the primary half with the microbench
@@ -1202,6 +1324,33 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                           f"{stval} is {round(stval / stprior[0], 4)}× "
                           f"best prior {stprior[0]} ({stprior[1]}) — the "
                           f"step path regressed below {threshold}")
+            # sharded-update collectives ratio: host-side arithmetic,
+            # judged before the degraded skip; LOWER is better (it is
+            # bytes moved over the all-reduce's bytes) within one
+            # platform/device/DCN/model/sizing/update-shard identity
+            if isinstance(half.get(_COLLECTIVES_KEY), (int, float)):
+                coprior = _comparable_prior_collectives(artifacts, newest,
+                                                        half)
+                coname = f"regression:{_COLLECTIVES_KEY}"
+                coval = float(half[_COLLECTIVES_KEY])
+                if coprior is None:
+                    check(coname, "pass",
+                          "no comparable prior collectives measurement "
+                          "(same platform/device/DCN/model/sizing/"
+                          "update-shard config) — nothing to regress "
+                          "against")
+                elif coval * threshold <= coprior[0]:
+                    check(coname, "pass",
+                          f"{coval} vs best prior {coprior[0]} "
+                          f"({coprior[1]}): ratio "
+                          f"{round(coval / coprior[0], 4)} ≤ "
+                          f"{round(1 / threshold, 4)}")
+                else:
+                    check(coname, "fail",
+                          f"{coval} is {round(coval / coprior[0], 4)}× "
+                          f"the best prior {coprior[0]} ({coprior[1]}) — "
+                          "the gradient exchange moves more bytes than "
+                          f"it used to beyond 1/{threshold}")
             # generative-decode A/B: host-side, judged before the
             # degraded skip like the others — throughput higher-better,
             # the two latency p99s LOWER-better within the same identity
@@ -1393,6 +1542,8 @@ def main(argv: list[str] | None = None) -> int:
                    default=DEFAULT_REQUIRE_FLEET_FROM)
     p.add_argument("--require-incident-from", type=int,
                    default=DEFAULT_REQUIRE_INCIDENT_FROM)
+    p.add_argument("--require-collectives-from", type=int,
+                   default=DEFAULT_REQUIRE_COLLECTIVES_FROM)
     args = p.parse_args(argv)
     paths = args.paths or discover(args.repo)
     if not paths:
@@ -1414,7 +1565,8 @@ def main(argv: list[str] | None = None) -> int:
                require_coldstart_from=args.require_coldstart_from,
                require_decode_from=args.require_decode_from,
                require_fleet_from=args.require_fleet_from,
-               require_incident_from=args.require_incident_from)
+               require_incident_from=args.require_incident_from,
+               require_collectives_from=args.require_collectives_from)
     print(json.dumps(doc))
     return 1 if doc["verdict"] == "fail" else 0
 
