@@ -155,10 +155,13 @@ class _DecodeRequest:
     __slots__ = ("prompt", "prompt_len", "max_new_tokens", "nbytes",
                  "queue", "cancelled", "generated", "t_submit",
                  "t_submit_wall", "t_admit", "t_last", "ttft_s",
-                 "max_itl_s", "error", "rt", "slot", "pages", "done")
+                 "max_itl_s", "error", "rt", "slot", "pages", "done",
+                 "tenant")
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
-                 rt: "_trace.RequestTrace | None"):
+                 rt: "_trace.RequestTrace | None",
+                 tenant: str = "default"):
+        self.tenant = tenant
         self.prompt = prompt
         self.prompt_len = int(prompt.shape[0])
         self.max_new_tokens = int(max_new_tokens)
@@ -198,6 +201,7 @@ class DecodeStream:
         self._req.cancelled = True
         _journal.emit("decode.cancel", slot=self._req.slot,
                       generated=self._req.generated,
+                      tenant=self._req.tenant,
                       **({"trace_id": self.trace_id}
                          if self.trace_id else {}))
 
@@ -429,6 +433,15 @@ class DecodeEngine:
         obs.gauge("decode_kv_pool_bytes",
                   "bytes of the pre-sized device KV pools (fixed at "
                   "engine init)").set(self.kv_pool_bytes)
+        #: device bytes per KV page (both pools) — the occupancy →
+        #: bytes-resident conversion the placement-by-KV-bytes signal
+        #: (ROADMAP item 2) and the cost view read
+        self._page_bytes = self.kv_pool_bytes // max(1, self.num_pages)
+        self._kv_bytes_g = obs.gauge(
+            "decode_kv_bytes_resident",
+            "device bytes of KV cache resident in allocated pages "
+            "(pages used x per-page bytes)")
+        self._kv_bytes_g.set(0)
 
     # -- shape policy --------------------------------------------------------
 
@@ -516,13 +529,14 @@ class DecodeEngine:
         self._pending_g.set(0)
         self._active_g.set(0)
         self._pages_used_g.set(self.pool.used_pages)
+        self._kv_bytes_g.set(self.pool.used_pages * self._page_bytes)
 
     # -- request path --------------------------------------------------------
 
     def submit(self, prompt: Sequence[int] | np.ndarray,
                max_new_tokens: int = 16,
-               trace_ctx: "_trace.TraceContext | None" = None
-               ) -> DecodeStream:
+               trace_ctx: "_trace.TraceContext | None" = None,
+               tenant: str = "default") -> DecodeStream:
         """Queue one generation; returns a :class:`DecodeStream` whose
         tokens arrive as the engine produces them.
 
@@ -531,6 +545,12 @@ class DecodeEngine:
         :class:`~tensorflowonspark_tpu.online.Rejected` when admission
         control sheds (pending queue over its request or byte bound) —
         shedding is loud by design, callers back off and retry.
+
+        ``tenant`` names the cost-accounting payer: the engine's step
+        wall apportions to it by tokens emitted
+        (:mod:`tensorflowonspark_tpu.obs.ledger`), and the slot
+        lifecycle journal events carry it so incident triage can name
+        the tenant, not just the slot.
         """
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         plen = int(prompt.shape[0])
@@ -570,7 +590,8 @@ class DecodeEngine:
                 rt = _trace.RequestTrace(
                     "decode.request", ctx=trace_ctx,
                     prompt_len=plen, max_new_tokens=max_new_tokens)
-        req = _DecodeRequest(prompt, max_new_tokens, rt)
+        req = _DecodeRequest(prompt, max_new_tokens, rt,
+                             tenant=str(tenant))
         with self._cond:
             if not self._started or self._stopped:
                 raise RuntimeError("DecodeEngine is not serving "
@@ -659,6 +680,7 @@ class DecodeEngine:
                 rec.commit()
             self._active_g.set(self._active)
             self._pages_used_g.set(self.pool.used_pages)
+            self._kv_bytes_g.set(self.pool.used_pages * self._page_bytes)
 
     def _pages_needed(self, req: _DecodeRequest) -> int:
         return -(-(req.prompt_len + req.max_new_tokens) // self.page_size)
@@ -724,6 +746,13 @@ class DecodeEngine:
         dt = perf() - t0
         if fresh:
             serving.observe_compile_seconds(dt)
+        # prefill wall is this request's alone (one sequence at a time);
+        # a fresh-signature prefill's compile rides the same tenant
+        from tensorflowonspark_tpu.obs import ledger as _ledger_mod
+
+        _ledger_mod.get_ledger().charge_decode(
+            [(req.tenant, 1)], dt,
+            compile_s=dt if fresh else 0.0, nbytes=req.nbytes)
         if req.rt is not None:
             req.rt.add("queue", req.t_admit - req.t_submit,
                        pending_depth=len(self._pending))
@@ -735,7 +764,7 @@ class DecodeEngine:
         self._tokens[slot] = tok
         _journal.emit(
             "decode.admit", slot=slot, pages=len(pages),
-            prompt_len=req.prompt_len,
+            prompt_len=req.prompt_len, tenant=req.tenant,
             queue_s=round(req.t_admit - req.t_submit, 6),
             **({"trace_id": req.rt.ctx.trace_id} if req.rt else {}))
         self._emit(req, tok)
@@ -758,6 +787,15 @@ class DecodeEngine:
         dt = perf() - t0
         if fresh:
             serving.observe_compile_seconds(dt)
+        # step wall splits across the live slots by tokens emitted (one
+        # each this step); the compile wall books to the first live
+        # slot's tenant — the request whose step met the fresh signature
+        from tensorflowonspark_tpu.obs import ledger as _ledger_mod
+
+        shares = [(req.tenant, 1) for req in self._slots
+                  if req is not None]
+        _ledger_mod.get_ledger().charge_decode(
+            shares, dt, compile_s=dt if fresh else 0.0)
         for s in range(self.max_seqs):
             req = self._slots[s]
             if req is None:
@@ -817,10 +855,11 @@ class DecodeEngine:
             self.pool.free(req.pages)
             req.pages = []
         self._pages_used_g.set(self.pool.used_pages)
+        self._kv_bytes_g.set(self.pool.used_pages * self._page_bytes)
         self._active_g.set(self._active)
         _journal.emit(
             "decode.retire", slot=slot, status=status,
-            tokens=req.generated,
+            tokens=req.generated, tenant=req.tenant,
             **({"trace_id": req.rt.ctx.trace_id} if req.rt else {}))
         self._finish(req, status, err)
 
@@ -936,6 +975,18 @@ class DecodeEngine:
                                if self.max_pending_bytes else 0.0),
                 "shed_window": window,
                 "slo": slo,
+                # paged KV-pool occupancy: the placement-by-KV-bytes
+                # signal (ROADMAP item 2) and a cost-view input — in
+                # the ADMISSION block because a router placing by KV
+                # residency reads it where it reads saturation
+                "kv": {
+                    "pages_used": used,
+                    "pages_total": total,
+                    "occupancy": (round(used / total, 4)
+                                  if total else 0.0),
+                    "bytes_resident": used * self._page_bytes,
+                    "pool_bytes": self.kv_pool_bytes,
+                },
             },
             "requests_total": int(self._requests_total.value),
             "tokens_total": int(self._tokens_total.value),

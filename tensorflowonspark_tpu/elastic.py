@@ -211,6 +211,7 @@ class ElasticWorker:
         from tensorflowonspark_tpu import util
         from tensorflowonspark_tpu.parallel import distributed
 
+        t0_rejoin = time.perf_counter()
         with obs.span("elastic.rejoin", gen=gen, node=self.node):
             # collectives of the old world first: a live distributed
             # runtime pinned to dead peers would wedge the first psum
@@ -242,6 +243,10 @@ class ElasticWorker:
         # regroup bumps the server past its generation (every read would
         # be rejected as stale), and reads are harmless from any epoch.
         obs.counter("elastic_rejoins_total").inc()
+        # the rejoin barrier window is training wall nobody computes in:
+        # the goodput breakdown books it as recovery, not stall
+        obs.ledger.goodput().note_recovery(
+            time.perf_counter() - t0_rejoin)
         obs.event("elastic.rejoined", gen=gen, node=self.node,
                   peers=len(info))
         self.ctx.cluster_info = info

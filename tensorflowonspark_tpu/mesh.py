@@ -647,8 +647,9 @@ class MeshRouter:
         a black-holed replica costs only this thread's budget; findings
         are judged from the refreshed rings and NEW ones emit structured
         trace events (``fleet.load_skew`` / ``fleet.capacity`` /
-        ``fleet.compile_cache`` / ``slo.burn``) exactly once per
-        episode — a finding re-fires only after it cleared."""
+        ``fleet.compile_cache`` / ``slo.burn`` / ``fleet.cost_skew``)
+        exactly once per episode — a finding re-fires only after it
+        cleared."""
         self.fleet.scrape([(r.id, r.host, r.port) for r in replicas])
         findings = self.check_fleet()
         fired: set[tuple] = set()
@@ -683,6 +684,21 @@ class MeshRouter:
                 self.request_blackbox(
                     f"slo.burn {f.get('objective')} "
                     f"tenant={f.get('tenant')}")
+        for f in findings.get("cost_skew") or ():
+            key = ("fleet.cost_skew", f.get("tenant"))
+            fired.add(key)
+            if key not in self._fleet_fired:
+                obs.event("fleet.cost_skew", **{
+                    k: v for k, v in f.items()
+                    if k != "finding" and isinstance(
+                        v, (str, int, float, bool))})
+                _journal.emit(
+                    "cost.skew", tenant=f.get("tenant"),
+                    share=f.get("share"),
+                    device_seconds=f.get("device_seconds"),
+                    fleet_device_seconds=f.get("fleet_device_seconds"),
+                    burning_tenants=f.get("burning_tenants") or [],
+                    objective=f.get("objective"))
         for key in self._fleet_fired - fired:
             # episodic clear: the objective burned last tick and no
             # longer does — the journal's fire/clear pair brackets the
@@ -690,6 +706,8 @@ class MeshRouter:
             if key[0] == "slo.burn":
                 _journal.emit("slo.clear", objective=key[1],
                               tenant=key[2])
+            elif key[0] == "fleet.cost_skew":
+                _journal.emit("cost.skew_clear", tenant=key[1])
         self._fleet_fired = fired
 
     def request_blackbox(self, reason: str) -> int:
@@ -751,7 +769,31 @@ class MeshRouter:
             self.fleet, self.slo_objectives(),
             fresh_within_s=max(self.health_stale_s,
                                2.5 * self.poll_interval))
+        out["cost_skew"] = _fleet.check_costs(
+            self.fleet, burns=out["slo_burn"],
+            window_s=self.fleet_window_s,
+            fresh_within_s=max(self.health_stale_s,
+                               2.5 * self.poll_interval))
         return out
+
+    def fleet_costs(self) -> dict[str, Any]:
+        """The ``GET /fleet/costs`` body: the windowed per-tenant
+        chargeback rollup (:func:`tensorflowonspark_tpu.obs.fleet.cost_summary`
+        over the federated ``ledger_*`` families) plus the current
+        ``fleet.cost_skew`` findings — the document
+        ``tools/costs.py`` merges with the journal into a chargeback
+        report."""
+        fresh = max(self.health_stale_s, 2.5 * self.poll_interval)
+        burns = _fleet.evaluate_slo(
+            self.fleet, self.slo_objectives(), fresh_within_s=fresh)
+        return {
+            "window_s": self.fleet_window_s,
+            "costs": _fleet.cost_summary(
+                self.fleet, self.fleet_window_s, fresh_within_s=fresh),
+            "findings": _fleet.check_costs(
+                self.fleet, burns=burns, window_s=self.fleet_window_s,
+                fresh_within_s=fresh),
+        }
 
     def fleet_summary(self) -> dict[str, Any]:
         """The ``GET /fleet`` body: per-replica windowed rates/latency +
@@ -1351,6 +1393,10 @@ class MeshHTTPServer:
       control-plane events merged into one causally-ordered timeline,
       paginated with ``?since=<cursor>&limit=N``
       (:meth:`MeshRouter.fleet_events`);
+    - ``GET /fleet/costs`` — the per-tenant chargeback document:
+      windowed device-seconds / rows / tokens / bytes / compile time
+      per tenant plus ``fleet.cost_skew`` findings
+      (:meth:`MeshRouter.fleet_costs`);
     - ``GET /debug/requests`` — router+replica span trees merged by
       trace id (slowest-first).
     """
@@ -1367,6 +1413,7 @@ class MeshHTTPServer:
                 "/fleet": self._fleet,
                 "/fleet/metrics": httpd.with_headers(self._fleet_metrics),
                 "/fleet/events": httpd.with_query(self._fleet_events),
+                "/fleet/costs": self._fleet_costs,
                 "/debug/requests": self._debug_requests,
             },
             post_routes={"/v1/predict": router.route_predict},
@@ -1397,6 +1444,10 @@ class MeshHTTPServer:
         return (200, httpd.OPENMETRICS_CONTENT_TYPE if om
                 else httpd.PROMETHEUS_CONTENT_TYPE,
                 self.router.fleet_metrics_text(openmetrics=om))
+
+    def _fleet_costs(self) -> tuple:
+        return (200, "application/json",
+                json.dumps(self.router.fleet_costs()))
 
     def _fleet_events(self, query: dict) -> tuple:
         try:

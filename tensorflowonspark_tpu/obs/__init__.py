@@ -64,6 +64,20 @@ And the fleet incident plane (ISSUE 16 tentpole):
   SIGTERM/anomaly; ``tools/incident.py`` merges it all into one
   Perfetto timeline.  ``TFOS_JOURNAL=0`` disables.
 
+And the cost accounting plane (ISSUE 18 tentpole):
+
+- **cost + goodput ledgers** (:mod:`.ledger`) — per-tenant device-second
+  / row / token / byte / compile-second apportionment across the online,
+  decode, and serve planes (labeled Prometheus families with an
+  un-apportioned engine denominator, so Σ tenants ≡ engine busy — the
+  conservation identity ``bench.py --costs`` proves), plus a training
+  goodput ledger folding flight stages, checkpoint saves, and elastic
+  recovery windows into a productive / input_wait / compile /
+  checkpoint / recovery / stall wall-clock breakdown; federated into
+  ``GET /fleet/costs`` and the ``fleet.cost_skew`` finding, merged into
+  chargeback reports by ``tools/costs.py``.  ``TFOS_LEDGER=0``
+  disables.
+
 Instrumented out of the box: cluster lifecycle (``TFCluster`` /
 ``TFSparkNode`` bootstrap, reserve, probe, shutdown), the trainer
 (``trainer.Trainer`` init + step counters, optional ``jax.profiler`` step
@@ -81,6 +95,7 @@ from tensorflowonspark_tpu.obs import (  # noqa: F401
     flight,
     httpd,
     journal,
+    ledger,
     roofline,
     trace,
 )

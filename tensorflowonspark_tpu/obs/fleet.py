@@ -44,6 +44,16 @@ exposition format the replicas already serve:
   aggregated, so a replica cold-starting without the persistent cache
   is visible).
 
+The same federation carries the per-tenant cost plane (ISSUE 18): the
+``ledger_*`` families (:mod:`tensorflowonspark_tpu.obs.ledger`) roll up
+into a windowed per-tenant chargeback document (:func:`cost_summary`,
+served as ``GET /fleet/costs``) and a ``fleet.cost_skew`` finding
+(:func:`check_costs`): a tenant holding more than
+``TFOS_FLEET_COST_SKEW_FRAC`` of the fleet's windowed device-seconds
+while another tenant's ``slo.burn`` fires — the throttling decision
+signal, since the dominant tenant is spending the hardware the burning
+tenant's SLO needs.
+
 Stale evidence never judges: a replica whose last successful scrape is
 older than the mesh's fail-open window (``TFOS_MESH_HEALTH_STALE_S``
 convention) is excluded from findings — the same discipline the
@@ -93,6 +103,13 @@ DEFAULT_COLD_WARM_RATIO = 0.5
 DEFAULT_COLD_MIN_UPTIME_S = 120.0
 #: counter whose windowed rate is the load-skew signal
 LOAD_COUNTER = "online_rows_total"
+#: fraction of fleet device-seconds one tenant must hold for
+#: ``fleet.cost_skew`` to consider it dominant
+#: (``TFOS_FLEET_COST_SKEW_FRAC`` overrides)
+DEFAULT_COST_SKEW_FRAC = 0.6
+#: minimum windowed fleet device-seconds before cost skew is judged —
+#: an idle fleet's rounding noise must not name a dominant tenant
+DEFAULT_COST_MIN_SECONDS = 0.05
 
 _NAME_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)")
 
@@ -1104,3 +1121,146 @@ def check_fleet(collector: FleetCollector, *,
     return {"load_skew": load_skew, "capacity": capacity,
             "compile_cache": compile_cache,
             "replicas_judged": fresh, "window_s": window_s}
+
+
+# ---------------------------------------------------------------------------
+# per-tenant cost federation (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+#: tenant-labeled cost counter family → the summary field it fills
+_COST_FIELDS = {
+    "ledger_device_seconds_total": "device_seconds",
+    "ledger_rows_total": "rows",
+    "ledger_tokens_total": "tokens",
+    "ledger_bytes_total": "bytes",
+    "ledger_compile_seconds_total": "compile_seconds",
+}
+
+
+def cost_skew_frac_default() -> float:
+    """``TFOS_FLEET_COST_SKEW_FRAC`` (a fraction in (0, 1]) or the
+    module default."""
+    raw = os.environ.get("TFOS_FLEET_COST_SKEW_FRAC", "").strip()
+    if raw:
+        try:
+            v = float(raw)
+            if 0 < v <= 1:
+                return v
+            logger.warning("TFOS_FLEET_COST_SKEW_FRAC=%r out of (0, 1]; "
+                           "using default %s", raw,
+                           DEFAULT_COST_SKEW_FRAC)
+        except ValueError:
+            logger.warning("TFOS_FLEET_COST_SKEW_FRAC=%r unparseable; "
+                           "using default %s", raw,
+                           DEFAULT_COST_SKEW_FRAC)
+    return DEFAULT_COST_SKEW_FRAC
+
+
+def cost_summary(collector: FleetCollector,
+                 window_s: float = DEFAULT_WINDOW_S,
+                 now: float | None = None,
+                 fresh_within_s: float | None = None) -> dict[str, Any]:
+    """Windowed per-tenant cost rollup over the federated ledgers.
+
+    Sums each replica's windowed deltas of the ``ledger_*`` families
+    (:mod:`tensorflowonspark_tpu.obs.ledger`) across the fleet: who
+    spent how many device-seconds / rows / tokens / bytes / compile
+    seconds in the last window, each tenant's ``share`` of the
+    apportioned total, plus the un-apportioned engine denominator per
+    plane and the pad-waste seconds per bucket choice.  Pure read of
+    the collector's rings — the ``GET /fleet/costs`` body's core.
+    """
+    fw = collector.fleet_window(window_s, now=now,
+                                fresh_within_s=fresh_within_s)
+    tenants: dict[str, dict[str, float]] = {}
+    engine: dict[str, float] = {}
+    pads: dict[str, float] = {}
+    for series, c in (fw.get("counters") or {}).items():
+        fam, labels = _registry.split_series(series)
+        field = _COST_FIELDS.get(fam)
+        if field is not None:
+            tenant = labels.get("tenant", "_unlabeled")
+            doc = tenants.setdefault(tenant, {})
+            doc[field] = doc.get(field, 0.0) + c["delta"]
+        elif fam == "ledger_engine_seconds_total":
+            plane = labels.get("plane", "_unlabeled")
+            engine[plane] = engine.get(plane, 0.0) + c["delta"]
+        elif fam == "ledger_pad_seconds_total":
+            bucket = labels.get("bucket", "_unlabeled")
+            pads[bucket] = pads.get(bucket, 0.0) + c["delta"]
+    total_device = sum(t.get("device_seconds", 0.0)
+                       for t in tenants.values())
+    out_tenants: dict[str, Any] = {}
+    for name in sorted(tenants):
+        t = tenants[name]
+        out_tenants[name] = {
+            "device_seconds": round(t.get("device_seconds", 0.0), 6),
+            "rows": int(t.get("rows", 0)),
+            "tokens": int(t.get("tokens", 0)),
+            "bytes": int(t.get("bytes", 0)),
+            "compile_seconds": round(t.get("compile_seconds", 0.0), 6),
+            "share": (round(t.get("device_seconds", 0.0)
+                            / total_device, 4)
+                      if total_device > 0 else None),
+        }
+    return {
+        "window_s": window_s,
+        "span_s": round(fw.get("span_s", 0.0), 3),
+        "replicas": fw.get("replicas") or [],
+        "tenants": out_tenants,
+        "device_seconds_total": round(total_device, 6),
+        "engine_seconds": {p: round(v, 6)
+                           for p, v in sorted(engine.items())},
+        "pad_seconds": {b: round(v, 6)
+                        for b, v in sorted(pads.items())},
+    }
+
+
+def check_costs(collector: FleetCollector, *,
+                burns: Sequence[Mapping[str, Any]] | None = None,
+                window_s: float = DEFAULT_WINDOW_S,
+                skew_frac: float | None = None,
+                min_seconds: float = DEFAULT_COST_MIN_SECONDS,
+                fresh_within_s: float | None = None,
+                now: float | None = None) -> list[dict[str, Any]]:
+    """``fleet.cost_skew`` findings: a tenant holding more than
+    ``skew_frac`` of the fleet's windowed device-seconds while ANOTHER
+    tenant's ``slo.burn`` finding fires (``burns`` — the throttling
+    decision signal: the dominant tenant is spending the hardware the
+    burning tenant's SLO needs).  A dominant tenant with no one burning
+    is just busy — not a finding; a fleet below ``min_seconds`` of
+    windowed device time is too idle to judge."""
+    skew_frac = (cost_skew_frac_default() if skew_frac is None
+                 else float(skew_frac))
+    summary = cost_summary(collector, window_s, now=now,
+                           fresh_within_s=fresh_within_s)
+    total = summary["device_seconds_total"]
+    if total < min_seconds:
+        return []
+    burning = {}
+    for b in burns or ():
+        t = b.get("tenant")
+        if t is not None and t not in burning:
+            burning[t] = b.get("objective")
+    if not burning:
+        return []
+    findings: list[dict[str, Any]] = []
+    for name, doc in summary["tenants"].items():
+        share = doc.get("share")
+        if share is None or share <= skew_frac:
+            continue
+        victims = sorted(t for t in burning if t != name)
+        if not victims:
+            continue
+        findings.append({
+            "finding": "fleet.cost_skew",
+            "tenant": name,
+            "share": share,
+            "device_seconds": doc["device_seconds"],
+            "fleet_device_seconds": total,
+            "burning_tenants": victims,
+            "objective": burning[victims[0]],
+            "skew_frac": skew_frac,
+            "window_s": window_s,
+        })
+    return findings

@@ -84,6 +84,9 @@ EVENT_TYPES = frozenset({
     "admission.shed",         # a request refused at the byte bound
     "slo.fire",               # slo.burn finding newly firing
     "slo.clear",              # a previously-firing objective cleared
+    # cost accounting (obs/ledger.py / mesh.py)
+    "cost.skew",              # fleet.cost_skew finding newly firing
+    "cost.skew_clear",        # a previously-firing cost skew cleared
     # artifact/spool lifecycle (compile_cache.py)
     "compile_cache.spool",    # entries pushed to the shared namespace
     # decode slot lifecycle (decode.py)

@@ -760,6 +760,9 @@ class OnlineServer:
         for req in failed:
             req.fail(err)
         tenant.evict_metrics()
+        from tensorflowonspark_tpu.obs import ledger as ledger_mod
+
+        ledger_mod.get_ledger().evict_tenant(name)
         logger.info("online tenant %r removed (%d pending failed)", name,
                     len(failed))
 
@@ -1178,9 +1181,11 @@ class OnlineServer:
     def _compute_loop(self) -> None:
         from tensorflowonspark_tpu import pipeline, serving
         from tensorflowonspark_tpu.obs import flight
+        from tensorflowonspark_tpu.obs import ledger as ledger_mod
 
         rec = flight.recorder("online")
         store = _trace.get_trace_store()
+        led = ledger_mod.get_ledger()
         perf = time.perf_counter
         while True:
             t0 = perf()
@@ -1223,6 +1228,17 @@ class OnlineServer:
             bt.forward_dur = t2 - t1
             bt.forward_end_wall = time.time()
             bt.compute_tid = threading.get_ident() & 0xFFFFFFFF
+            # cost apportionment rides the measurement it charges: the
+            # forward wall splits across batch-mates by row share (the
+            # pad rows' slice books to the bucket choice), the compile
+            # wall to the head tenant that met the fresh signature —
+            # from the local reqs, NOT bt.members (trace-gated)
+            led.charge_batch(
+                "online",
+                [(req.tenant.name, req.rows, req.nbytes)
+                 for req in reqs],
+                t2 - t1, bucket=bucket,
+                compile_s=(t2 - t1) if fresh else 0.0)
             # scatter: request k owns rows [off, off+k.rows) of the batch,
             # in drain order — tenant mix is irrelevant to correctness.
             # Every caller is woken FIRST; per-request trace bookkeeping

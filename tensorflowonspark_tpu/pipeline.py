@@ -719,6 +719,10 @@ class _RunModel:
             # as emit-bound.  One commit per batch (emit attribution lags
             # one batch, totals exact).
             pending = None
+            from tensorflowonspark_tpu.obs import ledger as ledger_mod
+
+            led = ledger_mod.get_ledger()
+            payer = str(self.model_name or self.export_dir)
             src = iter(readers.prefetched(staged_batches, depth))
             while True:
                 t0 = _perf()
@@ -734,6 +738,11 @@ class _RunModel:
                     # first call of a new shape signature: this dispatch
                     # wall carries the trace+XLA compile
                     serving.observe_compile_seconds(t2 - t1)
+                # serve-plane cost attribution: batch scoring has no
+                # tenants — the partition's forward wall books to its
+                # model key (the payer a chargeback can price)
+                led.charge_serve(payer, t2 - t1, n,
+                                 compile_s=(t2 - t1) if fresh else 0.0)
                 if rec is not None:
                     if depth > 0:
                         rec.add(wait=t1 - t0)

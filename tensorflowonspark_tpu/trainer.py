@@ -284,8 +284,13 @@ class Trainer:
         # already device_put the batch recorded the real transfer as
         # `stage`, and this re-shard of device-resident arrays is ~free —
         # sharing the name would bimodalize that histogram toward zero
-        self._flight.add(shard=t1 - t0,
-                         compute=time.perf_counter() - t1)
+        compute_s = time.perf_counter() - t1
+        self._flight.add(shard=t1 - t0, compute=compute_s)
+        # goodput ledger: the same windows, phase-classified (the first
+        # step's compute wall IS the jit compile — note_step books it)
+        from tensorflowonspark_tpu.obs import ledger as ledger_mod
+
+        ledger_mod.goodput().note_step(t1 - t0, compute_s)
         # bucketed step: the modelled collective-stage costs ride beside
         # the dispatch wall as overlapped (`_bg`) stages — on the async
         # path nothing blocks, so the comm is context, not critical path
@@ -479,6 +484,9 @@ class Trainer:
             # step-collectives A/B, which times the no-reduce twin.
             compute_s = time.perf_counter() - t1
             self._flight.add(shard=t1 - t0, compute=compute_s)
+            from tensorflowonspark_tpu.obs import ledger as ledger_mod
+
+            ledger_mod.goodput().note_step(t1 - t0, compute_s)
             comm = self._comm_stage_seconds()
             if comm:
                 self._flight.add(overlapped=True, **{
@@ -549,7 +557,14 @@ class Trainer:
         # forcing state.step syncs the device — but only on the save
         # cadence, where the save itself snapshots the same state anyway
         step = int(np.asarray(self.state.step))
+        t0 = time.perf_counter()
         self._ckpt_mgr.save(step, self._state_tree())
+        # async saves return after the device→host snapshot; that
+        # snapshot wall is the step path's real checkpoint cost, which
+        # is exactly what the goodput breakdown should book
+        from tensorflowonspark_tpu.obs import ledger as ledger_mod
+
+        ledger_mod.goodput().note_checkpoint(time.perf_counter() - t0)
         self.last_checkpoint_step = step
 
     def restore_latest(self) -> int | None:

@@ -1803,10 +1803,12 @@ def test_collectives_throughput_needs_its_ab_partner(tmp_path):
 
 def test_collectives_ratio_regression_within_identity_only(tmp_path):
     # same config, worse (higher) ratio beyond 1/threshold: fail
+    # (round-20 artifacts must be r20-complete — the costs microbench is
+    # owed there — so the comparison rides _r20 halves)
     paths = [
         _write(tmp_path, "BENCH_r19.json", _r19()),
         _write(tmp_path, "BENCH_r20.json",
-               _r19(**_collectives_fields(ratio=0.71)))]
+               _r20(**_collectives_fields(ratio=0.71)))]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "fail"
     assert any("moves more bytes" in r for r in verdict["reasons"])
@@ -1814,7 +1816,121 @@ def test_collectives_ratio_regression_within_identity_only(tmp_path):
     paths = [
         _write(tmp_path, "BENCH_r19.json", _r19()),
         _write(tmp_path, "BENCH_r20.json",
-               _r19(**_collectives_fields(ratio=0.71,
+               _r20(**_collectives_fields(ratio=0.71,
                                           collectives_devices=16)))]
     verdict = bench_gate.gate(paths)
     assert verdict["verdict"] == "pass", verdict["reasons"]
+
+
+# -- per-tenant cost accounting + goodput ledger (ISSUE 18) ------------------
+
+
+def _costs_fields(ratio=1.0, **extra):
+    fields = {"costs_conservation_ratio": ratio,
+              "costs_flight_ratio": 1.0,
+              "costs_overhead_frac": -0.02,
+              "costs_p99_ms": 9.9, "costs_p99_ms_off": 9.7,
+              "costs_skew_detect_s": 1.01,
+              "costs_skew_tenant": "t0", "costs_skew_share": 0.85,
+              "costs_goodput_breakdown": {
+                  "wall_s": 0.48, "stage_sum_s": 0.47,
+                  "stage_sum_frac": 0.979,
+                  "phases_s": {"productive": 0.01, "input_wait": 0.02,
+                               "compile": 0.39, "checkpoint": 0.04,
+                               "recovery": 0.0, "stall": 0.01},
+                  "productive_frac": 0.021, "steps": 10},
+              "costs_goodput_productive_frac": 0.021,
+              "costs_tenants": 3, "costs_clients": 6,
+              "costs_rows_total": 150, "costs_cadence_s": 1.0,
+              "costs_host_cpus": 1}
+    fields.update(extra)
+    return fields
+
+
+def _r20(**extra):
+    """A round-20-complete primary half: r19 + the cost-accounting
+    microbench."""
+    half = _r19(**_costs_fields())
+    half.update(extra)
+    return half
+
+
+def test_costs_field_required_on_primary_from_round_20(tmp_path):
+    # round 19: grandfathered — no cost-accounting microbench owed
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r19.json", _r19())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # round 20+: the primary must carry it (or explicit null + reason)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r20.json", _r19())])
+    assert verdict["verdict"] == "fail"
+    assert any("costs_conservation_ratio" in r for r in verdict["reasons"])
+    # complete round 20 passes
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r20.json", _r20())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # explicit null + reason satisfies (e.g. wall budget exhausted)
+    half = _r19(costs_conservation_ratio=None,
+                costs_reason="wall budget exhausted before cost "
+                             "microbench")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r20.json", half)])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # bare null does not
+    half = _r19(costs_conservation_ratio=None)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r20.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("costs_reason" in r for r in verdict["reasons"])
+
+
+def test_costs_conservation_drift_and_string_rejection(tmp_path):
+    """Charges that do not re-add to the engine seconds they were carved
+    from fail the artifact; a string must not slide past the block."""
+    verdict = bench_gate.gate([_write(
+        tmp_path, "BENCH_r20.json", _r20(**_costs_fields(ratio=1.05)))])
+    assert verdict["verdict"] == "fail"
+    assert any("drifts more than 1%" in r for r in verdict["reasons"])
+    half = _r20(costs_conservation_ratio="1.0")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r20.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("must be numeric or an explicit null" in r
+               for r in verdict["reasons"])
+
+
+def test_costs_value_without_config_identity_fails(tmp_path):
+    half = _r20()
+    del half["costs_clients"]
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r20.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("config identity" in r and "costs_clients" in r
+               for r in verdict["reasons"])
+
+
+def test_costs_overhead_must_ride_the_ratio(tmp_path):
+    half = _r20(costs_overhead_frac=None)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r20.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("costs_overhead_frac" in r for r in verdict["reasons"])
+
+
+def test_costs_skew_detection_inside_judged_budget(tmp_path):
+    # a detection latency past 3x cadence + 1s is an autopsy
+    half = _r20(costs_skew_detect_s=10.0)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r20.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("autopsy" in r for r in verdict["reasons"])
+    # a never-caught dominant tenant cannot back the stamped ratio
+    half = _r20(costs_skew_detect_s=None)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r20.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("never caught" in r for r in verdict["reasons"])
+
+
+def test_costs_goodput_breakdown_must_reconcile(tmp_path):
+    bd = dict(_costs_fields()["costs_goodput_breakdown"])
+    bd["stage_sum_s"] = 0.10  # 0.208 of the 0.48 wall: phases missing
+    half = _r20(costs_goodput_breakdown=bd)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r20.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("does not reconcile" in r for r in verdict["reasons"])
+    # no breakdown at all: the goodput ledger is part of the claim
+    half = _r20(costs_goodput_breakdown=None)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r20.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("costs_goodput_breakdown" in r for r in verdict["reasons"])

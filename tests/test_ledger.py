@@ -1,0 +1,366 @@
+"""Per-tenant cost accounting + training goodput ledger
+(``obs/ledger.py``): apportionment arithmetic, meter conservation under
+concurrent mixed-tenant load, the TFOS_LEDGER gate, tenant eviction,
+goodput phase folding and wall reconciliation, and the fleet cost plane
+(windowed rollup, cost-skew findings, the end-to-end online path)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import compat, obs, online
+from tensorflowonspark_tpu.obs import fleet, flight, ledger
+
+
+def _reg_counter(series):
+    """Current cumulative value of one registry counter series (0 when
+    the series was never minted) — instruments are process-wide, so
+    shared planes/buckets must be read as deltas."""
+    return obs.get_registry().snapshot()["counters"].get(series, 0.0)
+
+
+@pytest.fixture(autouse=True)
+def _ledger_on(monkeypatch):
+    monkeypatch.setenv("TFOS_LEDGER", "1")
+
+
+# ---------------------------------------------------------------------------
+# CostLedger apportionment arithmetic
+# ---------------------------------------------------------------------------
+
+
+def test_charge_batch_apportions_by_row_share_of_bucket():
+    """0.8s of forward wall over a bucket of 8 with 3+1 real rows: the
+    tenants split 0.4s by row share, the 4 pad rows' 0.4s books to the
+    bucket choice, and the full 0.8s lands on the engine denominator."""
+    led = ledger.CostLedger()
+    eng0 = _reg_counter('ledger_engine_seconds_total{plane="lb1"}')
+    pad0 = _reg_counter('ledger_pad_seconds_total{bucket="8"}')
+    led.charge_batch("lb1", [("lb1_a", 3, 300), ("lb1_b", 1, 100)],
+                     0.8, bucket=8)
+    doc = led.summary()
+    a, b = doc["tenants"]["lb1_a"], doc["tenants"]["lb1_b"]
+    assert a["device_seconds"] == pytest.approx(0.3)
+    assert b["device_seconds"] == pytest.approx(0.1)
+    assert (a["rows"], a["bytes"]) == (3, 300)
+    assert (b["rows"], b["bytes"]) == (1, 100)
+    assert _reg_counter('ledger_pad_seconds_total{bucket="8"}') - pad0 \
+        == pytest.approx(0.4)
+    assert _reg_counter('ledger_engine_seconds_total{plane="lb1"}') \
+        - eng0 == pytest.approx(0.8)
+
+
+def test_charge_decode_splits_by_tokens_and_books_prefill_bytes():
+    led = ledger.CostLedger()
+    # a decode step: one token per live slot, wall splits evenly-ish
+    led.charge_decode([("ld_a", 3), ("ld_b", 1)], 0.4)
+    # a prefill: single share, the admitted prompt's bytes ride along
+    led.charge_decode([("ld_a", 1)], 0.1, nbytes=96)
+    doc = led.summary()
+    a, b = doc["tenants"]["ld_a"], doc["tenants"]["ld_b"]
+    assert a["device_seconds"] == pytest.approx(0.4)
+    assert b["device_seconds"] == pytest.approx(0.1)
+    assert (a["tokens"], b["tokens"]) == (4, 1)
+    assert a["bytes"] == 96
+    # bytes never ride a multi-share step (whose prompt would it be?)
+    led.charge_decode([("ld_a", 1), ("ld_b", 1)], 0.1, nbytes=50)
+    assert led.summary()["tenants"]["ld_a"]["bytes"] == 96
+
+
+def test_compile_seconds_charged_to_head_tenant():
+    """The request that opened the batch missed the signature cache —
+    the compile wall is its tenant's, not split across riders."""
+    led = ledger.CostLedger()
+    led.charge_batch("lc1", [("lc_head", 1, 0), ("lc_ride", 7, 0)],
+                     0.2, compile_s=1.5)
+    doc = led.summary()
+    assert doc["tenants"]["lc_head"]["compile_seconds"] \
+        == pytest.approx(1.5)
+    assert doc["tenants"]["lc_ride"]["compile_seconds"] == 0.0
+
+
+def test_charge_serve_books_to_model_key():
+    led = ledger.CostLedger()
+    eng0 = _reg_counter('ledger_engine_seconds_total{plane="serve"}')
+    led.charge_serve("ls_model", 0.25, 40)
+    doc = led.summary()
+    assert doc["tenants"]["ls_model"]["device_seconds"] \
+        == pytest.approx(0.25)
+    assert doc["tenants"]["ls_model"]["rows"] == 40
+    assert _reg_counter('ledger_engine_seconds_total{plane="serve"}') \
+        - eng0 == pytest.approx(0.25)
+
+
+def test_degenerate_charges_are_noops():
+    led = ledger.CostLedger()
+    led.charge_batch("ln1", [], 0.5)              # no shares
+    led.charge_batch("ln1", [("ln_a", 1, 0)], -1)  # negative wall
+    led.charge_decode([("ln_a", 0)], 0.5)          # zero total units
+    assert led.summary()["tenants"] == {}
+    assert _reg_counter('ledger_engine_seconds_total{plane="ln1"}') == 0
+
+
+def test_disabled_gate_skips_charging(monkeypatch):
+    monkeypatch.setenv("TFOS_LEDGER", "0")
+    led = ledger.CostLedger()
+    led.charge_batch("lg1", [("lg_a", 4, 400)], 0.5)
+    led.charge_decode([("lg_a", 2)], 0.2)
+    assert led.summary()["tenants"] == {}
+    monkeypatch.setenv("TFOS_LEDGER", "1")
+    led.charge_batch("lg1", [("lg_a", 4, 400)], 0.5)
+    assert led.summary()["tenants"]["lg_a"]["rows"] == 4
+
+
+def test_evict_tenant_drops_labeled_series():
+    """Bounded cardinality: a removed tenant's ledger series leave the
+    registry (and the summary) rather than lingering forever."""
+    led = ledger.CostLedger()
+    led.charge_batch("le1", [("le_gone", 2, 20)], 0.1)
+    assert "le_gone" in led.summary()["tenants"]
+    led.evict_tenant("le_gone")
+    assert "le_gone" not in led.summary()["tenants"]
+    counters = obs.get_registry().snapshot()["counters"]
+    assert not any('tenant="le_gone"' in k for k in counters)
+
+
+# ---------------------------------------------------------------------------
+# meter conservation under concurrent mixed-tenant load (satellite claim:
+# apportioned charges + pad waste re-add to the engine wall within 1%)
+# ---------------------------------------------------------------------------
+
+
+def test_conservation_under_concurrent_mixed_tenant_load():
+    led = ledger.CostLedger()
+    tenants = [f"cc_t{i}" for i in range(4)]
+    eng0 = (_reg_counter('ledger_engine_seconds_total{plane="cc1"}')
+            + _reg_counter('ledger_engine_seconds_total{plane="decode"}'))
+    pad0 = _reg_counter('ledger_pad_seconds_total{bucket="16"}')
+
+    def worker(seed):
+        rng = np.random.RandomState(seed)
+        for i in range(200):
+            wall = float(rng.uniform(0.001, 0.01))
+            if i % 3 == 0:
+                led.charge_decode(
+                    [(tenants[(seed + j) % 4], 1 + int(rng.randint(3)))
+                     for j in range(2)], wall)
+            else:
+                rows = [1 + int(rng.randint(4)) for _ in range(3)]
+                led.charge_batch(
+                    "cc1",
+                    [(tenants[(seed + j) % 4], rows[j], rows[j] * 64)
+                     for j in range(3)],
+                    wall, bucket=16, compile_s=0.001 if i == 0 else 0.0)
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+
+    doc = led.summary()
+    charged = sum(doc["tenants"][t]["device_seconds"] for t in tenants)
+    pad = _reg_counter('ledger_pad_seconds_total{bucket="16"}') - pad0
+    engine = (_reg_counter('ledger_engine_seconds_total{plane="cc1"}')
+              + _reg_counter(
+                  'ledger_engine_seconds_total{plane="decode"}')) - eng0
+    assert engine > 0
+    assert (charged + pad) / engine == pytest.approx(1.0, abs=0.01)
+
+
+# ---------------------------------------------------------------------------
+# GoodputLedger: phase folding + wall reconciliation
+# ---------------------------------------------------------------------------
+
+
+def test_first_step_compute_books_as_compile():
+    gp = ledger.GoodputLedger(plane="gp_none1")
+    gp.note_step(0.1, 0.4)   # first step: trace + compile ride compute
+    gp.note_step(0.1, 0.3)   # steady state: productive
+    gp.note_checkpoint(0.05)
+    assert gp.steps == 2
+    bd = gp.breakdown(1.0)
+    assert bd["phases_s"]["compile"] == pytest.approx(0.4)
+    assert bd["phases_s"]["productive"] == pytest.approx(0.3)
+    assert bd["phases_s"]["input_wait"] == pytest.approx(0.2)
+    assert bd["phases_s"]["checkpoint"] == pytest.approx(0.05)
+    # the residual 0.05s nobody claimed is stall, and the breakdown
+    # reconciles exactly to the wall it decomposed
+    assert bd["phases_s"]["stall"] == pytest.approx(0.05)
+    assert bd["stage_sum_frac"] == pytest.approx(1.0)
+    assert bd["productive_frac"] == pytest.approx(0.3)
+
+
+def test_breakdown_folds_feed_flight_stages_into_input_wait():
+    """The DataFeed-side stage walls (existing flight signals) fold into
+    input_wait at breakdown time — no new instrumentation on the feed."""
+    plane = "gp_feed1"
+    rec = flight.recorder(plane)
+    rec.reset()
+    rec.add(wait=0.2, ingest=0.1)
+    gp = ledger.GoodputLedger(plane=plane)
+    gp.note_step(0.0, 0.4)
+    bd = gp.breakdown(0.7)
+    assert bd["phases_s"]["input_wait"] == pytest.approx(0.3)
+    assert bd["stage_sum_frac"] == pytest.approx(1.0)
+
+
+def test_goodput_reset_and_unknown_phase():
+    gp = ledger.GoodputLedger(plane="gp_none2")
+    gp.note_step(0.1, 0.2)
+    gp.reset()
+    assert gp.steps == 0
+    assert gp.breakdown(0.0)["phases_s"]["compile"] == 0.0
+    with pytest.raises(ValueError):
+        gp.note("daydreaming", 1.0)
+
+
+def test_singletons_reset_seam():
+    led, gp = ledger.get_ledger(), ledger.goodput()
+    assert ledger.get_ledger() is led and ledger.goodput() is gp
+    ledger.reset()
+    try:
+        assert ledger.get_ledger() is not led
+        assert ledger.goodput() is not gp
+    finally:
+        ledger.reset()
+
+
+# ---------------------------------------------------------------------------
+# fleet cost plane: windowed rollup + cost-skew findings
+# ---------------------------------------------------------------------------
+
+
+def _cost_snap(dev_by_tenant, engine_s, pad_s=0.0):
+    counters = {f'ledger_device_seconds_total{{tenant="{t}"}}': v
+                for t, v in dev_by_tenant.items()}
+    counters['ledger_engine_seconds_total{plane="online"}'] = engine_s
+    if pad_s:
+        counters['ledger_pad_seconds_total{bucket="8"}'] = pad_s
+    return {"counters": counters, "gauges": {}, "histograms": {}}
+
+
+def _skewed_collector():
+    """One replica, 10s apart: tenant fa spent 9 of the window's 10
+    device-seconds (90% share), fb the other 1."""
+    fc = fleet.FleetCollector()
+    fc.observe("r0", _cost_snap({"fa": 0.0, "fb": 0.0}, 0.0), ts=100.0)
+    fc.observe("r0", _cost_snap({"fa": 9.0, "fb": 1.0}, 10.5, pad_s=0.5),
+               ts=110.0)
+    return fc
+
+
+def test_cost_summary_windows_shares_and_denominator():
+    doc = fleet.cost_summary(_skewed_collector(), window_s=30.0,
+                             now=110.0, fresh_within_s=1000.0)
+    assert doc["tenants"]["fa"]["device_seconds"] == pytest.approx(9.0)
+    assert doc["tenants"]["fa"]["share"] == pytest.approx(0.9)
+    assert doc["tenants"]["fb"]["share"] == pytest.approx(0.1)
+    assert doc["device_seconds_total"] == pytest.approx(10.0)
+    assert doc["engine_seconds"]["online"] == pytest.approx(10.5)
+    assert doc["pad_seconds"]["8"] == pytest.approx(0.5)
+
+
+def test_check_costs_requires_a_cross_tenant_burn():
+    fc = _skewed_collector()
+    kw = dict(window_s=30.0, now=110.0, fresh_within_s=1000.0,
+              min_seconds=0.05)
+    # a dominant tenant with no one burning is just busy
+    assert fleet.check_costs(fc, burns=[], **kw) == []
+    # the dominant tenant burning its OWN objective is not skew
+    assert fleet.check_costs(
+        fc, burns=[{"tenant": "fa", "objective": "fa-lat"}], **kw) == []
+    # another tenant burning while fa holds 90%: the finding, named
+    out = fleet.check_costs(
+        fc, burns=[{"tenant": "fb", "objective": "fb-lat"}], **kw)
+    assert len(out) == 1
+    f = out[0]
+    assert f["finding"] == "fleet.cost_skew"
+    assert f["tenant"] == "fa"
+    assert f["share"] == pytest.approx(0.9)
+    assert f["burning_tenants"] == ["fb"]
+    assert f["objective"] == "fb-lat"
+
+
+def test_check_costs_idle_fleet_is_not_judged():
+    fc = fleet.FleetCollector()
+    fc.observe("r0", _cost_snap({"fa": 0.0}, 0.0), ts=100.0)
+    fc.observe("r0", _cost_snap({"fa": 0.001}, 0.001), ts=110.0)
+    out = fleet.check_costs(
+        fc, burns=[{"tenant": "fb", "objective": "fb-lat"}],
+        window_s=30.0, now=110.0, fresh_within_s=1000.0)
+    assert out == []
+
+
+def test_cost_skew_frac_env_override(monkeypatch):
+    monkeypatch.setenv("TFOS_FLEET_COST_SKEW_FRAC", "0.95")
+    assert fleet.cost_skew_frac_default() == pytest.approx(0.95)
+    monkeypatch.setenv("TFOS_FLEET_COST_SKEW_FRAC", "nonsense")
+    assert fleet.cost_skew_frac_default() \
+        == pytest.approx(fleet.DEFAULT_COST_SKEW_FRAC)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the online plane's own charges conserve (the bench claim,
+# proven small here so tier-1 holds it without the microbench)
+# ---------------------------------------------------------------------------
+
+
+W = np.arange(20, dtype=np.float32).reshape(4, 5) / 10.0
+
+
+def _predict(p, b):
+    return {"score": b["features"] @ p["w"]}
+
+
+def test_online_plane_charges_conserve_end_to_end(tmp_path):
+    export = str(tmp_path / "export")
+    compat.export_saved_model({"params": {"w": W}}, export)
+    ledger.reset()
+    led = ledger.get_ledger()
+    eng0 = _reg_counter('ledger_engine_seconds_total{plane="online"}')
+    pad0 = sum(v for k, v in obs.get_registry().snapshot()
+               ["counters"].items()
+               if k.startswith("ledger_pad_seconds_total"))
+    base = led.summary()
+
+    srv = online.OnlineServer()
+    names = ("ee_a", "ee_b")
+    for name in names:
+        srv.add_tenant(name, export_dir=export, predict_fn=_predict,
+                       batch_size=8, bucket_sizes=[2, 8], flush_ms=2.0,
+                       input_mapping={"features": "features"})
+    srv.start()
+    try:
+        def client(seed):
+            rng = np.random.RandomState(seed)
+            for i in range(12):
+                x = rng.rand(1 + i % 3, 4).astype(np.float32)
+                srv.submit(names[(seed + i) % 2], {"features": x},
+                           timeout=30.0)
+
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+    finally:
+        srv.stop()
+
+    after = led.summary()
+    charged = sum(
+        after["tenants"][t]["device_seconds"]
+        - (base["tenants"].get(t) or {}).get("device_seconds", 0.0)
+        for t in names)
+    pad = sum(v for k, v in obs.get_registry().snapshot()
+              ["counters"].items()
+              if k.startswith("ledger_pad_seconds_total")) - pad0
+    engine = _reg_counter(
+        'ledger_engine_seconds_total{plane="online"}') - eng0
+    rows = sum(after["tenants"][t]["rows"] for t in names)
+    assert rows == 72  # 3 clients x 12 requests x (1 + i%3) rows
+    assert engine > 0
+    assert (charged + pad) / engine == pytest.approx(1.0, abs=0.01)

@@ -150,6 +150,21 @@ run; on a single-device box equality and throughput are an explicit
 ``null`` + ``collectives_reason`` while the analytic ratio stays
 numeric.
 
+From round ``--require-costs-from`` (default 20, the round that
+introduced the per-tenant cost ledger and training goodput breakdown)
+the primary half must carry ``costs_conservation_ratio`` — apportioned
+per-tenant device-seconds plus padding waste over the engine seconds
+they were split from — or an explicit ``null`` + ``costs_reason``.  A
+numeric ratio must sit within 1% of 1.0 (charges that do not re-add to
+the walls they were carved from make every chargeback line fiction),
+carry its config identity (tenant/client counts, request volume,
+judgment cadence, host CPUs), an A/B-measured ``costs_overhead_frac``
+in [-1, 1], a ``costs_skew_detect_s`` within the judged budget of
+3 x cadence + 1 s (an induced dominant tenant must be caught by
+``fleet.cost_skew`` while it is still dominant), and a
+``costs_goodput_breakdown`` whose phase sum reconciles to the measured
+training wall within the flight tolerance.
+
 Usage::
 
     python tools/bench_gate.py                  # repo-root BENCH_r*.json
@@ -222,6 +237,10 @@ DEFAULT_REQUIRE_INCIDENT_FROM = 18
 #: collectives comparison (``collectives_bytes_ratio``, introduced with
 #: the reduce-scatter bucketed exchange + sharded optimizer updates)
 DEFAULT_REQUIRE_COLLECTIVES_FROM = 19
+#: first round whose primary half must carry the cost-accounting
+#: microbench (``costs_conservation_ratio``, introduced with the
+#: per-tenant cost ledger + training goodput breakdown)
+DEFAULT_REQUIRE_COSTS_FROM = 20
 #: |stage_sum / wall - 1| beyond this fails the artifact: a breakdown that
 #: does not add up is decoration, not attribution
 DEFAULT_FLIGHT_TOLERANCE = 0.15
@@ -317,6 +336,14 @@ _COLLECTIVES_IDENT_KEYS = ("collectives_platform", "collectives_devices",
                            "collectives_dcn_world", "collectives_model",
                            "collectives_grad_mb", "collectives_bucket_mb",
                            "collectives_update_shard")
+_COSTS_KEY = "costs_conservation_ratio"
+#: the cost-accounting microbench's config identity: the ledger's
+#: overhead and the skew detection latency are only comparable at the
+#: same tenant/client counts, request volume, judgment cadence and host
+#: CPU count (apportionment rides the engines' own threads)
+_COSTS_IDENT_KEYS = ("costs_tenants", "costs_clients",
+                     "costs_rows_total", "costs_cadence_s",
+                     "costs_host_cpus")
 #: decode latency p99s regression-gated LOWER-is-better beside the
 #: throughput (a scheduler change that buys tokens/sec by doubling the
 #: tail is a regression, not a win)
@@ -440,7 +467,8 @@ def validate_half(half: dict[str, Any], *,
                   require_decode: bool = False,
                   require_fleet: bool = False,
                   require_incident: bool = False,
-                  require_collectives: bool = False) -> list[str]:
+                  require_collectives: bool = False,
+                  require_costs: bool = False) -> list[str]:
     """Schema problems of one measured result (a wrapper's half)."""
     problems = []
     for key in _REQUIRED_HALF_KEYS:
@@ -933,6 +961,84 @@ def validate_half(half: dict[str, Any], *,
             problems.append(
                 f"{_COLLECTIVES_KEY!r} must be numeric or an explicit "
                 f"null (got {half[_COLLECTIVES_KEY]!r})")
+    # per-tenant cost-accounting microbench: the conservation ratio is
+    # the ledger's load-bearing claim — apportioned tenant seconds plus
+    # padding waste must re-add to the engine seconds they were split
+    # from, within 1%, or every downstream chargeback line is fiction.
+    # Null + 'costs_reason' always satisfies; a numeric ratio must carry
+    # its config identity, a bounded ledger overhead, a skew-detection
+    # latency inside the judged cadence budget, and a goodput breakdown
+    # that reconciles to the measured training wall
+    if require_costs or _COSTS_KEY in half:
+        if _COSTS_KEY not in half:
+            problems.append(
+                f"missing {_COSTS_KEY!r} (cost-accounting microbench is "
+                "part of the schema from r20: measure it or stamp an "
+                "explicit null + 'costs_reason')")
+        elif half[_COSTS_KEY] is None and "costs_reason" not in half:
+            problems.append(
+                f"{_COSTS_KEY!r} is null without a 'costs_reason'")
+        elif isinstance(half.get(_COSTS_KEY), (int, float)):
+            if abs(half[_COSTS_KEY] - 1.0) > 0.01:
+                problems.append(
+                    f"{_COSTS_KEY!r} {half[_COSTS_KEY]} drifts more "
+                    "than 1% from 1.0 — per-tenant charges plus padding "
+                    "waste must conserve the engine seconds they were "
+                    "apportioned from")
+            missing = [k for k in _COSTS_IDENT_KEYS if k not in half]
+            if missing:
+                problems.append(
+                    f"{_COSTS_KEY!r} without its config identity "
+                    f"({', '.join(missing)}) — ledger overhead and skew "
+                    "detection latency are only comparable within one "
+                    "tenant/client/volume/cadence/CPU-count config")
+            ov = half.get("costs_overhead_frac")
+            if not (isinstance(ov, (int, float)) and -1.0 <= ov <= 1.0):
+                problems.append(
+                    f"costs_overhead_frac is {ov!r}: the stamped ratio "
+                    "is only admissible next to an A/B-measured ledger "
+                    "overhead fraction in [-1, 1]")
+            det = half.get("costs_skew_detect_s")
+            cad = half.get("costs_cadence_s")
+            if not isinstance(det, (int, float)):
+                problems.append(
+                    f"costs_skew_detect_s is {det!r}: an induced "
+                    "dominant tenant that was never caught by "
+                    "fleet.cost_skew cannot back the stamped ratio")
+            elif isinstance(cad, (int, float)) \
+                    and det > 3.0 * cad + 1.0:
+                problems.append(
+                    f"costs_skew_detect_s {det} exceeds the judged "
+                    f"budget of 3x cadence + 1s ({3.0 * cad + 1.0:.1f}s "
+                    f"at {cad}s cadence) — a skew finding that lands "
+                    "after the spike is an autopsy, not an alert")
+            bd = half.get("costs_goodput_breakdown")
+            if not isinstance(bd, dict):
+                problems.append(
+                    f"costs_goodput_breakdown is {bd!r}: the goodput "
+                    "ledger's phase breakdown is part of the claim")
+            else:
+                wall = bd.get("wall_s")
+                ssum = bd.get("stage_sum_s")
+                if not (isinstance(wall, (int, float))
+                        and isinstance(ssum, (int, float))):
+                    problems.append(
+                        "costs_goodput_breakdown without numeric "
+                        "'wall_s' and 'stage_sum_s' — an unreconcilable "
+                        "breakdown is a narrative, not a ledger")
+                elif wall > 0 and abs(ssum / wall - 1.0) > 0.15:
+                    problems.append(
+                        f"costs_goodput_breakdown does not reconcile: "
+                        f"phases sum to {ssum / wall:.3f} of the "
+                        "measured wall (tolerance 0.15) — unattributed "
+                        "time beyond the stall residual means a phase "
+                        "is missing")
+        elif half[_COSTS_KEY] is not None:
+            # neither null nor numeric: keep the forged-value door shut
+            # like the fleet/incident/collectives blocks above
+            problems.append(
+                f"{_COSTS_KEY!r} must be numeric or an explicit null "
+                f"(got {half[_COSTS_KEY]!r})")
     # request-tracing overhead: A/B-measured on the online path, so a
     # degraded-accelerator round still owes it; null + reason always
     # satisfies (e.g. TFOS_TRACE_REQUESTS=0 runs have no A to B against)
@@ -1128,7 +1234,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
          require_decode_from: int = DEFAULT_REQUIRE_DECODE_FROM,
          require_fleet_from: int = DEFAULT_REQUIRE_FLEET_FROM,
          require_incident_from: int = DEFAULT_REQUIRE_INCIDENT_FROM,
-         require_collectives_from: int = DEFAULT_REQUIRE_COLLECTIVES_FROM
+         require_collectives_from: int = DEFAULT_REQUIRE_COLLECTIVES_FROM,
+         require_costs_from: int = DEFAULT_REQUIRE_COSTS_FROM
          ) -> dict[str, Any]:
     """Run the gate over a trajectory; returns the verdict document."""
     checks: list[dict[str, Any]] = []
@@ -1188,6 +1295,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                           and art["n"] >= require_incident_from)
             require_co = (label == "primary"
                           and art["n"] >= require_collectives_from)
+            require_ct = (label == "primary"
+                          and art["n"] >= require_costs_from)
             for problem in validate_half(half, require_roofline=require_rf,
                                          require_feed=require_fd,
                                          require_serving=require_sv,
@@ -1200,7 +1309,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                                          require_decode=require_dc,
                                          require_fleet=require_fo,
                                          require_incident=require_in,
-                                         require_collectives=require_co):
+                                         require_collectives=require_co,
+                                         require_costs=require_ct):
                 check(f"schema:{name}:{label}",
                       "fail" if is_newest else "warn", problem)
             # flight breakdowns ride the primary half with the microbench
@@ -1544,6 +1654,8 @@ def main(argv: list[str] | None = None) -> int:
                    default=DEFAULT_REQUIRE_INCIDENT_FROM)
     p.add_argument("--require-collectives-from", type=int,
                    default=DEFAULT_REQUIRE_COLLECTIVES_FROM)
+    p.add_argument("--require-costs-from", type=int,
+                   default=DEFAULT_REQUIRE_COSTS_FROM)
     args = p.parse_args(argv)
     paths = args.paths or discover(args.repo)
     if not paths:
@@ -1566,7 +1678,8 @@ def main(argv: list[str] | None = None) -> int:
                require_decode_from=args.require_decode_from,
                require_fleet_from=args.require_fleet_from,
                require_incident_from=args.require_incident_from,
-               require_collectives_from=args.require_collectives_from)
+               require_collectives_from=args.require_collectives_from,
+               require_costs_from=args.require_costs_from)
     print(json.dumps(doc))
     return 1 if doc["verdict"] == "fail" else 0
 

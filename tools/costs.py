@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Per-tenant chargeback report: merge the fleet cost plane's outputs.
+
+Three evidence sources, any subset, ONE deterministic report:
+
+- ``--costs`` — a saved ``GET /fleet/costs`` body (the router's windowed
+  per-tenant rollup + ``fleet.cost_skew`` findings,
+  :meth:`tensorflowonspark_tpu.mesh.MeshRouter.fleet_costs`);
+- ``--metrics`` — a saved ``GET /fleet/metrics`` (or ``/metrics``)
+  Prometheus text document: the LIFETIME ``ledger_*`` counters, summed
+  across replica labels, so the report carries since-boot totals next to
+  the windowed view;
+- ``--journal`` — a journal spool dir (``TFOS_JOURNAL_DIR``): per-tenant
+  admit / shed / cancel / SLO-fire tallies from the causal event
+  timeline, the "how often was this tenant refused" axis no meter
+  carries.
+
+Tenants are merged by name and emitted sorted, so identical inputs
+always produce byte-identical reports — the chargeback document is an
+artifact, not a dashboard.  ``--price-per-device-hour`` turns
+device-seconds into a currency line (windowed wall engine time, priced
+the way DEPLOY.md sizes it off ``fleet.capacity``); with no price the
+report stays in device-seconds.
+
+Usage::
+
+    python tools/costs.py --costs fleet_costs.json -o report.json
+    python tools/costs.py --metrics fleet_metrics.prom --journal /spool
+    python tools/costs.py --costs c.json --price-per-device-hour 3.20
+
+Exit code 0 on success; 2 when no source yields any evidence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+from tensorflowonspark_tpu.obs import fleet as _fleet  # noqa: E402
+from tensorflowonspark_tpu.obs import journal as _journal  # noqa: E402
+from tensorflowonspark_tpu.obs import registry as _registry  # noqa: E402
+
+import incident as _incident  # noqa: E402  (sibling tool: tenant tallies)
+
+#: lifetime ledger counter family -> report field (mirrors
+#: ``obs.fleet._COST_FIELDS`` on purpose: same families, same names)
+_LIFETIME_FIELDS = dict(_fleet._COST_FIELDS)
+
+
+def lifetime_from_metrics(text: str) -> dict[str, Any]:
+    """Sum the lifetime ``ledger_*`` counters out of a Prometheus text
+    document, collapsing the federation's ``replica=`` label — per
+    tenant, plus the engine denominator per plane and pad seconds per
+    bucket."""
+    snap = _fleet.parse_exposition(text)
+    tenants: dict[str, dict[str, float]] = {}
+    engine: dict[str, float] = {}
+    pads: dict[str, float] = {}
+    for series, value in (snap.get("counters") or {}).items():
+        fam, labels = _registry.split_series(series)
+        field = _LIFETIME_FIELDS.get(fam)
+        if field is not None:
+            tenant = labels.get("tenant", "_unlabeled")
+            doc = tenants.setdefault(tenant, {})
+            doc[field] = doc.get(field, 0.0) + value
+        elif fam == "ledger_engine_seconds_total":
+            plane = labels.get("plane", "_unlabeled")
+            engine[plane] = engine.get(plane, 0.0) + value
+        elif fam == "ledger_pad_seconds_total":
+            bucket = labels.get("bucket", "_unlabeled")
+            pads[bucket] = pads.get(bucket, 0.0) + value
+    return {
+        "tenants": {t: {k: (round(v, 6) if "seconds" in k else int(v))
+                        for k, v in sorted(tenants[t].items())}
+                    for t in sorted(tenants)},
+        "engine_seconds": {p: round(v, 6)
+                           for p, v in sorted(engine.items())},
+        "pad_seconds": {b: round(v, 6) for b, v in sorted(pads.items())},
+    }
+
+
+def tallies_from_journal(spool_dir: str) -> dict[str, Any]:
+    """Per-tenant admit/shed/cancel/SLO tallies from a spool dir — the
+    same digest ``tools/incident.py --summary`` emits."""
+    return _incident._tenant_tallies(_journal.read_spool(spool_dir))
+
+
+def build_report(costs_doc: dict[str, Any] | None = None,
+                 metrics_text: str | None = None,
+                 spool_dir: str | None = None,
+                 price_per_device_hour: float | None = None
+                 ) -> dict[str, Any]:
+    """Merge the sources into one per-tenant chargeback report.
+
+    Every tenant named by ANY source gets a row; absent facets stay
+    ``None`` rather than zero, so "no evidence" never reads as "no
+    usage".  Deterministic: tenants sorted, floats rounded.
+    """
+    windowed = (costs_doc or {}).get("costs") or {}
+    findings = (costs_doc or {}).get("findings") or []
+    lifetime = (lifetime_from_metrics(metrics_text)
+                if metrics_text is not None else None)
+    tallies = (tallies_from_journal(spool_dir)
+               if spool_dir is not None else None)
+
+    names: set[str] = set()
+    names.update(windowed.get("tenants") or ())
+    if lifetime:
+        names.update(lifetime["tenants"])
+    if tallies:
+        names.update(tallies)
+
+    skewed = {f.get("tenant") for f in findings
+              if f.get("finding") == "fleet.cost_skew"}
+    tenants: dict[str, Any] = {}
+    for name in sorted(names):
+        row: dict[str, Any] = {
+            "windowed": (windowed.get("tenants") or {}).get(name),
+            "lifetime": (lifetime["tenants"].get(name)
+                         if lifetime else None),
+            "events": tallies.get(name) if tallies else None,
+            "cost_skew": name in skewed,
+        }
+        if price_per_device_hour is not None:
+            basis = row["windowed"] or row["lifetime"] or {}
+            dev_s = basis.get("device_seconds")
+            row["cost_usd"] = (round(dev_s / 3600.0
+                                     * price_per_device_hour, 6)
+                               if dev_s is not None else None)
+        tenants[name] = row
+
+    report: dict[str, Any] = {
+        "tenants": tenants,
+        "window_s": (costs_doc or {}).get("window_s"),
+        "device_seconds_total": windowed.get("device_seconds_total"),
+        "engine_seconds": windowed.get("engine_seconds"),
+        "pad_seconds": windowed.get("pad_seconds"),
+        "findings": findings,
+        "sources": {"costs": costs_doc is not None,
+                    "metrics": metrics_text is not None,
+                    "journal": spool_dir is not None},
+    }
+    if lifetime:
+        report["lifetime_engine_seconds"] = lifetime["engine_seconds"]
+        report["lifetime_pad_seconds"] = lifetime["pad_seconds"]
+    if price_per_device_hour is not None:
+        report["price_per_device_hour"] = float(price_per_device_hour)
+    return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge fleet cost snapshot + metrics + journal into "
+                    "one per-tenant chargeback report")
+    ap.add_argument("--costs", default=None,
+                    help="saved GET /fleet/costs JSON document")
+    ap.add_argument("--metrics", default=None,
+                    help="saved GET /fleet/metrics (or /metrics) "
+                    "Prometheus text document")
+    ap.add_argument("--journal", default=None,
+                    help="journal spool directory (TFOS_JOURNAL_DIR)")
+    ap.add_argument("--price-per-device-hour", type=float, default=None,
+                    help="price one device-hour; adds a cost_usd line "
+                    "per tenant")
+    ap.add_argument("-o", "--output", default=None,
+                    help="write the report JSON here (default: stdout)")
+    args = ap.parse_args(argv)
+
+    costs_doc = None
+    if args.costs is not None:
+        try:
+            with open(args.costs) as f:
+                costs_doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"costs: cannot read --costs {args.costs}: {e}",
+                  file=sys.stderr)
+            return 2
+    metrics_text = None
+    if args.metrics is not None:
+        try:
+            with open(args.metrics) as f:
+                metrics_text = f.read()
+        except OSError as e:
+            print(f"costs: cannot read --metrics {args.metrics}: {e}",
+                  file=sys.stderr)
+            return 2
+    if args.journal is not None and not os.path.isdir(args.journal):
+        print(f"costs: no spool dir at {args.journal}", file=sys.stderr)
+        return 2
+
+    report = build_report(costs_doc, metrics_text, args.journal,
+                          args.price_per_device_hour)
+    if not report["tenants"]:
+        print("costs: no tenant evidence in any source",
+              file=sys.stderr)
+        return 2
+    out = json.dumps(report, indent=2, sort_keys=True)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(out + "\n")
+        print(f"costs: wrote {args.output} "
+              f"({len(report['tenants'])} tenants)")
+    else:
+        print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

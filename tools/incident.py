@@ -37,8 +37,8 @@ the last journal event of a type (``last:slo.fire``,
 seconds: the "what happened in the 10 s around this burn" view.
 ``--summary`` prints the incident digest (deaths with stamped corpse
 bundles, generation fences, exemplar trace ids and whether their span
-trees were recovered) that ``bench.py --incident`` and the chaos tests
-assert on.
+trees were recovered, per-tenant admit/shed/cancel tallies) that
+``bench.py --incident`` and the chaos tests assert on.
 
 Exit code 0 on success (and, with ``--validate``, a clean schema check);
 2 on an empty/unreadable spool.
@@ -218,6 +218,35 @@ def _recovered_ids(bundles: list[dict[str, Any]]) -> set:
     return got
 
 
+#: journal event type -> the per-tenant tally field it bumps
+_TENANT_TALLIES = {
+    "decode.admit": "admitted",
+    "decode.retire": "retired",
+    "decode.cancel": "cancelled",
+    "admission.shed": "shed",
+    "slo.fire": "slo_fires",
+    "cost.skew": "cost_skews",
+}
+
+
+def _tenant_tallies(events: list[dict[str, Any]]) -> dict[str, Any]:
+    """Per-tenant request/shed/cancel tallies from tenant-stamped journal
+    events — who got admitted, who got refused, whose SLO burned — the
+    incident digest's "which tenant was this about" axis."""
+    tallies: dict[str, dict[str, int]] = {}
+    for ev in events:
+        field = _TENANT_TALLIES.get(str(ev.get("type")))
+        if field is None:
+            continue
+        tenant = (ev.get("attrs") or {}).get("tenant")
+        if not tenant:
+            continue
+        doc = tallies.setdefault(
+            str(tenant), {f: 0 for f in _TENANT_TALLIES.values()})
+        doc[field] += 1
+    return {t: tallies[t] for t in sorted(tallies)}
+
+
 def summarize(events: list[dict[str, Any]],
               bundles: list[dict[str, Any]]) -> dict[str, Any]:
     """The incident digest the chaos proof asserts on.
@@ -226,7 +255,9 @@ def summarize(events: list[dict[str, Any]],
     bundle; ``regroups`` each generation fence; ``exemplars`` maps the
     journal's linked trace ids to whether a bundle recovered their span
     trees (``linked`` = intersection, the "exemplar-linked trace"
-    acceptance bit).  ``ordered`` re-checks the total order end to end.
+    acceptance bit); ``tenants`` tallies per-tenant admits / retires /
+    cancels / sheds / SLO fires / cost-skew fires.  ``ordered``
+    re-checks the total order end to end.
     """
     deaths = [e for e in events if e.get("type") == "replica.death"]
     regroups = [e for e in events
@@ -253,6 +284,7 @@ def summarize(events: list[dict[str, Any]],
                     for b in bundles],
         "exemplars": exemplar_ids,
         "linked": sorted(t for t in exemplar_ids if t in recovered),
+        "tenants": _tenant_tallies(events),
     }
 
 
