@@ -23,22 +23,47 @@ capacity, and a mid-stream disconnect frees exactly what it held
 (asserted leak-free in ``tests/test_decode.py``, the ``test_shm``
 pattern).
 
+**Chunked, multi-sequence prefill.**  Prompts are split into
+page-aligned chunks drawn from the ``shapes.prefill_chunks`` ladder and
+each engine step packs chunks from SEVERAL admitted requests into one
+jitted prefill call of fixed ``(max_seqs, chunk_len)`` geometry,
+interleaved with decode steps — a long prompt advances at most one
+chunk per step, so it cannot monopolize the loop and every co-tenant's
+TTFT is bounded by the chunk budget (``TFOS_PREFILL_CHUNK``), not the
+longest prompt in flight.  ``TFOS_PREFILL_CHUNK=0`` selects the legacy
+one-prompt-per-call prefill (pads to ``shapes.prefill_buckets``) — kept
+as the bench baseline.
+
+**Copy-on-write prefix sharing.**  A bounded registry
+(:class:`_PrefixRegistry`, ``TFOS_PREFIX_SHARE`` /
+``TFOS_PREFIX_REGISTRY_MAX``) keyed by token-hash maps completed
+prompts' page-aligned prefixes to REFCOUNTED read-only physical pages.
+Admission looks up the longest common token prefix and maps those pages
+into the new slot's table for free — KV at position t depends only on
+tokens ``0..t``, so shared pages are exact, not approximate.  The pool
+counts pages by PHYSICAL identity (``bytes_resident`` is unique pages),
+so N requests sharing a prefix hold it once.  A prefix that diverges
+mid-page maps the boundary page too; the first divergent write triggers
+a page COPY (``tinylm.copy_page_fn``, one fixed jit signature) into a
+private page before the write lands — shared pages are never mutated.
+
 **One-compile decode.**  All decode-step shapes are fixed by the
 (slot, page) geometry — ``tokens (S,)``, ``seq_lens (S,)``,
 ``page_tables (S, P)`` — so sequence growth moves an integer, never a
 shape, and steady-state decode adds ZERO jit signatures after
-:meth:`DecodeEngine.warmup`.  Prefill pads prompts to the
-``shapes.prefill_buckets`` ladder (one compile per bucket), keyed
+:meth:`DecodeEngine.warmup`: one per chunk-ladder rung (or prefill
+bucket in legacy mode), one decode step, one COW page copy.  All keyed
 through ``serving.note_compile`` like every other serving plane, so
 ``compile counters == shapes`` stays assertable (the PR 13 invariant)
 and the fleet compile cache amortizes decode compiles too.
 
-**Phases are separate flight stages.**  ``prefill`` (prompt ingestion,
-one sequence per jit call) and ``decode`` (the batched token step)
-accumulate into the ``"decode"`` flight plane with their own verdicts
-(``prefill_bound`` / ``decode_bound``) — the two phases have different
-remedies (longer ladder / chunked prefill vs more slots per step), so
-one ``compute`` bucket would hide the one fact an operator needs.
+**Phases are separate flight stages.**  ``prefill_chunk`` (chunked
+prompt ingestion; ``prefill`` in legacy mode) and ``decode`` (the
+batched token step) accumulate into the ``"decode"`` flight plane with
+their own verdicts (``prefill_bound`` / ``decode_bound``) — the two
+phases have different remedies (smaller chunk budget / more slots per
+step), so one ``compute`` bucket would hide the one fact an operator
+needs.
 
 **Streaming + SLOs.**  Tokens stream to callers as they are produced
 (:class:`DecodeStream`; chunked HTTP via :class:`DecodeHTTPServer` on
@@ -98,9 +123,41 @@ DEFAULT_ITL_SLO_MS = 500.0
 SLO_WINDOW_S = 60.0
 #: per-token spans listed on a retained trace before truncation
 _MAX_TOKEN_SPANS = 32
+#: default chunked-prefill budget, in PAGES per chunk row (the
+#: ``TFOS_PREFILL_CHUNK`` env knob overrides in tokens; 0 = legacy
+#: per-prompt prefill) — two pages bounds a long prompt's hold on the
+#: step loop without paying a chunk call per page
+DEFAULT_PREFILL_CHUNK_PAGES = 2
+#: default prefix-registry entry bound (``TFOS_PREFIX_REGISTRY_MAX``);
+#: each entry pins its prefix pages until evicted, so the bound is a
+#: KV-memory bound too
+DEFAULT_PREFIX_REGISTRY_MAX = 32
 
 _DONE = object()
 _ENGINE_SEQ = itertools.count(1)
+
+
+def _env_int(name: str, default: int) -> int:
+    import os
+
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        logger.warning("ignoring non-integer %s=%r", name, raw)
+        return default
+
+
+def prefix_share_enabled() -> bool:
+    """COW prefix sharing on/off (``TFOS_PREFIX_SHARE``, default ON).
+    Re-read per engine construction, not cached at import — same
+    late-binding discipline as the other ``TFOS_*`` toggles."""
+    import os
+
+    return os.environ.get("TFOS_PREFIX_SHARE", "1").strip().lower() \
+        not in ("0", "false", "no", "off")
 
 
 class PagedKVPool:
@@ -112,6 +169,22 @@ class PagedKVPool:
     page-granular with worst-case reservation at admission — no
     mid-flight preemption, no fragmentation (any free page serves any
     sequence; the page table is the indirection).
+
+    Pages are REFCOUNTED: :meth:`alloc` hands out pages at refcount 1,
+    :meth:`share` (prefix sharing mapping one physical page into
+    several slots' tables) increments, and :meth:`free` DECREMENTS —
+    the page returns to the free list only at zero.  Every holder frees
+    exactly the references it took, so a shared page's
+    "double free" is impossible by construction: the hazard the
+    refcount exists to remove is two tables releasing one physical page
+    twice.  Releasing a reference nobody holds (refcount already zero)
+    still raises loudly — that is a real bookkeeping bug, not sharing.
+
+    :meth:`invariant` states the conservation law (every page is
+    exactly one of trash / free-with-refcount-0 / used-with-positive
+    refcount) as a JSON-able dict for ``/healthz``;
+    :meth:`check_invariant` raises on violation and is asserted at
+    engine shutdown and in every decode test teardown.
     """
 
     def __init__(self, num_pages: int):
@@ -119,7 +192,12 @@ class PagedKVPool:
             raise ValueError("need >= 2 pages (page 0 is the trash page)")
         self.num_pages = int(num_pages)
         self._free: list[int] = list(range(1, self.num_pages))
+        self._refs: list[int] = [0] * self.num_pages
         self.peak_used = 0
+        #: cumulative pages ever allocated — with prefix sharing this
+        #: grows SUB-LINEARLY in requests served (shared prefixes alloc
+        #: once), which is the bench round's unique-page claim
+        self.alloc_total = 0
 
     @property
     def free_pages(self) -> int:
@@ -129,24 +207,183 @@ class PagedKVPool:
     def used_pages(self) -> int:
         return (self.num_pages - 1) - len(self._free)
 
+    @property
+    def shared_pages(self) -> int:
+        """Physical pages mapped by more than one holder."""
+        return sum(1 for r in self._refs if r > 1)
+
+    @property
+    def logical_pages(self) -> int:
+        """Total page REFERENCES outstanding (what non-shared
+        allocation would have cost): sum of refcounts."""
+        return sum(r for r in self._refs if r > 0)
+
     def can_alloc(self, n: int) -> bool:
         return n <= len(self._free)
+
+    def refcount(self, page: int) -> int:
+        return self._refs[page]
 
     def alloc(self, n: int) -> list[int]:
         if n > len(self._free):
             raise RuntimeError(
                 f"KV pool exhausted: need {n} pages, {len(self._free)} free")
         pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._refs[p] = 1
+        self.alloc_total += n
         self.peak_used = max(self.peak_used, self.used_pages)
         return pages
 
-    def free(self, pages: Sequence[int]) -> None:
+    def share(self, pages: Sequence[int]) -> None:
+        """Take one additional reference on each page (all-or-nothing:
+        validated before any refcount moves)."""
         for p in pages:
             if not 1 <= p < self.num_pages:
                 raise ValueError(f"bad page id {p}")
-            if p in self._free:
-                raise ValueError(f"double free of page {p}")
-            self._free.append(p)
+            if self._refs[p] <= 0:
+                raise ValueError(f"share of unallocated page {p}")
+        for p in pages:
+            self._refs[p] += 1
+
+    def free(self, pages: Sequence[int]) -> None:
+        """Release one reference per listed page; a page returns to the
+        free list when its last reference drops.  Validated up front
+        COUNTING DUPLICATES (freeing ``[p, p]`` against one reference
+        must not leave a negative refcount behind a partial mutation)."""
+        from collections import Counter
+
+        want = Counter(pages)
+        for p, k in want.items():
+            if not 1 <= p < self.num_pages:
+                raise ValueError(f"bad page id {p}")
+            if self._refs[p] < k:
+                raise ValueError(
+                    f"double free of page {p} ({k} releases, "
+                    f"{self._refs[p]} references held)")
+        for p in pages:
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                self._free.append(p)
+
+    def invariant(self) -> dict[str, Any]:
+        """The conservation law as data (no raise — the ``/healthz``
+        surface): ``used + free + trash == num_pages``, refcounts
+        non-negative, the free list duplicate-free with refcount 0."""
+        free = len(self._free)
+        used = self.num_pages - 1 - free
+        referenced = sum(1 for p in range(1, self.num_pages)
+                         if self._refs[p] > 0)
+        negative = sum(1 for r in self._refs if r < 0)
+        free_clean = (len(set(self._free)) == free
+                      and all(self._refs[p] == 0 for p in self._free))
+        ok = (negative == 0 and referenced == used and free_clean
+              and self._refs[0] == 0
+              and used + free + 1 == self.num_pages)
+        return {"ok": ok, "pages_used": used, "pages_free": free,
+                "pages_trash": 1, "num_pages": self.num_pages,
+                "referenced": referenced, "negative_refcounts": negative}
+
+    def check_invariant(self) -> dict[str, Any]:
+        doc = self.invariant()
+        if not doc["ok"]:
+            raise RuntimeError(f"KV pool invariant violated: {doc}")
+        return doc
+
+
+class _PrefixRegistry:
+    """Bounded LRU of completed prompts' page-aligned prefixes →
+    refcounted read-only physical pages (the COW prefix-sharing map).
+
+    Entries are keyed by the token-hash of the full prefix (the dict
+    hash of its byte form) with the exact token array stored alongside
+    — a hash collision can therefore never alias two prefixes, and
+    :meth:`lookup` matches by longest common TOKEN prefix, so a new
+    prompt reuses an entry's pages even when it diverges partway
+    through (the divergence page is what COW copies).  Each entry holds
+    one pool reference per page (taken in :meth:`register`, released on
+    eviction / :meth:`clear`), so a registered prefix outlives the
+    request that produced it but never outlives the registry bound.
+
+    Engine-thread only — admission, registration, and eviction all run
+    on the step loop, which is what makes lookup-then-share atomic
+    without a lock of its own.
+    """
+
+    def __init__(self, pool: PagedKVPool, page_size: int,
+                 max_entries: int = DEFAULT_PREFIX_REGISTRY_MAX):
+        from collections import OrderedDict
+
+        self._pool = pool
+        self._page_size = int(page_size)
+        self.max_entries = max(1, int(max_entries))
+        self._entries: "OrderedDict[bytes, tuple[np.ndarray, list[int]]]"
+        self._entries = OrderedDict()
+        self.hits = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def pinned_pages(self) -> int:
+        """Unique physical pages currently pinned by registry entries —
+        what a drained engine's ``used_pages`` legitimately holds."""
+        return len({p for _, pages in self._entries.values()
+                    for p in pages})
+
+    def register(self, tokens: np.ndarray, pages: Sequence[int]) -> bool:
+        """Pin ``pages`` (one reference each) as the read-only KV of
+        ``tokens``; evicts LRU entries past the bound.  No-op (LRU
+        touch) when the exact prefix is already registered."""
+        key = tokens.tobytes()
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return False
+        self._pool.share(pages)
+        self._entries[key] = (np.array(tokens, np.int32), list(pages))
+        while len(self._entries) > self.max_entries:
+            _, (_, old) = self._entries.popitem(last=False)
+            self._pool.free(old)
+            self.evictions += 1
+        return True
+
+    def lookup(self, prompt: np.ndarray, cap: int
+               ) -> tuple[int, list[int]]:
+        """Longest common token prefix of ``prompt`` against every
+        entry, capped at ``cap`` tokens (callers pass
+        ``prompt_len - 1`` so a fully-registered prompt still computes
+        its last position — the logits that mint the first token).
+
+        Returns ``(matched_tokens, pages)`` where ``pages`` covers the
+        match (``ceil(matched / page_size)`` entries — the last one
+        PARTIAL when the match ends mid-page; that page must be COW'd
+        before the slot's first write).  ``(0, [])`` when the best
+        match is under one page — mapping a page to reuse less than a
+        page of KV costs a copy for nothing.  The caller takes its own
+        references via ``pool.share``.
+        """
+        best_m, best_key, best_pages = 0, None, []
+        for key, (tok, pages) in self._entries.items():
+            k = min(len(tok), int(cap))
+            if k <= best_m:
+                continue
+            eq = tok[:k] == prompt[:k]
+            m = k if eq.all() else int(np.argmax(~eq))
+            if m > best_m:
+                n_map = -(-m // self._page_size)
+                best_m, best_key, best_pages = m, key, pages[:n_map]
+        if best_m < self._page_size:
+            return 0, []
+        self._entries.move_to_end(best_key)
+        self.hits += 1
+        return best_m, list(best_pages)
+
+    def clear(self) -> None:
+        """Release every pinned page (engine shutdown)."""
+        while self._entries:
+            _, (_, pages) = self._entries.popitem(last=False)
+            self._pool.free(pages)
 
 
 class _DecodeRequest:
@@ -156,7 +393,8 @@ class _DecodeRequest:
                  "queue", "cancelled", "generated", "t_submit",
                  "t_submit_wall", "t_admit", "t_last", "ttft_s",
                  "max_itl_s", "error", "rt", "slot", "pages", "done",
-                 "tenant")
+                 "tenant", "prefill_pos", "start_pos", "shared_pages",
+                 "cow_index", "table")
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
                  rt: "_trace.RequestTrace | None",
@@ -180,6 +418,14 @@ class _DecodeRequest:
         self.slot: int | None = None
         self.pages: list[int] = []
         self.done = False
+        # chunked-prefill phase state: tokens [0, prefill_pos) are in
+        # the cache (shared prefix pages and/or completed chunks); the
+        # request enters the decode phase at prefill_pos == prompt_len
+        self.prefill_pos = 0
+        self.start_pos = 0            # prefill_pos at admission
+        self.shared_pages = 0         # prefix pages mapped for free
+        self.cow_index: int | None = None  # table index pending COW
+        self.table: np.ndarray | None = None  # this slot's page table
 
 
 class DecodeStream:
@@ -314,6 +560,9 @@ class DecodeEngine:
                  max_pending_mb: float = DEFAULT_MAX_PENDING_MB,
                  ttft_slo_ms: float = DEFAULT_TTFT_SLO_MS,
                  itl_slo_ms: float = DEFAULT_ITL_SLO_MS,
+                 prefill_chunk: int | None = None,
+                 share_prefixes: bool | None = None,
+                 prefix_registry_max: int | None = None,
                  seed: int = 0):
         import jax
 
@@ -352,12 +601,37 @@ class DecodeEngine:
         self.ttft_slo_s = float(ttft_slo_ms) / 1000.0
         self.itl_slo_s = float(itl_slo_ms) / 1000.0
 
+        # chunked-prefill geometry: the chunk budget (tokens a prompt
+        # may advance per engine step) comes from the argument, else
+        # the TFOS_PREFILL_CHUNK env, else a pages-based default;
+        # 0 selects the legacy one-prompt-per-call prefill
+        if prefill_chunk is None:
+            prefill_chunk = _env_int(
+                "TFOS_PREFILL_CHUNK",
+                DEFAULT_PREFILL_CHUNK_PAGES * self.page_size)
+        self.chunked_prefill = int(prefill_chunk) != 0
+        self.prefill_chunks = (
+            shapes.prefill_chunks(self.max_prompt_len, self.page_size,
+                                  max_chunk=int(prefill_chunk))
+            if self.chunked_prefill else ())
+        # prefix sharing rides the chunk scheduler (the legacy prefill
+        # writes every position from 0, which would mutate shared
+        # pages), so it is forced off in legacy mode
+        if share_prefixes is None:
+            share_prefixes = prefix_share_enabled()
+        self.share_prefixes = bool(share_prefixes) and self.chunked_prefill
+        if prefix_registry_max is None:
+            prefix_registry_max = _env_int("TFOS_PREFIX_REGISTRY_MAX",
+                                           DEFAULT_PREFIX_REGISTRY_MAX)
+        self.prefix_registry_max = int(prefix_registry_max)
+
         # the note_compile identity: one per engine INSTANCE — the jitted
         # closures below are per-engine, so two engines with one shared
         # key would claim compiles==jit-keys while each pays its own
         self.cache_key = ("decode", model_name, self.max_seqs,
                           self.page_size, self.pages_per_seq,
-                          self.prefill_buckets, next(_ENGINE_SEQ))
+                          self.prefill_buckets, self.prefill_chunks,
+                          self.share_prefixes, next(_ENGINE_SEQ))
 
         pool_shape = tinylm.kv_pool_shape(self.config, self.num_pages,
                                           self.page_size)
@@ -367,12 +641,20 @@ class DecodeEngine:
         #: zero-device-buffer-growth tests assert this never moves
         self.kv_pool_bytes = 2 * int(np.prod(pool_shape)) * 4
         self.pool = PagedKVPool(self.num_pages)
+        self._registry = (
+            _PrefixRegistry(self.pool, self.page_size,
+                            max_entries=self.prefix_registry_max)
+            if self.share_prefixes else None)
 
         import functools
 
         self._prefill_jit = jax.jit(functools.partial(
             tinylm.prefill_fn, config=self.config,
             page_size=self.page_size))
+        self._prefill_chunk_jit = jax.jit(functools.partial(
+            tinylm.prefill_chunk_fn, config=self.config,
+            page_size=self.page_size))
+        self._copy_page_jit = jax.jit(tinylm.copy_page_fn)
         self._decode_jit = jax.jit(functools.partial(
             tinylm.decode_fn, config=self.config,
             page_size=self.page_size))
@@ -385,6 +667,11 @@ class DecodeEngine:
         self._ptables = np.zeros((S, P), np.int32)
         self._slots: list[_DecodeRequest | None] = [None] * S
         self._active = 0
+        #: slots still in the prefill phase; their ``_ptables`` rows
+        #: stay ZERO (and ``_seq_lens`` 0) until the phase flips, so
+        #: the decode step's writes for them land in the trash page —
+        #: never in a mapped (possibly shared) page
+        self._prefilling = 0
 
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -440,43 +727,103 @@ class DecodeEngine:
         self._kv_bytes_g = obs.gauge(
             "decode_kv_bytes_resident",
             "device bytes of KV cache resident in allocated pages "
-            "(pages used x per-page bytes)")
+            "(pages used x per-page bytes; unique PHYSICAL pages — "
+            "prefix-shared pages count once)")
         self._kv_bytes_g.set(0)
+        self._prefix_hits_total = obs.counter(
+            "decode_prefix_hits_total",
+            "admissions that mapped a registered prompt prefix")
+        self._prefix_shared_total = obs.counter(
+            "decode_prefix_shared_pages_total",
+            "KV pages mapped from the prefix registry instead of "
+            "allocated (each one is a page of prefill compute and "
+            "pool memory not spent)")
+        self._cow_copies_total = obs.counter(
+            "decode_cow_copies_total",
+            "copy-on-write page copies (a shared prefix diverged "
+            "mid-page; the boundary page was copied before the first "
+            "divergent write)")
+        self._pages_alloc_total = obs.counter(
+            "decode_kv_pages_allocated_total",
+            "cumulative pages allocated from the pool (sub-linear in "
+            "requests when prefixes share)")
+        self._shared_pages_g = obs.gauge(
+            "decode_kv_pages_shared",
+            "physical pages currently mapped by more than one holder")
 
     # -- shape policy --------------------------------------------------------
 
     def enumerate_signatures(self) -> list[tuple]:
         """The complete signature set this engine's runtime requests:
-        one per prefill bucket plus exactly ONE for the decode step —
-        what :meth:`warmup` warms, and what steady-state serving must
-        not grow (asserted in tests via the ``note_compile`` seen-set)."""
+        one per chunk-ladder rung (or prefill bucket in legacy mode),
+        exactly ONE for the decode step, and one for the COW page copy
+        when prefix sharing is on — what :meth:`warmup` warms, and what
+        steady-state serving must not grow (asserted in tests via the
+        ``note_compile`` seen-set)."""
         return enumerate_signatures(
             max_seqs=self.max_seqs, pages_per_seq=self.pages_per_seq,
-            prefill_buckets=self.prefill_buckets)
+            prefill_buckets=(None if self.chunked_prefill
+                             else self.prefill_buckets),
+            prefill_chunks=(self.prefill_chunks
+                            if self.chunked_prefill else None),
+            share_prefixes=self.share_prefixes)
 
     def warmup(self) -> None:
-        """Compile every ladder shape now: each prefill bucket (zero
-        tokens through the trash page — no allocation) and the decode
-        step.  Counted through ``serving.note_compile`` so compiles ==
-        jit keys holds, and run through the persistent compile cache's
-        designated seeding path semantics (first call pays, fleet
-        loads)."""
+        """Compile every ladder shape now: each chunk rung (or prefill
+        bucket in legacy mode; zero tokens through the trash page — no
+        allocation), the decode step, and the COW page copy when
+        sharing is on.  Counted through ``serving.note_compile`` so
+        compiles == jit keys holds, and run through the persistent
+        compile cache's designated seeding path semantics (first call
+        pays, fleet loads)."""
         from tensorflowonspark_tpu import serving
 
         perf = time.perf_counter
-        P = self.pages_per_seq
+        S, P = self.max_seqs, self.pages_per_seq
         trash_row = np.zeros((P,), np.int32)
-        for b in self.prefill_buckets:
-            tokens = np.zeros((b,), np.int32)
-            plen = np.asarray(1, np.int32)
-            fresh = serving.note_compile(
-                self.cache_key, {"tokens": tokens, "prompt_len": plen})
-            t0 = perf()
-            nt, self._kp, self._vp = self._prefill_jit(
-                self._params, tokens, plen, self._kp, self._vp, trash_row)
-            int(nt)
-            if fresh:
-                serving.observe_compile_seconds(perf() - t0)
+        if self.chunked_prefill:
+            # zero chunk_lens route every warm write to the trash page
+            for rung in self.prefill_chunks:
+                tokens = np.zeros((S, rung), np.int32)
+                starts = np.zeros((S,), np.int32)
+                lens = np.zeros((S,), np.int32)
+                tables = np.zeros((S, P), np.int32)
+                fresh = serving.note_compile(
+                    self.cache_key,
+                    {"tokens": tokens, "start_lens": starts,
+                     "chunk_lens": lens, "page_tables": tables})
+                t0 = perf()
+                nts, self._kp, self._vp = self._prefill_chunk_jit(
+                    self._params, tokens, starts, lens, self._kp,
+                    self._vp, tables)
+                np.asarray(nts)
+                if fresh:
+                    serving.observe_compile_seconds(perf() - t0)
+            if self.share_prefixes:
+                z = np.asarray(0, np.int32)
+                fresh = serving.note_compile(
+                    self.cache_key, {"src": z, "dst": z})
+                t0 = perf()
+                # trash page onto itself: content-free by convention
+                self._kp, self._vp = self._copy_page_jit(
+                    self._kp, self._vp, z, z)
+                self._kp.block_until_ready()
+                if fresh:
+                    serving.observe_compile_seconds(perf() - t0)
+        else:
+            for b in self.prefill_buckets:
+                tokens = np.zeros((b,), np.int32)
+                plen = np.asarray(1, np.int32)
+                fresh = serving.note_compile(
+                    self.cache_key,
+                    {"tokens": tokens, "prompt_len": plen})
+                t0 = perf()
+                nt, self._kp, self._vp = self._prefill_jit(
+                    self._params, tokens, plen, self._kp, self._vp,
+                    trash_row)
+                int(nt)
+                if fresh:
+                    serving.observe_compile_seconds(perf() - t0)
         batch = {"tokens": self._tokens, "seq_lens": self._seq_lens,
                  "page_tables": self._ptables}
         fresh = serving.note_compile(self.cache_key, batch)
@@ -526,10 +873,17 @@ class DecodeEngine:
             req = self._slots[s]
             if req is not None:
                 self._retire(s, "error", err)
+        if self._registry is not None:
+            self._registry.clear()
         self._pending_g.set(0)
         self._active_g.set(0)
         self._pages_used_g.set(self.pool.used_pages)
         self._kv_bytes_g.set(self.pool.used_pages * self._page_bytes)
+        self._shared_pages_g.set(self.pool.shared_pages)
+        # every reference is back: page conservation + non-negative
+        # refcounts must hold here or the allocator lost track of a
+        # page — fail the shutdown loudly rather than hide a leak
+        self.pool.check_invariant()
 
     # -- request path --------------------------------------------------------
 
@@ -657,17 +1011,24 @@ class DecodeEngine:
                 rec.add(wait=wait_s)
                 rec.commit()
                 continue
+            chunked = self.chunked_prefill
             try:
                 # stage windows cover the WHOLE phase — jit call plus
                 # token delivery and retirement bookkeeping — so the
                 # plane's stage sum reconciles with the wall the gate
                 # checks it against
                 t0 = perf()
-                for req in admits:
-                    self._prefill_one(req)
+                if chunked:
+                    for req in admits:
+                        self._admit_one(req)
+                    if self._prefilling:
+                        self._prefill_chunk_step()
+                else:
+                    for req in admits:
+                        self._prefill_one(req)
                 t1 = perf()
                 prefill_s = t1 - t0
-                if self._active:
+                if self._active - self._prefilling > 0:
                     self._decode_step()
                 decode_s = perf() - t1
             except Exception as e:  # a broken step must not wedge callers
@@ -676,11 +1037,15 @@ class DecodeEngine:
                 self._fail_all(e)
                 continue
             if prefill_s or decode_s:
-                rec.add(prefill=prefill_s, decode=decode_s)
+                if chunked:
+                    rec.add(prefill_chunk=prefill_s, decode=decode_s)
+                else:
+                    rec.add(prefill=prefill_s, decode=decode_s)
                 rec.commit()
             self._active_g.set(self._active)
             self._pages_used_g.set(self.pool.used_pages)
             self._kv_bytes_g.set(self.pool.used_pages * self._page_bytes)
+            self._shared_pages_g.set(self.pool.shared_pages)
 
     def _pages_needed(self, req: _DecodeRequest) -> int:
         return -(-(req.prompt_len + req.max_new_tokens) // self.page_size)
@@ -722,6 +1087,181 @@ class DecodeEngine:
             admits.append(req)
         return admits
 
+    def _admit_one(self, req: _DecodeRequest) -> None:
+        """Assign a slot and map its page table (chunked mode): shared
+        prefix pages for free, fresh pages for the rest.  No model
+        compute here — the chunk scheduler owns that, so admission cost
+        stays flat however long the prompt is."""
+        t0 = time.perf_counter()
+        slot = self._slots.index(None)
+        need = self._pages_needed(req)
+        matched: int = 0
+        shared: list[int] = []
+        if self._registry is not None:
+            matched, shared = self._registry.lookup(
+                req.prompt, req.prompt_len - 1)
+        fresh = self.pool.alloc(need - len(shared))
+        if shared:
+            self.pool.share(shared)
+        self._pages_alloc_total.inc(need - len(shared))
+        req.slot = slot
+        req.pages = list(shared) + fresh
+        req.t_admit = t0
+        req.prefill_pos = req.start_pos = matched
+        req.shared_pages = len(shared)
+        # a match ending mid-page maps that boundary page shared; the
+        # slot's first write lands in it, so it is COW-pending
+        req.cow_index = (matched // self.page_size
+                         if matched % self.page_size else None)
+        row = np.zeros((self.pages_per_seq,), np.int32)
+        row[: len(req.pages)] = req.pages
+        req.table = row
+        self._slots[slot] = req
+        self._active += 1
+        self._prefilling += 1
+        if matched:
+            self._prefix_hits_total.inc()
+            self._prefix_shared_total.inc(len(shared))
+        if req.rt is not None:
+            req.rt.add("queue", t0 - req.t_submit,
+                       pending_depth=len(self._pending))
+        _journal.emit(
+            "decode.admit", slot=slot, pages=len(req.pages),
+            prompt_len=req.prompt_len, tenant=req.tenant,
+            queue_s=round(t0 - req.t_submit, 6),
+            shared_pages=req.shared_pages, prefix_tokens=matched,
+            **({"trace_id": req.rt.ctx.trace_id} if req.rt else {}))
+
+    def _cow_resolve(self, req: _DecodeRequest) -> None:
+        """The first divergent write into a shared page: copy it to a
+        private page (one fixed-signature jit call) and swap the table
+        entry, so the registered read-only page is never mutated.
+        Skipped when the reference turned exclusive in the meantime
+        (registry eviction) — writing in place is safe then."""
+        from tensorflowonspark_tpu import serving
+
+        if req.cow_index is None:
+            return
+        idx, req.cow_index = req.cow_index, None
+        old = req.pages[idx]
+        if self.pool.refcount(old) <= 1:
+            return
+        new = self.pool.alloc(1)[0]
+        self._pages_alloc_total.inc()
+        src = np.asarray(old, np.int32)
+        dst = np.asarray(new, np.int32)
+        t0 = time.perf_counter()
+        fresh = serving.note_compile(self.cache_key,
+                                     {"src": src, "dst": dst})
+        self._kp, self._vp = self._copy_page_jit(
+            self._kp, self._vp, src, dst)
+        if fresh:
+            serving.observe_compile_seconds(time.perf_counter() - t0)
+        self.pool.free([old])
+        req.pages[idx] = new
+        req.table[idx] = new
+        self._cow_copies_total.inc()
+        _journal.emit("decode.cow_copy", slot=req.slot, page=old,
+                      copy=new, tenant=req.tenant)
+
+    def _prefill_chunk_step(self) -> None:
+        """ONE fixed-shape multi-sequence prefill call: pack the next
+        chunk of every prefill-phase slot (COW-resolving any shared
+        boundary page about to be written), advance each, and flip
+        completed prompts into the decode phase.  The chunk length is
+        the smallest ladder rung covering the largest packed chunk, so
+        post-warmup calls mint zero signatures."""
+        from tensorflowonspark_tpu import serving, shapes
+
+        perf = time.perf_counter
+        t0 = perf()
+        rows = [r for r in self._slots
+                if r is not None and r.prefill_pos < r.prompt_len]
+        if not rows:
+            return
+        for req in rows:
+            self._cow_resolve(req)
+        S, P = self.max_seqs, self.pages_per_seq
+        top = self.prefill_chunks[-1]
+        L = shapes.choose_bucket(
+            max(min(r.prompt_len - r.prefill_pos, top) for r in rows),
+            self.prefill_chunks)
+        tokens = np.zeros((S, L), np.int32)
+        starts = np.zeros((S,), np.int32)
+        lens = np.zeros((S,), np.int32)
+        tables = np.zeros((S, P), np.int32)
+        packed: list[tuple[_DecodeRequest, int]] = []
+        nbytes = 0
+        for i, req in enumerate(rows):
+            n = min(req.prompt_len - req.prefill_pos, L)
+            tokens[i, :n] = req.prompt[req.prefill_pos:
+                                       req.prefill_pos + n]
+            starts[i] = req.prefill_pos
+            lens[i] = n
+            tables[i] = req.table
+            packed.append((req, n))
+            if req.prefill_pos == req.start_pos:
+                nbytes += req.nbytes  # first chunk carries the payload
+        fresh = serving.note_compile(
+            self.cache_key, {"tokens": tokens, "start_lens": starts,
+                             "chunk_lens": lens, "page_tables": tables})
+        nts, self._kp, self._vp = self._prefill_chunk_jit(
+            self._params, tokens, starts, lens, self._kp, self._vp,
+            tables)
+        nts_np = np.asarray(nts)
+        dt = perf() - t0
+        if fresh:
+            serving.observe_compile_seconds(dt)
+        from tensorflowonspark_tpu.obs import ledger as _ledger_mod
+
+        _ledger_mod.get_ledger().charge_decode(
+            [(req.tenant, n) for req, n in packed], dt,
+            compile_s=dt if fresh else 0.0, nbytes=nbytes)
+        for i, (req, n) in enumerate(packed):
+            pos = req.prefill_pos
+            req.prefill_pos = pos + n
+            if req.rt is not None:
+                # per-chunk TTFT attribution: which chunk of which
+                # prompt spent the time before the first token
+                req.rt.add("prefill_chunk", dt / len(packed),
+                           pos=pos, tokens=n, chunk_len=L)
+            if req.prefill_pos >= req.prompt_len:
+                self._finish_prefill(req, int(nts_np[i]))
+
+    def _finish_prefill(self, req: _DecodeRequest, tok: int) -> None:
+        """Prompt fully in cache: flip the slot into the decode phase
+        (its real page table becomes decode-visible only now — see
+        ``_prefilling``) and emit the first generated token."""
+        slot = req.slot
+        self._prefilling -= 1
+        self._seq_lens[slot] = req.prompt_len
+        self._tokens[slot] = tok
+        self._ptables[slot][:] = req.table
+        self._register_prefix(req)
+        _journal.emit(
+            "decode.prefill", slot=slot, tenant=req.tenant,
+            prompt_len=req.prompt_len, from_pos=req.start_pos,
+            shared_pages=req.shared_pages,
+            **({"trace_id": req.rt.ctx.trace_id} if req.rt else {}))
+        self._emit(req, tok)
+        if req.generated >= req.max_new_tokens or (
+                self.eos_id is not None and tok == self.eos_id):
+            self._retire(slot, "ok", None)
+
+    def _register_prefix(self, req: _DecodeRequest) -> None:
+        """Publish this prompt's page-aligned prefix for future
+        admissions.  Only FULL pages register: the page holding the
+        prompt tail keeps taking decode writes, so sharing it would
+        leak generated KV into other tenants' context."""
+        if self._registry is None:
+            return
+        reg_tokens = (req.prompt_len // self.page_size) * self.page_size
+        if reg_tokens < self.page_size:
+            return
+        self._registry.register(
+            req.prompt[:reg_tokens],
+            req.pages[: reg_tokens // self.page_size])
+
     def _prefill_one(self, req: _DecodeRequest) -> None:
         from tensorflowonspark_tpu import serving, shapes
 
@@ -729,8 +1269,10 @@ class DecodeEngine:
         t0 = perf()
         slot = self._slots.index(None)
         pages = self.pool.alloc(self._pages_needed(req))
+        self._pages_alloc_total.inc(len(pages))
         req.slot, req.pages = slot, pages
         req.t_admit = t0
+        req.prefill_pos = req.prompt_len  # legacy: decode phase at once
         row = self._ptables[slot]
         row[:] = 0
         row[: len(pages)] = pages
@@ -792,13 +1334,16 @@ class DecodeEngine:
         # slot's tenant — the request whose step met the fresh signature
         from tensorflowonspark_tpu.obs import ledger as _ledger_mod
 
+        # prefill-phase slots ride the step with zero seq_len and a
+        # zero table row (writes land in trash); their outputs are
+        # garbage — skip them here, the chunk scheduler owns them
         shares = [(req.tenant, 1) for req in self._slots
-                  if req is not None]
+                  if req is not None and req.prefill_pos >= req.prompt_len]
         _ledger_mod.get_ledger().charge_decode(
             shares, dt, compile_s=dt if fresh else 0.0)
         for s in range(self.max_seqs):
             req = self._slots[s]
-            if req is None:
+            if req is None or req.prefill_pos < req.prompt_len:
                 continue
             tok = int(nts_np[s])
             self._seq_lens[s] += 1
@@ -848,9 +1393,12 @@ class DecodeEngine:
         req = self._slots[slot]
         self._slots[slot] = None
         self._active -= 1
+        if req.prefill_pos < req.prompt_len:
+            self._prefilling -= 1  # cancelled/failed mid-prefill
         self._seq_lens[slot] = 0
         self._tokens[slot] = 0
         self._ptables[slot][:] = 0
+        req.table = None
         if req.pages:
             self.pool.free(req.pages)
             req.pages = []
@@ -944,6 +1492,9 @@ class DecodeEngine:
         slo = self.slo_snapshot()
         used = self.pool.used_pages
         total = self.num_pages - 1
+        shared = self.pool.shared_pages
+        logical = self.pool.logical_pages
+        invariant = self.pool.invariant()
         return {
             "state": self.state,
             "uptime_s": (round(time.monotonic() - self._started_ts, 3)
@@ -960,6 +1511,23 @@ class DecodeEngine:
                 "kv_occupancy": round(used / total, 4) if total else 0.0,
                 "kv_pool_bytes": self.kv_pool_bytes,
                 "prefill_buckets": list(self.prefill_buckets),
+                "prefill_chunks": list(self.prefill_chunks),
+                "chunked_prefill": self.chunked_prefill,
+                "prefix_share": self.share_prefixes,
+                "prefix_registry": {
+                    "entries": (len(self._registry)
+                                if self._registry is not None else 0),
+                    "max_entries": (self._registry.max_entries
+                                    if self._registry is not None
+                                    else 0),
+                    "hits": (self._registry.hits
+                             if self._registry is not None else 0),
+                    "evictions": (self._registry.evictions
+                                  if self._registry is not None else 0),
+                    "pinned_pages": (self._registry.pinned_pages
+                                     if self._registry is not None
+                                     else 0),
+                },
                 "max_len": self.max_len,
                 "max_prompt_len": self.max_prompt_len,
                 "warmed": self._warmed,
@@ -978,14 +1546,28 @@ class DecodeEngine:
                 # paged KV-pool occupancy: the placement-by-KV-bytes
                 # signal (ROADMAP item 2) and a cost-view input — in
                 # the ADMISSION block because a router placing by KV
-                # residency reads it where it reads saturation
+                # residency reads it where it reads saturation.
+                # pages_used/bytes_resident count UNIQUE physical
+                # pages (a prefix-shared page counts once);
+                # pages_logical is what non-shared allocation would
+                # have held — the gap is the sharing win
                 "kv": {
                     "pages_used": used,
                     "pages_total": total,
+                    "pages_shared": shared,
+                    "pages_logical": logical,
                     "occupancy": (round(used / total, 4)
                                   if total else 0.0),
                     "bytes_resident": used * self._page_bytes,
                     "pool_bytes": self.kv_pool_bytes,
+                    "prefix_hits_total": int(
+                        self._prefix_hits_total.value),
+                    "shared_pages_total": int(
+                        self._prefix_shared_total.value),
+                    "cow_copies_total": int(
+                        self._cow_copies_total.value),
+                    "pages_allocated_total": self.pool.alloc_total,
+                    "invariant": invariant,
                 },
             },
             "requests_total": int(self._requests_total.value),
@@ -997,10 +1579,14 @@ class DecodeEngine:
 
 
 def enumerate_signatures(*, max_seqs: int, pages_per_seq: int,
-                         prefill_buckets: Sequence[int]) -> list[tuple]:
+                         prefill_buckets: Sequence[int] | None = None,
+                         prefill_chunks: Sequence[int] | None = None,
+                         share_prefixes: bool = False) -> list[tuple]:
     """The decode tier's complete compile-shape set, from geometry alone
-    (no engine, no params): one prefill signature per ladder bucket plus
-    exactly one decode-step signature.  Signed through
+    (no engine, no params): one prefill signature per chunk-ladder rung
+    (``prefill_chunks``; or per prompt bucket via ``prefill_buckets``
+    in legacy mode), exactly one decode-step signature, and one COW
+    page-copy signature when ``share_prefixes``.  Signed through
     ``shapes.signature`` on ``ShapeDtypeStruct`` specs — identical to
     what the runtime hands ``serving.note_compile``, which is the
     zero-new-signatures test's whole claim."""
@@ -1009,16 +1595,28 @@ def enumerate_signatures(*, max_seqs: int, pages_per_seq: int,
     from tensorflowonspark_tpu import shapes
 
     i32 = np.dtype(np.int32)
+    S, P = int(max_seqs), int(pages_per_seq)
     sigs = []
-    for b in prefill_buckets:
-        sigs.append(shapes.signature({
-            "tokens": jax.ShapeDtypeStruct((int(b),), i32),
-            "prompt_len": jax.ShapeDtypeStruct((), i32)}))
+    if prefill_chunks:
+        for rung in prefill_chunks:
+            sigs.append(shapes.signature({
+                "tokens": jax.ShapeDtypeStruct((S, int(rung)), i32),
+                "start_lens": jax.ShapeDtypeStruct((S,), i32),
+                "chunk_lens": jax.ShapeDtypeStruct((S,), i32),
+                "page_tables": jax.ShapeDtypeStruct((S, P), i32)}))
+    else:
+        for b in prefill_buckets or ():
+            sigs.append(shapes.signature({
+                "tokens": jax.ShapeDtypeStruct((int(b),), i32),
+                "prompt_len": jax.ShapeDtypeStruct((), i32)}))
     sigs.append(shapes.signature({
-        "tokens": jax.ShapeDtypeStruct((int(max_seqs),), i32),
-        "seq_lens": jax.ShapeDtypeStruct((int(max_seqs),), i32),
-        "page_tables": jax.ShapeDtypeStruct(
-            (int(max_seqs), int(pages_per_seq)), i32)}))
+        "tokens": jax.ShapeDtypeStruct((S,), i32),
+        "seq_lens": jax.ShapeDtypeStruct((S,), i32),
+        "page_tables": jax.ShapeDtypeStruct((S, P), i32)}))
+    if share_prefixes:
+        sigs.append(shapes.signature({
+            "src": jax.ShapeDtypeStruct((), i32),
+            "dst": jax.ShapeDtypeStruct((), i32)}))
     return sigs
 
 

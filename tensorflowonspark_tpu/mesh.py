@@ -814,6 +814,12 @@ class MeshRouter:
             lat = _fleet.merge_family_hists(
                 (w or {}).get("histograms"),
                 "online_request_seconds") or {}
+            # decode replicas carry a paged-KV residency block in their
+            # admission doc; surface the occupancy signal (unique
+            # physical pages — prefix sharing already netted out) so the
+            # fleet view shows KV pressure next to byte saturation
+            kv = adm.get("kv")
+            kv = kv if isinstance(kv, dict) else {}
             doc = {
                 "state": state,
                 "scrape": scrape_health.get(rid),
@@ -821,6 +827,14 @@ class MeshRouter:
                 "capacity_bytes": self.capacity_bytes,
                 "window": None,
                 "saturation": adm.get("saturation"),
+                "kv": ({
+                    "pages_used": kv.get("pages_used"),
+                    "pages_total": kv.get("pages_total"),
+                    "pages_shared": kv.get("pages_shared"),
+                    "occupancy": kv.get("occupancy"),
+                    "bytes_resident": kv.get("bytes_resident"),
+                    "invariant_ok": (kv.get("invariant") or {}).get("ok"),
+                } if kv else None),
                 "compile_cache": (health or {}).get("compile_cache"),
             }
             if w is not None:

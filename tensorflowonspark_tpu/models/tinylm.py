@@ -169,6 +169,98 @@ def prefill_fn(params, tokens, prompt_len, k_pool, v_pool, page_table,
     return jnp.argmax(logits).astype(jnp.int32), k_pool, v_pool
 
 
+def prefill_chunk_fn(params, tokens, start_lens, chunk_lens, k_pool,
+                     v_pool, page_tables, *, config: Config,
+                     page_size: int):
+    """Prefill ONE page-aligned chunk for SEVERAL sequences at once —
+    the fixed-shape multi-sequence prefill step (`C` chunk rows ×
+    `L` tokens; `Ctx = P * page_size` gathered context positions).
+
+    - ``tokens``: ``(C, L)`` int32, each row the next ``chunk_lens[c]``
+      prompt tokens of one sequence, zero-padded to the ladder rung L;
+    - ``start_lens``: ``(C,)`` int32, how much of each row's sequence is
+      already in the cache (prior chunks and/or shared prefix pages) —
+      row c's token t sits at global position ``start_lens[c] + t``;
+    - ``chunk_lens``: ``(C,)`` int32, valid tokens per row (0 for idle
+      rows); padded/idle positions write to the trash page and their
+      outputs are garbage the engine never reads;
+    - ``page_tables``: ``(C, P)`` int32, each ROW'S OWN table — rows
+      from different requests may map the same physical pages
+      (prefix sharing); shared pages are only ever read here, writes
+      land in each row's private pages by the engine's COW discipline.
+
+    Returns ``(next_tokens (C,), k_pool, v_pool)`` where
+    ``next_tokens[c]`` is the argmax at the row's LAST valid position —
+    meaningful only when this chunk completes the prompt (the engine
+    uses it as the first generated token then, discards it otherwise).
+
+    KV at position t depends only on tokens ``0..t``, so chunked
+    computation is exact: the gather reads prior positions from the
+    pool (written by earlier chunks or shared pages) and this chunk's
+    own positions from the writes a few lines above, masked causally at
+    ``j <= start + t`` — identical math to :func:`prefill_fn` position
+    for position, which is what makes chunked + shared-prefix decode
+    token-exact against the per-prompt baseline.
+    """
+    import jax.numpy as jnp
+
+    C, L = tokens.shape
+    P = page_tables.shape[1]
+    Ctx = P * page_size
+    scale = 1.0 / np.sqrt(config.head_dim)
+    t_idx = jnp.arange(L)[None, :]                      # (1, L)
+    pos = start_lens[:, None] + t_idx                   # (C, L) global pos
+    valid = t_idx < chunk_lens[:, None]                 # (C, L)
+    pos_c = jnp.minimum(pos, config.max_len - 1)
+    # padded tails route their writes to the trash page explicitly
+    pages = jnp.where(
+        valid,
+        jnp.take_along_axis(page_tables, pos_c // page_size, axis=1), 0)
+    offs = pos_c % page_size
+    # valid context for row c, token t = positions 0..start+t inclusive
+    # (this chunk's own K/V is written below, before the gather)
+    mask = jnp.arange(Ctx)[None, None, :] <= pos_c[:, :, None]  # (C, L, Ctx)
+    x = params["embed"][tokens] + params["pos"][pos_c]
+    for i in range(config.n_layers):
+        ln1, wq, wk, wv, wo, ln2, w1, w2 = (params[n]
+                                            for n in _layer_names(i))
+        h = _rms(x, ln1)
+        q = jnp.einsum("ctd,dhk->cthk", h, wq)
+        k = jnp.einsum("ctd,dhk->cthk", h, wk)
+        v = jnp.einsum("ctd,dhk->cthk", h, wv)
+        k_pool = k_pool.at[i, pages, offs].set(k)
+        v_pool = v_pool.at[i, pages, offs].set(v)
+        kg = k_pool[i][page_tables].reshape(C, Ctx, *k_pool.shape[3:])
+        vg = v_pool[i][page_tables].reshape(C, Ctx, *v_pool.shape[3:])
+        s = jnp.einsum("cthk,cshk->chts", q, kg) * scale
+        s = jnp.where(mask[:, None], s, -1e30)
+        w = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+        w = w / jnp.sum(w, axis=-1, keepdims=True)
+        o = jnp.einsum("chts,cshk->cthk", w, vg)
+        x = x + jnp.einsum("cthk,hkd->ctd", o, wo)
+        h = _rms(x, ln2)
+        x = x + jnp.maximum(h @ w1, 0.0) @ w2
+    # only each row's last valid position matters (it predicts the next
+    # token when the chunk completes a prompt); idle rows read t=0 garbage
+    last = jnp.maximum(chunk_lens - 1, 0)
+    xl = jnp.take_along_axis(x, last[:, None, None].repeat(
+        x.shape[-1], axis=-1), axis=1)[:, 0]
+    logits = _rms(xl, params["lnf"]) @ params["embed"].T
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pool, v_pool
+
+
+def copy_page_fn(k_pool, v_pool, src, dst):
+    """Copy ONE physical page ``src → dst`` across every layer of both
+    pools — the copy-on-write step.  ``src``/``dst`` are traced ``()``
+    int32 scalars, so every page copy shares the one compiled signature
+    (the decode tier's zero-new-signatures invariant extends to COW)."""
+    import jax
+
+    ks = jax.lax.dynamic_index_in_dim(k_pool, src, axis=1, keepdims=False)
+    vs = jax.lax.dynamic_index_in_dim(v_pool, src, axis=1, keepdims=False)
+    return k_pool.at[:, dst].set(ks), v_pool.at[:, dst].set(vs)
+
+
 def decode_fn(params, tokens, seq_lens, k_pool, v_pool, page_tables,
               *, config: Config, page_size: int):
     """One decode step for EVERY slot at once — the fixed-shape batched

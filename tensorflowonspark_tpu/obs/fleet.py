@@ -1056,6 +1056,13 @@ def check_fleet(collector: FleetCollector, *,
         if headroom >= headroom_warn:
             continue
         adm = admission_of(rid)
+        # decode replicas publish paged-KV residency next to saturation
+        # (the placement-by-KV-bytes signal): the capacity finding carries
+        # it so an autoscaler sees byte pressure AND page pressure in one
+        # document.  bytes_resident counts UNIQUE physical pages — the
+        # prefix-sharing win is already netted out.
+        kv = adm.get("kv")
+        kv = kv if isinstance(kv, dict) else {}
         capacity.append({
             "finding": "fleet.capacity",
             "replica": rid,
@@ -1065,6 +1072,8 @@ def check_fleet(collector: FleetCollector, *,
             "pending_bytes": adm.get("pending_bytes"),
             "max_pending_bytes": adm.get("max_pending_bytes"),
             "saturation": adm.get("saturation"),
+            "kv_bytes_resident": kv.get("bytes_resident"),
+            "kv_occupancy": kv.get("occupancy"),
         })
 
     # -- compile-cache effectiveness (fleet cold-start visibility) ----------

@@ -98,12 +98,14 @@ STAGE_VERDICT = {
     "update": "device_bound",
     "emit": "emit_bound",
     "reply": "emit_bound",
-    # generative decode plane: prefill (prompt ingestion, one sequence at
-    # a time) and decode (the batched token step over every active slot)
-    # are SEPARATE phases with separate economics — a prefill_bound tier
-    # needs a longer ladder or chunked prefill, a decode_bound tier needs
-    # more slots per step — so they classify apart
+    # generative decode plane: prefill (prompt ingestion — the chunked
+    # multi-sequence step, or one sequence per call in legacy mode) and
+    # decode (the batched token step over every active slot) are
+    # SEPARATE phases with separate economics — a prefill_bound tier
+    # needs a smaller chunk budget or a longer ladder, a decode_bound
+    # tier needs more slots per step — so they classify apart
     "prefill": "prefill_bound",
+    "prefill_chunk": "prefill_bound",
     "decode": "decode_bound",
 }
 

@@ -91,6 +91,8 @@ EVENT_TYPES = frozenset({
     "compile_cache.spool",    # entries pushed to the shared namespace
     # decode slot lifecycle (decode.py)
     "decode.admit",           # pending request admitted to a slot
+    "decode.prefill",         # prompt fully in cache, first token out
+    "decode.cow_copy",        # shared page copied before divergent write
     "decode.retire",          # slot retired (ok / error)
     "decode.cancel",          # cancelled mid-stream
     # the journal's own lifecycle

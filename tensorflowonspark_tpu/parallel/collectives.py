@@ -74,12 +74,17 @@ Composition contract (everything the monolithic step supports):
   sharded 1/N over *all* data axes (strictly finer than ZeRO's
   fsdp-only split), under the all-reduce structure it keeps the
   inherited ZeRO layout.
-- **elementwise optimizer transforms only** on the sharded-update path:
-  the in-region update sees each replica's 1/N parameter slice, which is
+- **elementwise optimizer transforms** on the sharded-update path: the
+  in-region update sees each replica's 1/N parameter slice, which is
   exact for per-element transforms (Adam/AdamW/SGD/momentum — the
-  ``optax`` default here) but would silently compute *shard-local* norms
-  for global-reduction transforms (``clip_by_global_norm``).  Set
-  ``TFOS_SHARDED_UPDATE=0`` for such optimizer chains.
+  ``optax`` default here).  The one global-reduction transform serving
+  needs — global-norm clipping — is built in: ``clip_global_norm=``
+  computes the norm as each replica's shard-local square-sum combined
+  across the world by the same reduce-scatter + all-gather primitive as
+  the stats exchange (no all-reduce op enters the HLO), then scales
+  exactly as ``optax.clip_by_global_norm`` would, BEFORE the 1/N
+  update.  Other global-reduction transforms still need
+  ``TFOS_SHARDED_UPDATE=0``.
 - **model-parallel meshes opt out cleanly**: ``tp``/``sp``/``pp``/``ep``
   collectives live *inside* the model (GSPMD constraints, ring attention,
   GPipe) and do not compose with a data-axis manual region, so those
@@ -147,9 +152,11 @@ def bucketing_enabled() -> bool:
 
 def sharded_update_enabled() -> bool:
     """``TFOS_SHARDED_UPDATE`` gate, default ON: reduce-scatter buckets
-    with the in-region 1/N optimizer update.  Turn OFF for optimizer
-    chains with cross-param global reductions (``clip_by_global_norm``) —
-    see the module docstring's composition contract."""
+    with the in-region 1/N optimizer update.  Global-norm clipping no
+    longer needs this turned off — pass ``clip_global_norm=`` and the
+    norm is computed as sharded partials combined by reduce-scatter +
+    all-gather (module docstring's composition contract).  Turn OFF only
+    for optimizer chains with *other* cross-param global reductions."""
     return os.environ.get("TFOS_SHARDED_UPDATE", "1").strip().lower() \
         not in ("0", "false", "no")
 
@@ -479,6 +486,7 @@ def make_bucketed_train_step(
     update_shard: bool | None = None,
     mesh_config=None,
     scatter_min_bytes: int | None = None,
+    clip_global_norm: float | None = None,
 ):
     """Compile the bucketed-collective ``state, batch -> state, loss`` step.
 
@@ -496,6 +504,15 @@ def make_bucketed_train_step(
     - ``scatter_min_bytes``: scatter-eligibility size floor (default
       ``train.zero_min_bytes()`` — the shared ``TFOS_ZERO_MIN_BYTES``
       knob);
+    - ``clip_global_norm``: optional global-norm gradient clip applied
+      before the optimizer update, exact ``optax.clip_by_global_norm``
+      semantics.  On the sharded-update path each replica's
+      scatter-eligible gradient shards tile the full gradient, so the
+      cross-replica sum of shard square-sums (one extra scalar
+      reduce-scatter + all-gather — no all-reduce op enters the HLO)
+      plus the replicated leaves' square-sum is the exact global square
+      norm; clipped optimizers keep the reduce-scatter path instead of
+      needing ``TFOS_SHARDED_UPDATE=0``;
     - ``reduce=False`` compiles the *no-reduce* twin — identical graph
       minus the per-bucket gradient collectives — used by ``bench.py`` to
       measure the compute-only floor an overlap fraction is judged
@@ -707,6 +724,33 @@ def make_bucketed_train_step(
                 else:
                     p_list.append(p_leaves[i])
                     g_list.append(full_grads[i])
+            if clip_global_norm is not None:
+                # global-norm clip on sharded gradients: eligible leaves'
+                # shards are disjoint row blocks tiling the full (already
+                # cross-replica-averaged) gradient, so summing their
+                # square-sums across the world — via the same rs+ag
+                # primitive as the stats exchange, never an all-reduce —
+                # plus the replicated leaves' square-sum (identical on
+                # every replica, added once) is the exact global square
+                # norm optax.clip_by_global_norm would see
+                zero = jnp.float32(0.0)
+                shard_sq = sum(
+                    (jnp.sum(jnp.square(g_list[i]))
+                     for i in range(len(param_leaves)) if eligible[i]),
+                    zero)
+                repl_sq = sum(
+                    (jnp.sum(jnp.square(g_list[i]))
+                     for i in range(len(param_leaves)) if not eligible[i]),
+                    zero)
+                total_sq = repl_sq + _rs_ag_sum(
+                    shard_sq.reshape(1), 1).reshape(())
+                g_norm = jnp.sqrt(total_sq)
+                c = jnp.float32(clip_global_norm)
+                g_list = [
+                    jnp.where(g_norm < c, g,
+                              (g / g_norm.astype(g.dtype)) * c)
+                    for g in g_list
+                ]
             g_tree = jax.tree_util.tree_unflatten(param_treedef, g_list)
             p_tree = jax.tree_util.tree_unflatten(param_treedef, p_list)
             updates, new_opt = optimizer.update(g_tree, opt_state, p_tree)
@@ -781,13 +825,20 @@ def make_bucketed_train_step(
             loss, new_cols, reduced = smapped(
                 st.params, st.collections, batch)
             grads = jax.tree_util.tree_unflatten(param_treedef, list(reduced))
+            import optax
+
+            if clip_global_norm is not None:
+                # full reduced gradients are in hand here, so the stock
+                # optax transform gives the reference clip semantics
+                grads, _ = optax.clip_by_global_norm(
+                    float(clip_global_norm)).update(
+                        grads, optax.EmptyState())
             # one optax call, per-leaf dataflow: each param's update/apply
             # depends only on its own bucket's reduction (plus the scalar
             # count), so XLA schedules bucket i's weight update behind
             # bucket i's all-reduce while later buckets are still reducing
             updates, opt_state = optimizer.update(
                 grads, st.opt_state, st.params)
-            import optax
 
             params = optax.apply_updates(st.params, updates)
             return TrainState(params, opt_state, st.step + 1, new_cols), loss
@@ -804,6 +855,7 @@ def make_bucketed_train_step(
     step.comm_bytes = comm_bytes
     step.data_world = world
     step.update_sharded = update_shard
+    step.clip_global_norm = clip_global_norm
     step.n_scatter_buckets = kinds.count("scatter") if update_shard else 0
     step.n_replicated_buckets = kinds.count("repl") if update_shard else 0
     step.n_stats_segments = n_stats_segments if update_shard else 0

@@ -337,6 +337,7 @@ def make_train_step(
     collection_shardings=None,
     bucketed: bool | None = None,
     mesh_config=None,
+    clip_global_norm: float | None = None,
 ):
     """Compile ``state, batch -> state, loss`` over the mesh.
 
@@ -364,6 +365,16 @@ def make_train_step(
     per interconnect tier on multi-slice topologies — the ``Mesh`` object
     itself does not record how its axes map onto ICI vs DCN.
 
+    ``clip_global_norm`` clips gradients to that global norm before the
+    optimizer update (``optax.clip_by_global_norm`` semantics) on EVERY
+    step structure, including the sharded-update bucketed step — where
+    the norm is computed as sharded partials combined by reduce-scatter
+    + all-gather, so clipped optimizers no longer need
+    ``TFOS_SHARDED_UPDATE=0``.  Prefer this over wrapping ``optimizer``
+    in ``optax.chain(optax.clip_by_global_norm(...), ...)``: the chain
+    changes the opt-state structure and silently computes shard-local
+    norms on the sharded path.
+
     The returned step always carries ``.bucketed`` so callers (trainer
     flight attribution, bench) can see which structure compiled.
     """
@@ -390,7 +401,8 @@ def make_train_step(
                 loss_fn, optimizer, mesh, param_shardings, state,
                 batch_example, sequence_axes=sequence_axes, donate=donate,
                 collection_shardings=collection_shardings,
-                mesh_config=mesh_config)
+                mesh_config=mesh_config,
+                clip_global_norm=clip_global_norm)
         if bucketed:
             raise ValueError(f"bucketed train step unavailable: {reason}")
         logger.debug("monolithic train step (%s)", reason)
@@ -403,9 +415,12 @@ def make_train_step(
         else:
             loss, grads = jax.value_and_grad(loss_fn)(st.params, batch)
             new_cols = st.collections
-        updates, opt_state = optimizer.update(grads, st.opt_state, st.params)
         import optax
 
+        if clip_global_norm is not None:
+            grads, _ = optax.clip_by_global_norm(
+                float(clip_global_norm)).update(grads, optax.EmptyState())
+        updates, opt_state = optimizer.update(grads, st.opt_state, st.params)
         params = optax.apply_updates(st.params, updates)
         return TrainState(params, opt_state, st.step + 1, new_cols), loss
 
@@ -413,6 +428,7 @@ def make_train_step(
                         sequence_axes=sequence_axes, donate=donate,
                         collection_shardings=collection_shardings)
     step.bucketed = False
+    step.clip_global_norm = clip_global_norm
     return step
 
 

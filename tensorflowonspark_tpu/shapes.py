@@ -242,6 +242,50 @@ def prefill_buckets(max_prompt_len: int, *, min_bucket: int = 8,
     return tuple(out)
 
 
+def prefill_chunks(max_prompt_len: int, page_size: int, *,
+                   max_chunk: int | None = None) -> tuple[int, ...]:
+    """The CHUNKED-prefill ladder: page-aligned chunk lengths the decode
+    tier compiles its multi-sequence prefill step at.
+
+    Chunked prefill splits every prompt into page-aligned chunks and
+    packs chunks from several requests into one jitted call of fixed
+    ``(chunks, chunk_len)`` geometry — ``chunk_len`` must come from this
+    ladder, so prefill compiles once per RUNG and a long prompt advances
+    at most ``max_chunk`` tokens per engine step (the TTFT bound: decode
+    steps interleave between chunks, so a long prompt cannot monopolize
+    the loop).  Page alignment is load-bearing twice over: a chunk
+    boundary always lands on a page boundary (so a chunk never
+    half-fills a page another chunk must append to mid-call), and the
+    prefix-sharing registry maps whole pages, so shared prefixes compose
+    with chunk boundaries without remapping.
+
+    Rungs are power-of-two multiples of ``page_size`` (``ps, 2ps, 4ps,
+    ...``) up to the terminal rung: the page-aligned cover of
+    ``max_prompt_len``, capped at ``max_chunk`` rounded DOWN to a page
+    multiple (never below one page).  Pure arithmetic — no env, no
+    device state — so every process derives the identical ladder from
+    the same config, same as :func:`prefill_buckets`.
+    """
+    max_prompt_len = int(max_prompt_len)
+    page_size = int(page_size)
+    if max_prompt_len < 1:
+        raise ValueError(f"max_prompt_len must be >= 1, got {max_prompt_len}")
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    cover = -(-max_prompt_len // page_size) * page_size
+    top = cover
+    if max_chunk is not None:
+        budget = max(page_size, (int(max_chunk) // page_size) * page_size)
+        top = min(top, budget)
+    out: list[int] = []
+    rung = page_size
+    while rung < top:
+        out.append(rung)
+        rung <<= 1
+    out.append(top)
+    return tuple(out)
+
+
 def batch_rows(batch: Mapping[str, Any]) -> int:
     """The batch's paddable row count: the leading dimension EVERY
     ``ndim >= 1`` input shares — that shared dimension is what makes it a

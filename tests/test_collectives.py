@@ -765,3 +765,136 @@ def test_step_comm_model_attr_matches_module_fn():
     assert m["scatter_bytes"] + m["replicated_bytes"] == m["grad_bytes"]
     assert m["grad_bytes"] == step.comm_bytes
     assert 0 < m["exchange_ratio"] < 1
+
+
+# -- global-norm clipping (the lifted TFOS_SHARDED_UPDATE=0 carve-out) --------
+
+
+def test_clip_global_norm_matches_optax_chain():
+    """``clip_global_norm=`` on the monolithic step must reproduce the
+    stock ``optax.chain(clip_by_global_norm, adamw)`` step — pins our
+    manual clip to optax's exact definition (the ``(g / norm) * max``
+    scaling behind a ``norm < max`` trigger, no eps variant)."""
+    import optax
+
+    mesh = build_mesh(MeshConfig(dp=8))
+    clip = 1e-2
+    state_c, opt, shardings, loss_fn, batch = _toy_setup(mesh)
+    state_r, *_ = _toy_setup(mesh)
+    chained = optax.chain(optax.clip_by_global_norm(clip), optax.adamw(5e-2))
+    state_r = create_train_state(state_r.params, chained)
+    step_c = make_train_step(loss_fn, opt, mesh, shardings, state_c, batch,
+                             bucketed=False, clip_global_norm=clip)
+    step_r = make_train_step(loss_fn, chained, mesh, shardings, state_r,
+                             batch, bucketed=False)
+    assert step_c.clip_global_norm == clip
+    sharded = shard_batch(mesh, batch)
+    for _ in range(3):
+        state_c, loss_c = step_c(state_c, sharded)
+        state_r, loss_r = step_r(state_r, sharded)
+        np.testing.assert_allclose(float(loss_c), float(loss_r), **TOL)
+    for key in state_c.params:
+        np.testing.assert_allclose(np.asarray(state_c.params[key]),
+                                   np.asarray(state_r.params[key]),
+                                   err_msg=key, **TOL)
+
+
+def _assert_clip_matches(mesh, clip, zero=False, steps=5, update_shard=True):
+    """Clipped sharded-update (or all-reduce) bucketed step vs the clipped
+    monolithic step: same losses and params at the established tolerances."""
+    state_m, opt, shardings, loss_fn, batch = _toy_setup(mesh, zero=zero)
+    state_s, *_ = _toy_setup(mesh, zero=zero)
+    mono = make_train_step(loss_fn, opt, mesh, shardings, state_m, batch,
+                           bucketed=False, clip_global_norm=clip)
+    shard = make_bucketed_train_step(
+        loss_fn, opt, mesh, shardings, state_s, batch, bucket_bytes=200,
+        update_shard=update_shard, scatter_min_bytes=128,
+        clip_global_norm=clip)
+    if update_shard:
+        assert shard.update_sharded and shard.n_scatter_buckets >= 1
+    assert shard.clip_global_norm == clip
+    sharded = shard_batch(mesh, batch)
+    for _ in range(steps):
+        state_m, loss_m = mono(state_m, sharded)
+        state_s, loss_s = shard(state_s, sharded)
+        np.testing.assert_allclose(float(loss_m), float(loss_s), **TOL)
+    for key in state_m.params:
+        np.testing.assert_allclose(np.asarray(state_m.params[key]),
+                                   np.asarray(state_s.params[key]),
+                                   err_msg=key, **TOL)
+    return state_s
+
+
+def test_sharded_clip_matches_monolithic_dp_only():
+    """The lifted carve-out, active regime: a clip small enough to fire
+    every step — the sharded-update step's rs+ag global norm must equal
+    the monolithic step's full-gradient norm."""
+    mesh = build_mesh(MeshConfig(dp=8))
+    clip = 1e-2
+    state_s = _assert_clip_matches(mesh, clip)
+    # the clip genuinely fired: an unclipped twin lands elsewhere
+    state_u, opt, shardings, loss_fn, batch = _toy_setup(mesh)
+    unclipped = make_bucketed_train_step(
+        loss_fn, opt, mesh, shardings, state_u, batch, bucket_bytes=200,
+        update_shard=True, scatter_min_bytes=128)
+    sharded = shard_batch(mesh, batch)
+    for _ in range(5):
+        state_u, _ = unclipped(state_u, sharded)
+    assert not np.allclose(np.asarray(state_s.params["emb"]),
+                           np.asarray(state_u.params["emb"]), **TOL)
+
+
+def test_sharded_clip_matches_monolithic_zero():
+    _assert_clip_matches(build_mesh(MeshConfig(dp=2, fsdp=4)), 1e-2,
+                         zero=True)
+
+
+def test_sharded_clip_inactive_regime():
+    """A threshold far above any real gradient norm: the clipped sharded
+    step must reduce to the unclipped one (the ``norm < max`` trigger
+    path, where the scale is exactly 1)."""
+    mesh = build_mesh(MeshConfig(dp=8))
+    state_c, opt, shardings, loss_fn, batch = _toy_setup(mesh)
+    state_u, *_ = _toy_setup(mesh)
+    clipped = make_bucketed_train_step(
+        loss_fn, opt, mesh, shardings, state_c, batch, bucket_bytes=200,
+        update_shard=True, scatter_min_bytes=128, clip_global_norm=1e6)
+    unclipped = make_bucketed_train_step(
+        loss_fn, opt, mesh, shardings, state_u, batch, bucket_bytes=200,
+        update_shard=True, scatter_min_bytes=128)
+    sharded = shard_batch(mesh, batch)
+    for _ in range(3):
+        state_c, loss_c = clipped(state_c, sharded)
+        state_u, loss_u = unclipped(state_u, sharded)
+        np.testing.assert_allclose(float(loss_c), float(loss_u), **TOL)
+    for key in state_c.params:
+        np.testing.assert_allclose(np.asarray(state_c.params[key]),
+                                   np.asarray(state_u.params[key]),
+                                   err_msg=key, **TOL)
+
+
+def test_allreduce_path_clip_matches_monolithic():
+    """update_shard=False keeps full gradients outside the region, so the
+    clip there is the stock optax transform — still must match."""
+    _assert_clip_matches(build_mesh(MeshConfig(dp=8)), 1e-2,
+                         update_shard=False)
+
+
+def test_clipped_sharded_step_hlo_has_no_allreduce():
+    """The point of the satellite: clipping must NOT knock the step off
+    the reduce-scatter path.  The norm's cross-replica sum rides one
+    extra scalar rs+ag segment; zero all-reduce ops in the module."""
+    mesh = build_mesh(MeshConfig(dp=8))
+    state, opt, shardings, loss_fn, batch = _toy_setup(mesh)
+    step = make_bucketed_train_step(loss_fn, opt, mesh, shardings, state,
+                                    batch, bucket_bytes=200,
+                                    update_shard=True, scatter_min_bytes=128,
+                                    clip_global_norm=1e-2)
+    counts = _hlo_counts(step, state, mesh, batch)
+    assert counts["all-reduce"] == 0, counts
+    n_segments = (step.n_scatter_buckets + step.n_replicated_buckets
+                  + step.n_stats_segments + 1)  # +1: the norm's rs+ag
+    assert counts["reduce-scatter"] == n_segments * step.n_tiers, \
+        (counts, n_segments)
+    assert counts["all-gather"] == n_segments * step.n_tiers, \
+        (counts, n_segments)

@@ -48,6 +48,7 @@ def make_engine():
         eng.stop()
         assert eng.pool.used_pages == 0, "leaked KV pages"
         assert eng.pool.free_pages == eng.num_pages - 1
+        eng.pool.check_invariant()  # conservation law holds at teardown
         assert eng.kv_pool_bytes == pool_bytes, "device pool grew"
         assert tuple(eng._kp.shape) == tinylm.kv_pool_shape(
             eng.config, eng.num_pages, eng.page_size)
@@ -151,8 +152,15 @@ def test_zero_new_signatures_after_warmup(make_engine):
     eng = make_engine()
     eng.warmup()
     enumerated = set(eng.enumerate_signatures())
-    # one signature per prefill bucket + exactly ONE for the decode step
-    assert len(enumerated) == len(eng.prefill_buckets) + 1
+    # one signature per chunk-ladder rung (default engine: chunked
+    # prefill) + exactly ONE decode step + ONE COW page copy; a legacy
+    # engine (prefill_chunk=0) enumerates per prompt bucket instead
+    if eng.chunked_prefill:
+        expected = (len(eng.prefill_chunks) + 1
+                    + (1 if eng.share_prefixes else 0))
+    else:
+        expected = len(eng.prefill_buckets) + 1
+    assert len(enumerated) == expected
     assert serving._SEEN_SHAPES[eng.cache_key] == enumerated
     eng.start()
     for i, p in enumerate(_prompts(6)):
@@ -263,7 +271,8 @@ def test_flight_plane_and_slo_windows(make_engine):
     for p in _prompts(3):
         eng.submit(p, max_new_tokens=6).result()
     snap = rec.snapshot()
-    assert snap["stages_s"].get("prefill", 0) > 0
+    prefill_stage = ("prefill_chunk" if eng.chunked_prefill else "prefill")
+    assert snap["stages_s"].get(prefill_stage, 0) > 0
     assert snap["stages_s"].get("decode", 0) > 0
     assert snap["verdict"] in flight.VERDICTS
     slo = eng.slo_snapshot()
@@ -315,7 +324,9 @@ def test_per_token_spans_on_retained_trace(make_engine, monkeypatch):
         time.sleep(0.01)
     assert entry is not None, "armed decode request was not retained"
     names = [s["name"] for s in entry["spans"]]
-    assert "prefill" in names and "queue" in names
+    # chunked engines trace one span PER prefill chunk; legacy one total
+    prefill_span = ("prefill_chunk" if eng.chunked_prefill else "prefill")
+    assert prefill_span in names and "queue" in names
     # per-token spans: one per generated token after the first
     assert names.count("token") == 5
     token_spans = [s for s in entry["spans"] if s["name"] == "token"]

@@ -1,0 +1,343 @@
+"""Chunked batched prefill + COW prefix sharing (ISSUE 19).
+
+The tentpole contracts: chunked multi-sequence prefill is token-exact
+against the legacy per-prompt prefill engine (same greedy continuations,
+token for token), prefix sharing maps common prompt prefixes onto
+refcounted read-only pages and COW-copies on first divergent write —
+including a divergence landing MID-page — steady-state serving mints
+zero jit signatures beyond the enumerated set (chunk-ladder rungs + one
+decode step + one COW copy), cumulative page allocation grows
+sub-linearly in shared-prefix requests, and the pool conservation law
+(``used + free + trash == num_pages``, refcounts never negative) holds
+at every teardown, including the cancel/stop chaos paths.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import decode, serving, shapes
+from tensorflowonspark_tpu.models import tinylm
+from tensorflowonspark_tpu.util import ensure_jax_platform
+
+ensure_jax_platform()
+
+CFG = tinylm.Config.tiny()
+
+
+@pytest.fixture
+def make_engine():
+    """Engine factory with the pool hygiene contract enforced at
+    teardown for EVERY engine (the test_decode pattern, plus the
+    refcount conservation law)."""
+    engines = []
+
+    def _make(**kw):
+        defaults = dict(max_seqs=4, page_size=8, max_len=64,
+                        max_prompt_len=24)
+        defaults.update(kw)
+        eng = decode.DecodeEngine(CFG, **defaults)
+        engines.append(eng)
+        return eng
+
+    yield _make
+    for eng in engines:
+        eng.stop()
+        assert eng.pool.used_pages == 0, "leaked KV pages"
+        assert eng.pool.shared_pages == 0
+        eng.pool.check_invariant()
+
+
+def _prompts(n, lo=3, hi=24, seed=7):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, CFG.vocab_size,
+                        size=(lo + (i * (hi - lo)) // max(1, n - 1),)
+                        ).astype(np.int32) for i in range(n)]
+
+
+def _family(prefix_len, tail_len, n, seed=11):
+    """``n`` prompts sharing an identical ``prefix_len``-token prefix
+    with distinct ``tail_len``-token tails."""
+    rng = np.random.RandomState(seed)
+    prefix = rng.randint(0, CFG.vocab_size, size=(prefix_len,))
+    return [np.concatenate([
+        prefix, rng.randint(0, CFG.vocab_size, size=(tail_len,))]
+    ).astype(np.int32) for _ in range(n)]
+
+
+# -- ladder + pool units ------------------------------------------------------
+
+
+def test_prefill_chunks_ladder():
+    assert shapes.prefill_chunks(24, 8, max_chunk=16) == (8, 16)
+    assert shapes.prefill_chunks(24, 8) == (8, 16, 24)
+    assert shapes.prefill_chunks(8, 8) == (8,)
+    assert shapes.prefill_chunks(5, 8) == (8,)  # page-aligned cover
+    # max_chunk rounds DOWN to a page multiple, never below one page
+    assert shapes.prefill_chunks(100, 8, max_chunk=20) == (8, 16)
+    assert shapes.prefill_chunks(100, 8, max_chunk=3) == (8,)
+    assert shapes.prefill_chunks(64, 16, max_chunk=64) == (16, 32, 64)
+    with pytest.raises(ValueError):
+        shapes.prefill_chunks(0, 8)
+    with pytest.raises(ValueError):
+        shapes.prefill_chunks(8, 0)
+
+
+def test_pool_refcounts_make_shared_double_free_impossible():
+    """The satellite-1 claim: two holders of one physical page each
+    release their OWN reference — the page frees exactly once, and a
+    release nobody holds still raises loudly."""
+    pool = decode.PagedKVPool(6)
+    pages = pool.alloc(2)
+    pool.share(pages)  # second holder maps the same physical pages
+    assert pool.shared_pages == 2 and pool.logical_pages == 4
+    assert pool.used_pages == 2  # unique physical pages, not references
+    pool.free(pages)  # holder A releases: pages stay resident
+    assert pool.used_pages == 2 and pool.shared_pages == 0
+    pool.free(pages)  # holder B releases: now they return
+    assert pool.used_pages == 0 and pool.free_pages == 5
+    with pytest.raises(ValueError):
+        pool.free(pages)  # a reference nobody holds is a real bug
+    pool.check_invariant()
+
+
+def test_pool_duplicate_free_validated_before_mutation():
+    pool = decode.PagedKVPool(4)
+    (p,) = pool.alloc(1)
+    with pytest.raises(ValueError):
+        pool.free([p, p])  # two releases against one reference
+    # the failed free mutated NOTHING (no partial decrement)
+    assert pool.refcount(p) == 1 and pool.used_pages == 1
+    pool.free([p])
+    pool.check_invariant()
+
+
+def test_pool_share_validates_and_trash_page_protected():
+    pool = decode.PagedKVPool(4)
+    with pytest.raises(ValueError):
+        pool.share([0])  # the trash page is never shareable
+    with pytest.raises(ValueError):
+        pool.share([1])  # unallocated
+    pages = pool.alloc(1)
+    pool.share(pages)
+    pool.free(pages + pages)  # both references at once is fine
+    assert pool.invariant()["ok"]
+
+
+# -- token-exact equivalence --------------------------------------------------
+
+
+def test_chunked_prefill_token_exact_vs_legacy(make_engine):
+    """The tentpole equivalence: mixed prompt lengths through the
+    chunked multi-sequence prefill engine produce EXACTLY the tokens the
+    legacy per-prompt prefill engine produces."""
+    legacy = make_engine(prefill_chunk=0)
+    chunked = make_engine(prefill_chunk=16)
+    assert not legacy.chunked_prefill and chunked.chunked_prefill
+    legacy.start()
+    chunked.start()
+    prompts = _prompts(8, lo=1, hi=24)
+    want = [legacy.submit(p, max_new_tokens=8).result() for p in prompts]
+    got = [chunked.submit(p, max_new_tokens=8).result() for p in prompts]
+    assert got == want
+
+
+def test_shared_prefix_token_exact_and_subllinear_alloc(make_engine):
+    """Sequential same-prefix requests: every request after the first
+    hits the registry, output stays token-exact, and cumulative page
+    allocation grows sub-linearly (the unique-page claim)."""
+    legacy = make_engine(prefill_chunk=0)
+    chunked = make_engine(prefill_chunk=16)
+    legacy.start()
+    chunked.start()
+    prompts = _family(prefix_len=16, tail_len=4, n=6)
+    want = [legacy.submit(p, max_new_tokens=6).result() for p in prompts]
+    got = [chunked.submit(p, max_new_tokens=6).result() for p in prompts]
+    assert got == want
+    st = chunked.stats()
+    assert st["engine"]["prefix_registry"]["hits"] == len(prompts) - 1
+    kv = st["admission"]["kv"]
+    assert kv["prefix_hits_total"] >= len(prompts) - 1
+    assert kv["shared_pages_total"] >= 2 * (len(prompts) - 1)
+    # sub-linear unique-page growth: the shared 2-page prefix allocs once
+    assert chunked.pool.alloc_total < legacy.pool.alloc_total
+    assert kv["invariant"]["ok"]
+
+
+def test_prefix_diverging_mid_page_cow_copies(make_engine):
+    """The COW boundary case: the common prefix ends MID-page, so the
+    boundary page is mapped shared and must copy on the first divergent
+    write — and the outputs must still be token-exact."""
+    legacy = make_engine(prefill_chunk=0)
+    chunked = make_engine(prefill_chunk=16)
+    legacy.start()
+    chunked.start()
+    rng = np.random.RandomState(23)
+    base = rng.randint(0, CFG.vocab_size, size=(16,)).astype(np.int32)
+    # diverges at token 12: page 1 (tokens 8..15) is shared mid-page
+    fork = np.concatenate([
+        base[:12], rng.randint(0, CFG.vocab_size, size=(8,))]
+    ).astype(np.int32)
+    for p in (base, fork):
+        assert (chunked.submit(p, max_new_tokens=6).result()
+                == legacy.submit(p, max_new_tokens=6).result())
+    st = chunked.stats()
+    assert st["engine"]["prefix_registry"]["hits"] >= 1
+    assert st["admission"]["kv"]["cow_copies_total"] >= 1
+    # the registered base prefix is untouched by the fork's writes:
+    # a third request reusing the FULL base prompt is still exact
+    assert (chunked.submit(base, max_new_tokens=6).result()
+            == legacy.submit(base, max_new_tokens=6).result())
+
+
+def test_concurrent_shared_prefix_matches_sequential(make_engine):
+    """Same-prefix requests racing through the chunk packer land
+    token-identical to their sequential runs."""
+    eng = make_engine(max_seqs=4)
+    eng.start()
+    prompts = _family(prefix_len=16, tail_len=6, n=8, seed=31)
+    seq = [eng.submit(p, max_new_tokens=8).result() for p in prompts]
+    out = [None] * len(prompts)
+
+    def run(i):
+        out[i] = eng.submit(prompts[i], max_new_tokens=8).result()
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert out == seq
+
+
+# -- compile discipline -------------------------------------------------------
+
+
+def test_zero_new_signatures_with_sharing(make_engine):
+    """Chunked prefill + prefix sharing + COW under varied traffic mints
+    NOTHING beyond the enumerated set: one signature per chunk rung, one
+    decode step, one COW page copy."""
+    eng = make_engine(prefill_chunk=16)
+    eng.warmup()
+    enumerated = set(eng.enumerate_signatures())
+    assert len(enumerated) == len(eng.prefill_chunks) + 2
+    assert serving._SEEN_SHAPES[eng.cache_key] == enumerated
+    eng.start()
+    for p in _prompts(5, lo=1, hi=24):
+        eng.submit(p, max_new_tokens=4).result()
+    for p in _family(prefix_len=16, tail_len=4, n=4, seed=41):
+        eng.submit(p, max_new_tokens=4).result()  # hits + COW traffic
+    rng = np.random.RandomState(43)
+    base = rng.randint(0, CFG.vocab_size, size=(16,)).astype(np.int32)
+    eng.submit(base, max_new_tokens=3).result()
+    eng.submit(np.concatenate([base[:12], [1, 2, 3]]).astype(np.int32),
+               max_new_tokens=3).result()  # mid-page COW
+    assert serving._SEEN_SHAPES[eng.cache_key] == enumerated
+    assert eng.stats()["admission"]["kv"]["cow_copies_total"] >= 1
+
+
+def test_legacy_mode_forces_sharing_off(make_engine):
+    """prefill_chunk=0 keeps the legacy per-prompt prefill, whose writes
+    start at position 0 — sharing MUST be off there (it would mutate
+    registered pages), whatever the env/ctor says."""
+    eng = make_engine(prefill_chunk=0, share_prefixes=True)
+    assert not eng.chunked_prefill and not eng.share_prefixes
+    sigs = eng.enumerate_signatures()
+    assert len(sigs) == len(eng.prefill_buckets) + 1  # no COW signature
+
+
+# -- chaos / invariant --------------------------------------------------------
+
+
+def test_cancel_mid_prefill_frees_shared_and_exclusive_pages(make_engine):
+    """Cancelling while chunked prefill is still advancing (long prompts,
+    one-token chunks force many prefill steps) must release exactly the
+    references held — shared AND exclusive — with other generations
+    untouched and the conservation law intact."""
+    eng = make_engine(max_seqs=2, prefill_chunk=8)
+    eng.start()
+    prompts = _family(prefix_len=16, tail_len=8, n=6, seed=53)
+    eng.submit(prompts[0], max_new_tokens=2).result()  # register prefix
+    survivors = []
+    for i, p in enumerate(prompts[1:]):
+        s = eng.submit(p, max_new_tokens=12)
+        if i % 2:
+            s.cancel()  # often lands mid-prefill (3 chunk steps each)
+        else:
+            survivors.append((p, s))
+    want = [eng.submit(p, max_new_tokens=12).result()
+            for p, _ in survivors]
+    assert [s.result(timeout=60) for _, s in survivors] == want
+    deadline = time.time() + 10
+    while eng.pool.used_pages and time.time() < deadline:
+        time.sleep(0.01)
+    eng.pool.check_invariant()
+    assert eng.stats()["admission"]["kv"]["invariant"]["ok"]
+
+
+def test_stop_mid_flight_keeps_invariant(make_engine):
+    """stop() with shared-prefix requests still in flight (the SIGKILL
+    analogue the engine can see) fails them loudly AND leaves the pool
+    conserving: teardown's check_invariant() is the assertion."""
+    eng = make_engine(max_seqs=2, prefill_chunk=8)
+    eng.start()
+    streams = [eng.submit(p, max_new_tokens=30)
+               for p in _family(prefix_len=16, tail_len=8, n=5, seed=61)]
+    results = []
+
+    def consume(s):
+        try:
+            results.append(("ok", s.result(timeout=30)))
+        except Exception as e:
+            results.append(("err", type(e).__name__))
+
+    threads = [threading.Thread(target=consume, args=(s,))
+               for s in streams]
+    for t in threads:
+        t.start()
+    eng.stop()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(results) == 5
+    assert any(kind == "err" for kind, _ in results)
+    assert eng.pool.used_pages == 0
+    eng.pool.check_invariant()
+
+
+# -- heavy sweep --------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_mixed_workload_sweep_token_exact(make_engine):
+    """Heavy mixed workload: several prefix families + singletons, mixed
+    lengths, concurrent submission, chunked vs legacy token-exactness
+    over the whole set, sub-linear allocation, invariant at the end."""
+    legacy = make_engine(prefill_chunk=0, max_seqs=4)
+    chunked = make_engine(prefill_chunk=16, max_seqs=4)
+    legacy.start()
+    chunked.start()
+    prompts = []
+    for fam in range(4):
+        prompts += _family(prefix_len=16, tail_len=3 + fam, n=6,
+                           seed=100 + fam)
+    prompts += _prompts(16, lo=1, hi=24, seed=200)
+    want = [legacy.submit(p, max_new_tokens=10).result() for p in prompts]
+    got = [None] * len(prompts)
+
+    def run(i):
+        got[i] = chunked.submit(prompts[i], max_new_tokens=10).result()
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert got == want
+    assert chunked.pool.alloc_total < legacy.pool.alloc_total
+    st = chunked.stats()
+    assert st["admission"]["kv"]["invariant"]["ok"]
+    assert st["engine"]["prefix_registry"]["hits"] >= 3

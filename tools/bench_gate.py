@@ -165,6 +165,28 @@ in [-1, 1], a ``costs_skew_detect_s`` within the judged budget of
 ``costs_goodput_breakdown`` whose phase sum reconciles to the measured
 training wall within the flight tolerance.
 
+From round ``--require-decode-prefill-from`` (default 21, the round
+that introduced chunked batched prefill + copy-on-write prefix sharing
+on the paged decode tier) the primary half must carry
+``decode_prefill_short_ttft_ms_p99`` — the short-prompt time-to-first-
+token p99 under a mixed short/long + shared-prefix workload on the
+chunked engine — or an explicit ``null`` + ``decode_prefill_reason``.
+``decode_prefill_output_equality`` of ``"fail"`` FAILS the artifact
+outright — a chunked prefill whose decoded tokens diverged from the
+per-prompt engine's is broken, not fast.  A numeric p99 must carry its
+config identity (prompt mix, shared-prefix length/volume, chunk
+ladder, page/slot geometry, model, device/CPU counts), a PASSING
+equality check, and the page-allocation A/B
+(``decode_prefill_alloc_pages`` vs ``..._baseline`` plus
+``decode_prefill_page_savings_frac`` — the sub-linear unique-pages
+claim); the TTFT p99 is regression-gated LOWER-is-better within that
+identity.  ``decode_prefill_short_ttft_speedup`` may be ``null`` only
+with a ``decode_prefill_short_ttft_speedup_reason`` — a compute-bound
+single-device host pays real FLOPs for the packed fixed-shape prefill
+geometry that a dispatch-bound accelerator gets for ~one slot's
+dispatch cost, so the TTFT claim is not measurable there while the
+sharing and equality claims still are.
+
 Usage::
 
     python tools/bench_gate.py                  # repo-root BENCH_r*.json
@@ -241,6 +263,11 @@ DEFAULT_REQUIRE_COLLECTIVES_FROM = 19
 #: microbench (``costs_conservation_ratio``, introduced with the
 #: per-tenant cost ledger + training goodput breakdown)
 DEFAULT_REQUIRE_COSTS_FROM = 20
+#: first round whose primary half must carry the chunked-prefill +
+#: prefix-sharing microbench (``decode_prefill_short_ttft_ms_p99``,
+#: introduced with chunked batched prefill + COW prefix sharing on the
+#: paged decode tier)
+DEFAULT_REQUIRE_DECODE_PREFILL_FROM = 21
 #: |stage_sum / wall - 1| beyond this fails the artifact: a breakdown that
 #: does not add up is decoration, not attribution
 DEFAULT_FLIGHT_TOLERANCE = 0.15
@@ -336,6 +363,21 @@ _COLLECTIVES_IDENT_KEYS = ("collectives_platform", "collectives_devices",
                            "collectives_dcn_world", "collectives_model",
                            "collectives_grad_mb", "collectives_bucket_mb",
                            "collectives_update_shard")
+_DECODE_PREFILL_KEY = "decode_prefill_short_ttft_ms_p99"
+#: the chunked-prefill microbench's config identity: short-prompt TTFT
+#: p99 and the page-allocation A/B are only comparable at the same
+#: prompt mix (short/long lengths, shared-prefix length and volume),
+#: chunk ladder, page/slot geometry, model geometry AND device/CPU
+#: counts — a packed prefill over a different chunk rung or prompt mix
+#: is a different experiment
+_DECODE_PREFILL_IDENT_KEYS = (
+    "decode_prefill_clients", "decode_prefill_requests",
+    "decode_prefill_shared_requests", "decode_prefill_max_new_tokens",
+    "decode_prefill_prompt_lens", "decode_prefill_prefix_len",
+    "decode_prefill_chunk", "decode_prefill_chunks",
+    "decode_prefill_model", "decode_prefill_page_size",
+    "decode_prefill_max_seqs", "decode_prefill_devices",
+    "decode_prefill_host_cpus")
 _COSTS_KEY = "costs_conservation_ratio"
 #: the cost-accounting microbench's config identity: the ledger's
 #: overhead and the skew detection latency are only comparable at the
@@ -468,7 +510,8 @@ def validate_half(half: dict[str, Any], *,
                   require_fleet: bool = False,
                   require_incident: bool = False,
                   require_collectives: bool = False,
-                  require_costs: bool = False) -> list[str]:
+                  require_costs: bool = False,
+                  require_decode_prefill: bool = False) -> list[str]:
     """Schema problems of one measured result (a wrapper's half)."""
     problems = []
     for key in _REQUIRED_HALF_KEYS:
@@ -773,6 +816,72 @@ def validate_half(half: dict[str, Any], *,
                         f"{lkey} {p99} exceeds {slo_key} {slo}: a "
                         "tokens/sec claimed at an SLO it missed is not "
                         "a measurement")
+    # chunked-prefill + COW prefix-sharing microbench: host-side like
+    # the decode one, so a degraded-accelerator round still owes it;
+    # null + 'decode_prefill_reason' always satisfies.  A numeric
+    # short-prompt TTFT p99 must carry its config identity, a PASSING
+    # token-level equality check against the per-prompt engine, and its
+    # page-allocation A/B (the sub-linear unique-pages claim); the TTFT
+    # speedup may be null only WITH a
+    # 'decode_prefill_short_ttft_speedup_reason' — a compute-bound
+    # single-device host pays real FLOPs for the packed fixed-shape
+    # geometry a dispatch-bound accelerator gets for ~one slot's cost
+    if require_decode_prefill or _DECODE_PREFILL_KEY in half:
+        if half.get("decode_prefill_output_equality") == "fail":
+            # judged FIRST: a diverged chunked prefill also stamps a
+            # null headline + reason, and that legitimate-looking null
+            # must not launder broken sharing into a passing artifact
+            problems.append(
+                "decode_prefill_output_equality is 'fail': chunked "
+                "prefill with prefix sharing decoded different tokens "
+                "than per-prompt prefill — broken, not fast; the "
+                "artifact fails")
+        if _DECODE_PREFILL_KEY not in half:
+            problems.append(
+                f"missing {_DECODE_PREFILL_KEY!r} (chunked-prefill "
+                "microbench is part of the schema from r21: measure it "
+                "or stamp an explicit null + 'decode_prefill_reason')")
+        elif half[_DECODE_PREFILL_KEY] is None \
+                and "decode_prefill_reason" not in half:
+            problems.append(
+                f"{_DECODE_PREFILL_KEY!r} is null without a "
+                "'decode_prefill_reason'")
+        elif isinstance(half.get(_DECODE_PREFILL_KEY), (int, float)):
+            missing = [k for k in _DECODE_PREFILL_IDENT_KEYS
+                       if k not in half]
+            if missing:
+                problems.append(
+                    f"{_DECODE_PREFILL_KEY!r} without its config "
+                    f"identity ({', '.join(missing)}) — short-prompt "
+                    "TTFT is only comparable within one "
+                    "mix/chunk/page/slot/device config")
+            if "decode_prefill_reason" not in half:
+                # a reason (e.g. wall budget exhausted after the
+                # chunked pass) waives the A/B partner requirements —
+                # the raw chunked numbers still stand on their own
+                if half.get("decode_prefill_output_equality") != "pass":
+                    problems.append(
+                        "decode_prefill_output_equality is "
+                        f"{half.get('decode_prefill_output_equality')!r}"
+                        ": a chunked+shared prefill whose tokens were "
+                        "not verified equal to per-prompt prefill's is "
+                        "broken, not fast")
+                for pkey in ("decode_prefill_alloc_pages",
+                             "decode_prefill_alloc_pages_baseline",
+                             "decode_prefill_page_savings_frac"):
+                    if not isinstance(half.get(pkey), (int, float)):
+                        problems.append(
+                            f"{_DECODE_PREFILL_KEY!r} without a "
+                            f"numeric '{pkey}' — the sharing claim is "
+                            "only meaningful against the per-prompt "
+                            "page allocation A/B'd in the same run")
+                if half.get("decode_prefill_short_ttft_speedup") is None \
+                        and "decode_prefill_short_ttft_speedup_reason" \
+                        not in half:
+                    problems.append(
+                        "'decode_prefill_short_ttft_speedup' is null "
+                        "without a "
+                        "'decode_prefill_short_ttft_speedup_reason'")
     # fleet-observability microbench: host-side multi-process like the
     # mesh one, so a degraded-accelerator round still owes it; null +
     # 'fleet_reason' always satisfies.  A numeric overhead must be a
@@ -1235,7 +1344,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
          require_fleet_from: int = DEFAULT_REQUIRE_FLEET_FROM,
          require_incident_from: int = DEFAULT_REQUIRE_INCIDENT_FROM,
          require_collectives_from: int = DEFAULT_REQUIRE_COLLECTIVES_FROM,
-         require_costs_from: int = DEFAULT_REQUIRE_COSTS_FROM
+         require_costs_from: int = DEFAULT_REQUIRE_COSTS_FROM,
+         require_decode_prefill_from: int = DEFAULT_REQUIRE_DECODE_PREFILL_FROM
          ) -> dict[str, Any]:
     """Run the gate over a trajectory; returns the verdict document."""
     checks: list[dict[str, Any]] = []
@@ -1297,6 +1407,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                           and art["n"] >= require_collectives_from)
             require_ct = (label == "primary"
                           and art["n"] >= require_costs_from)
+            require_dp = (label == "primary"
+                          and art["n"] >= require_decode_prefill_from)
             for problem in validate_half(half, require_roofline=require_rf,
                                          require_feed=require_fd,
                                          require_serving=require_sv,
@@ -1310,7 +1422,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                                          require_fleet=require_fo,
                                          require_incident=require_in,
                                          require_collectives=require_co,
-                                         require_costs=require_ct):
+                                         require_costs=require_ct,
+                                         require_decode_prefill=require_dp):
                 check(f"schema:{name}:{label}",
                       "fail" if is_newest else "warn", problem)
             # flight breakdowns ride the primary half with the microbench
@@ -1508,6 +1621,34 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                               f"{round(lval / lprior[0], 4)}× the best "
                               f"prior {lprior[0]}ms ({lprior[1]}) — the "
                               f"decode tail slowed beyond 1/{threshold}")
+            # chunked-prefill short-prompt TTFT: host-side, a latency,
+            # LOWER is better within its own mix/chunk/page/slot/device
+            # identity — a prefill packer that buys page sharing with a
+            # slower first token is a regression, not a win
+            if isinstance(half.get(_DECODE_PREFILL_KEY), (int, float)):
+                pprior = _comparable_prior_hostside(
+                    artifacts, newest, half, _DECODE_PREFILL_KEY,
+                    _DECODE_PREFILL_IDENT_KEYS, better=min)
+                pname = f"regression:{_DECODE_PREFILL_KEY}"
+                pval = float(half[_DECODE_PREFILL_KEY])
+                if pprior is None:
+                    check(pname, "pass",
+                          "no comparable prior chunked-prefill "
+                          "measurement (same mix/chunk/page/slot/device "
+                          "config) — nothing to regress against")
+                elif pval * threshold <= pprior[0]:
+                    check(pname, "pass",
+                          f"{pval}ms vs best prior {pprior[0]}ms "
+                          f"({pprior[1]}): ratio "
+                          f"{round(pval / pprior[0], 4)} ≤ "
+                          f"{round(1 / threshold, 4)}")
+                else:
+                    check(pname, "fail",
+                          f"{pval}ms is "
+                          f"{round(pval / pprior[0], 4)}× the best "
+                          f"prior {pprior[0]}ms ({pprior[1]}) — the "
+                          "short-prompt first token slowed beyond "
+                          f"1/{threshold}")
             # compile-cache cold start: host-side, judged before the
             # degraded skip; LOWER is better (it is a latency), same
             # contract as recovery_seconds
@@ -1656,6 +1797,8 @@ def main(argv: list[str] | None = None) -> int:
                    default=DEFAULT_REQUIRE_COLLECTIVES_FROM)
     p.add_argument("--require-costs-from", type=int,
                    default=DEFAULT_REQUIRE_COSTS_FROM)
+    p.add_argument("--require-decode-prefill-from", type=int,
+                   default=DEFAULT_REQUIRE_DECODE_PREFILL_FROM)
     args = p.parse_args(argv)
     paths = args.paths or discover(args.repo)
     if not paths:
@@ -1679,7 +1822,8 @@ def main(argv: list[str] | None = None) -> int:
                require_fleet_from=args.require_fleet_from,
                require_incident_from=args.require_incident_from,
                require_collectives_from=args.require_collectives_from,
-               require_costs_from=args.require_costs_from)
+               require_costs_from=args.require_costs_from,
+               require_decode_prefill_from=args.require_decode_prefill_from)
     print(json.dumps(doc))
     return 1 if doc["verdict"] == "fail" else 0
 
