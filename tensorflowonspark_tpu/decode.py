@@ -47,15 +47,55 @@ mid-page maps the boundary page too; the first divergent write triggers
 a page COPY (``tinylm.copy_page_fn``, one fixed jit signature) into a
 private page before the write lands — shared pages are never mutated.
 
+**Speculative multi-token decoding.**  With ``TFOS_SPEC_TOKENS >= 1``
+(or ``spec_tokens=``) the single-token step is replaced by a
+propose/verify loop: a cheap DRAFTER proposes up to ``k`` tokens per
+sequence (``TFOS_SPEC_DRAFTER``: ``ngram`` — host-side prompt-lookup,
+no second model, the default; ``model`` — a smaller ``tinylm`` config
+sharing the vocab, shadow-caching into its own pools through the SAME
+page tables; ``none`` — no drafts, the sampling-capable single-token
+baseline), then ONE jitted verify forward (``tinylm.verify_fn``) scores
+all ``k+1`` positions per slot in a fixed ``(max_seqs, k+1)`` call and
+the longest agreeing draft prefix is accepted — each step emits between
+1 and ``k+1`` tokens.  Greedy mode is TOKEN-FOR-TOKEN identical to the
+single-token engine (acceptance is exact argmax equality, position for
+position), which is what keeps the bench equality-gated.  Rejected
+drafts roll back by rewinding the slot's write cursor (``seq_lens``) —
+pure host bookkeeping: speculative writes only ever land in the slot's
+own reserved pages (never in registry-shared pages, which cover only
+full PROMPT prefixes; any COW-pending boundary page resolves through
+``_cow_resolve`` before the step writes), and a rejected position's
+stale KV is masked until the next step overwrites it.  An adaptive
+controller halves ``k`` down a pre-warmed ``shapes.spec_ladder`` when
+the windowed acceptance rate goes cold and restores it when it
+recovers — every rung compiles at warmup, so ``k`` moves without
+minting a signature.
+
+**Seeded real sampling.**  Requests may carry :class:`SamplingParams`
+(temperature / top-k / top-p / seed); sampling runs host-side in the
+verify step (and on the prefill logits for the first token) under a
+per-request seeded RNG keyed by ABSOLUTE position
+(``default_rng([seed, position])`` — the fold-in discipline), so a
+request's token stream is deterministic and replayable across engine
+restarts and independent of slot placement.  Draft tokens pass through
+speculative REJECTION sampling (accept draft ``x`` with probability
+``p(x)``, else resample from ``p`` excluding ``x`` renormalized —
+exact for the deterministic drafters shipped here), which preserves the
+target distribution: speculation changes tokens-per-step, never the
+law of the stream.  Greedy requests (``temperature == 0``, the
+default) never touch the RNG and stay bit-exact.
+
 **One-compile decode.**  All decode-step shapes are fixed by the
 (slot, page) geometry — ``tokens (S,)``, ``seq_lens (S,)``,
 ``page_tables (S, P)`` — so sequence growth moves an integer, never a
 shape, and steady-state decode adds ZERO jit signatures after
 :meth:`DecodeEngine.warmup`: one per chunk-ladder rung (or prefill
-bucket in legacy mode), one decode step, one COW page copy.  All keyed
-through ``serving.note_compile`` like every other serving plane, so
-``compile counters == shapes`` stays assertable (the PR 13 invariant)
-and the fleet compile cache amortizes decode compiles too.
+bucket in legacy mode), one decode step (or one verify step per
+``shapes.spec_ladder`` rung with speculation on, plus the draft-model
+drafter's own chunk/decode/COW signatures), one COW page copy.  All
+keyed through ``serving.note_compile`` like every other serving plane,
+so ``compile counters == shapes`` stays assertable (the PR 13
+invariant) and the fleet compile cache amortizes decode compiles too.
 
 **Phases are separate flight stages.**  ``prefill_chunk`` (chunked
 prompt ingestion; ``prefill`` in legacy mode) and ``decode`` (the
@@ -63,7 +103,10 @@ batched token step) accumulate into the ``"decode"`` flight plane with
 their own verdicts (``prefill_bound`` / ``decode_bound``) — the two
 phases have different remedies (smaller chunk budget / more slots per
 step), so one ``compute`` bucket would hide the one fact an operator
-needs.
+needs.  With speculation on, the token step splits further into
+``speculate`` (drafting) and ``verify`` (the target forward): a
+``speculate_bound`` verdict means proposals cost more than they save —
+shrink ``k`` or switch drafter.
 
 **Streaming + SLOs.**  Tokens stream to callers as they are produced
 (:class:`DecodeStream`; chunked HTTP via :class:`DecodeHTTPServer` on
@@ -132,6 +175,16 @@ DEFAULT_PREFILL_CHUNK_PAGES = 2
 #: each entry pins its prefix pages until evicted, so the bound is a
 #: KV-memory bound too
 DEFAULT_PREFIX_REGISTRY_MAX = 32
+#: adaptive speculation controller: windowed acceptance below LOW
+#: halves ``k`` (one ladder rung down), above HIGH restores one rung —
+#: the hysteresis gap keeps a borderline drafter from thrashing the
+#: rung every window
+SPEC_ACCEPT_LOW = 0.35
+SPEC_ACCEPT_HIGH = 0.70
+#: acceptance window (seconds) and the minimum proposals it must hold
+#: before the controller acts — a cold START is not a cold DRAFTER
+SPEC_WINDOW_S = 30.0
+SPEC_WINDOW_MIN_PROPOSED = 16
 
 _DONE = object()
 _ENGINE_SEQ = itertools.count(1)
@@ -158,6 +211,76 @@ def prefix_share_enabled() -> bool:
 
     return os.environ.get("TFOS_PREFIX_SHARE", "1").strip().lower() \
         not in ("0", "false", "no", "off")
+
+
+class SamplingParams:
+    """Per-request sampling policy for the verify-path token choice.
+
+    ``temperature == 0`` (the default) is GREEDY: pure argmax, no RNG
+    drawn, bit-exact against the single-token engine.  With
+    ``temperature > 0`` the next token is sampled from the softmax of
+    ``logits / temperature``, optionally truncated to the ``top_k``
+    highest-probability tokens (0 = off) and/or the smallest nucleus
+    covering ``top_p`` probability mass (1.0 = off), renormalized.
+
+    ``seed`` keys a per-request RNG folded with the token's ABSOLUTE
+    position (``np.random.default_rng([seed, position])``), so the
+    stream is a pure function of (prompt, params, seed) — replayable
+    across engine restarts, independent of slot placement, batch
+    composition, and scheduling.  Sampling rides the speculative verify
+    path (it needs logits, which the argmax-only legacy decode step
+    never materializes host-side), so it requires ``spec_tokens >= 1``
+    — ``spec_drafter="none"`` gives sampling WITHOUT speculation.
+    """
+
+    __slots__ = ("temperature", "top_k", "top_p", "seed")
+
+    def __init__(self, temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: int = 0):
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
+        if self.temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, "
+                             f"got {self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+    def to_doc(self) -> dict[str, Any]:
+        return {"temperature": self.temperature, "top_k": self.top_k,
+                "top_p": self.top_p, "seed": self.seed}
+
+
+def _sampling_dist(logits: np.ndarray, sp: SamplingParams) -> np.ndarray:
+    """The target distribution ``p`` a sampling request draws from:
+    temperature-scaled softmax, then top-k / top-p truncation,
+    renormalized.  float64 host math — the distribution must be a
+    deterministic function of the float32 logits alone, never of batch
+    shape or device reduction order."""
+    z = np.asarray(logits, np.float64) / max(sp.temperature, 1e-6)
+    z = z - z.max()
+    p = np.exp(z)
+    p /= p.sum()
+    if sp.top_k and sp.top_k < p.shape[0]:
+        kth = np.sort(p)[-sp.top_k]
+        p = np.where(p >= kth, p, 0.0)
+        p /= p.sum()
+    if sp.top_p < 1.0:
+        order = np.argsort(-p, kind="stable")
+        keep = int(np.searchsorted(np.cumsum(p[order]),
+                                   sp.top_p - 1e-12) + 1)
+        mask = np.zeros(p.shape[0], bool)
+        mask[order[:keep]] = True
+        p = np.where(mask, p, 0.0)
+        p /= p.sum()
+    return p
 
 
 class PagedKVPool:
@@ -386,6 +509,309 @@ class _PrefixRegistry:
             self._pool.free(pages)
 
 
+class _SpecController:
+    """Windowed-acceptance adaptive controller over the speculation
+    ladder (``shapes.spec_ladder``): halves ``k`` when the drafter goes
+    cold, restores it one rung at a time when it recovers.
+
+    Every rung is compiled at warmup, so moving between rungs NEVER
+    mints a jit signature — the controller changes how much the engine
+    bets per step, not what it compiles.  The window clears on every
+    shift (fresh evidence at the new rung, no carried momentum) and the
+    controller refuses to act on fewer than
+    ``SPEC_WINDOW_MIN_PROPOSED`` windowed proposals — a cold start is
+    not a cold drafter.  Callers hold the engine lock.
+    """
+
+    __slots__ = ("ladder", "rung", "window_s", "shifts", "_samples")
+
+    def __init__(self, ladder: Sequence[int],
+                 window_s: float = SPEC_WINDOW_S):
+        self.ladder = tuple(int(k) for k in ladder)
+        if not self.ladder:
+            raise ValueError("empty speculation ladder")
+        self.rung = len(self.ladder) - 1  # start at the configured k
+        self.window_s = float(window_s)
+        self.shifts = 0
+        self._samples: list[tuple[float, int, int]] = []
+
+    @property
+    def k(self) -> int:
+        return self.ladder[self.rung]
+
+    def _trim(self, now: float) -> None:
+        cut = now - self.window_s
+        i = 0
+        for i, (ts, _, _) in enumerate(self._samples):
+            if ts >= cut:
+                break
+        else:
+            i = len(self._samples)
+        if i:
+            del self._samples[:i]
+
+    def acceptance(self, now: float | None = None) -> float | None:
+        """Windowed acceptance rate (accepted / proposed), ``None``
+        until anything was proposed in the window."""
+        self._trim(time.time() if now is None else now)
+        proposed = sum(p for _, p, _ in self._samples)
+        if not proposed:
+            return None
+        return round(sum(a for _, _, a in self._samples) / proposed, 4)
+
+    def note(self, proposed: int, accepted: int,
+             now: float | None = None) -> None:
+        now = time.time() if now is None else now
+        self._samples.append((now, int(proposed), int(accepted)))
+        self._trim(now)
+        total = sum(p for _, p, _ in self._samples)
+        if total < SPEC_WINDOW_MIN_PROPOSED:
+            return
+        rate = sum(a for _, _, a in self._samples) / total
+        if rate < SPEC_ACCEPT_LOW and self.rung > 0:
+            self.rung -= 1
+            self.shifts += 1
+            self._samples.clear()
+        elif rate > SPEC_ACCEPT_HIGH and self.rung < len(self.ladder) - 1:
+            self.rung += 1
+            self.shifts += 1
+            self._samples.clear()
+
+
+class _NullDrafter:
+    """The ``none`` drafter: proposes nothing, every step verifies one
+    position — the sampling-capable single-token engine (and the honest
+    non-speculative baseline the distribution test compares against)."""
+
+    kind = "none"
+
+    def warmup(self, engine: "DecodeEngine") -> None:
+        pass
+
+    def on_prefill_chunk(self, engine, tokens, starts, lens,
+                         tables) -> None:
+        pass
+
+    def on_cow(self, engine, src: int, dst: int) -> None:
+        pass
+
+    def propose_all(self, engine: "DecodeEngine",
+                    rows: "list[_DecodeRequest]",
+                    k: int) -> dict[int, list[int]]:
+        return {}
+
+
+class _NgramDrafter(_NullDrafter):
+    """Prompt-lookup / n-gram drafter: no second model, no device work.
+
+    For each sequence, find the most recent earlier occurrence of its
+    trailing n-gram (longest first, down to a single token) in its own
+    history (prompt + generated tokens) and propose the ``k`` tokens
+    that followed it.  Free to propose and wrong only at the price of a
+    rejected draft, it shines exactly where generation is repetitive —
+    extraction, templated output, the cycles tiny greedy models settle
+    into — and proposes NOTHING on novel text (an idle drafter, not a
+    cold one: the controller only weighs actual proposals).
+    """
+
+    kind = "ngram"
+    #: longest trailing n-gram tried first
+    max_ngram = 3
+
+    def propose_all(self, engine: "DecodeEngine",
+                    rows: "list[_DecodeRequest]",
+                    k: int) -> dict[int, list[int]]:
+        return {req.slot: self._propose_one(req.history, k)
+                for req in rows}
+
+    @classmethod
+    def _propose_one(cls, hist: list[int], k: int) -> list[int]:
+        L = len(hist)
+        for n in range(min(cls.max_ngram, L - 1), 0, -1):
+            pat = hist[-n:]
+            # most recent occurrence ENDING strictly before the last
+            # position (the trailing n-gram itself)
+            for i in range(L - 2, n - 2, -1):
+                if hist[i - n + 1: i + 1] == pat:
+                    return hist[i + 1: i + 1 + k]
+        return []
+
+
+class _ModelDrafter(_NullDrafter):
+    """Draft-model drafter: a smaller ``tinylm`` config sharing the
+    target's vocab proposes ``k`` tokens via ``k`` fixed-shape draft
+    decode steps per engine step.
+
+    The draft model shadow-caches into its OWN KV pools (sized by its
+    own head geometry) but through the target engine's page tables —
+    same page ids, same trash-page routing, same COW discipline — so
+    there is no second allocator to keep honest: the target pool's
+    refcount invariant covers both caches.  Every draft-side jit batch
+    uses ``draft_``-prefixed keys, so its signatures stay distinct from
+    the target's in the ``note_compile`` seen-set (dict key names are
+    part of ``shapes.signature``) and the zero-new-signatures invariant
+    extends over the drafter.
+    """
+
+    kind = "model"
+
+    def __init__(self, engine: "DecodeEngine", config=None, params=None,
+                 seed: int = 0):
+        import functools
+
+        import jax
+
+        from tensorflowonspark_tpu.models import tinylm
+
+        self.config = config or tinylm.Config.draft_for(engine.config)
+        if self.config.vocab_size != engine.config.vocab_size:
+            raise ValueError(
+                f"draft vocab {self.config.vocab_size} != target vocab "
+                f"{engine.config.vocab_size} — proposals must be target "
+                "tokens")
+        if self.config.max_len < engine.max_len:
+            raise ValueError(
+                f"draft max_len {self.config.max_len} < engine max_len "
+                f"{engine.max_len} — the shadow cache mirrors the "
+                "target's positions")
+        self._params = (params if params is not None
+                        else tinylm.init_params(self.config, seed=seed))
+        shape = tinylm.kv_pool_shape(self.config, engine.num_pages,
+                                     engine.page_size)
+        self._kp = jax.numpy.zeros(shape, jax.numpy.float32)
+        self._vp = jax.numpy.zeros(shape, jax.numpy.float32)
+        self.kv_pool_bytes = 2 * int(np.prod(shape)) * 4
+        self._chunk_jit = jax.jit(functools.partial(
+            tinylm.prefill_chunk_fn, config=self.config,
+            page_size=engine.page_size))
+        self._decode_jit = jax.jit(functools.partial(
+            tinylm.decode_fn, config=self.config,
+            page_size=engine.page_size))
+        self._copy_jit = jax.jit(tinylm.copy_page_fn)
+
+    def warmup(self, engine: "DecodeEngine") -> None:
+        from tensorflowonspark_tpu import serving
+
+        perf = time.perf_counter
+        S, P = engine.max_seqs, engine.pages_per_seq
+        for rung in engine.prefill_chunks:
+            tokens = np.zeros((S, rung), np.int32)
+            starts = np.zeros((S,), np.int32)
+            lens = np.zeros((S,), np.int32)
+            tables = np.zeros((S, P), np.int32)
+            fresh = serving.note_compile(
+                engine.cache_key,
+                {"draft_tokens": tokens, "draft_start_lens": starts,
+                 "draft_chunk_lens": lens, "draft_page_tables": tables})
+            t0 = perf()
+            lg, self._kp, self._vp = self._chunk_jit(
+                self._params, tokens, starts, lens, self._kp, self._vp,
+                tables)
+            np.asarray(lg)
+            if fresh:
+                serving.observe_compile_seconds(perf() - t0)
+        toks = np.zeros((S,), np.int32)
+        seqs = np.zeros((S,), np.int32)
+        tables = np.zeros((S, P), np.int32)
+        fresh = serving.note_compile(
+            engine.cache_key,
+            {"draft_tokens": toks, "draft_seq_lens": seqs,
+             "draft_page_tables": tables})
+        t0 = perf()
+        nts, self._kp, self._vp = self._decode_jit(
+            self._params, toks, seqs, self._kp, self._vp, tables)
+        np.asarray(nts)
+        if fresh:
+            serving.observe_compile_seconds(perf() - t0)
+        if engine.share_prefixes:
+            z = np.asarray(0, np.int32)
+            fresh = serving.note_compile(
+                engine.cache_key, {"draft_src": z, "draft_dst": z})
+            t0 = perf()
+            self._kp, self._vp = self._copy_jit(self._kp, self._vp, z, z)
+            self._kp.block_until_ready()
+            if fresh:
+                serving.observe_compile_seconds(perf() - t0)
+
+    def on_prefill_chunk(self, engine, tokens, starts, lens,
+                         tables) -> None:
+        """Mirror the target's prefill chunk into the shadow cache —
+        the draft model must hold its own K/V for every prompt position
+        before it can propose continuations."""
+        from tensorflowonspark_tpu import serving
+
+        t0 = time.perf_counter()
+        fresh = serving.note_compile(
+            engine.cache_key,
+            {"draft_tokens": tokens, "draft_start_lens": starts,
+             "draft_chunk_lens": lens, "draft_page_tables": tables})
+        lg, self._kp, self._vp = self._chunk_jit(
+            self._params, tokens, starts, lens, self._kp, self._vp,
+            tables)
+        np.asarray(lg)
+        if fresh:
+            serving.observe_compile_seconds(time.perf_counter() - t0)
+
+    def on_cow(self, engine, src: int, dst: int) -> None:
+        """Mirror a COW page copy: the shadow cache shares the target's
+        page tables, so a table swap there is a table swap here."""
+        from tensorflowonspark_tpu import serving
+
+        s = np.asarray(src, np.int32)
+        d = np.asarray(dst, np.int32)
+        t0 = time.perf_counter()
+        fresh = serving.note_compile(
+            engine.cache_key, {"draft_src": s, "draft_dst": d})
+        self._kp, self._vp = self._copy_jit(self._kp, self._vp, s, d)
+        if fresh:
+            serving.observe_compile_seconds(time.perf_counter() - t0)
+
+    def propose_all(self, engine: "DecodeEngine",
+                    rows: "list[_DecodeRequest]",
+                    k: int) -> dict[int, list[int]]:
+        """``k`` sequential fixed-shape draft decode calls over ALL
+        slots at once: each call proposes one more token per sequence.
+        Idle/prefilling slots ride along writing to the trash page
+        (zero table rows), exactly like the target decode step."""
+        from tensorflowonspark_tpu import serving
+
+        out: dict[int, list[int]] = {req.slot: [] for req in rows}
+        toks = engine._tokens.copy()
+        seqs = engine._seq_lens.copy()
+        for _ in range(k):
+            t0 = time.perf_counter()
+            fresh = serving.note_compile(
+                engine.cache_key,
+                {"draft_tokens": toks, "draft_seq_lens": seqs,
+                 "draft_page_tables": engine._ptables})
+            nts, self._kp, self._vp = self._decode_jit(
+                self._params, toks, seqs, self._kp, self._vp,
+                engine._ptables)
+            nts_np = np.asarray(nts)
+            if fresh:
+                serving.observe_compile_seconds(time.perf_counter() - t0)
+            for req in rows:
+                out[req.slot].append(int(nts_np[req.slot]))
+            toks = nts_np.copy()
+            seqs = seqs + 1
+        return out
+
+
+def make_drafter(engine: "DecodeEngine", kind: str, *, draft_config=None,
+                 draft_params=None, seed: int = 0) -> _NullDrafter:
+    """Drafter factory behind the one interface the engine speaks:
+    ``warmup`` / ``on_prefill_chunk`` / ``on_cow`` / ``propose_all``."""
+    if kind == "ngram":
+        return _NgramDrafter()
+    if kind == "model":
+        return _ModelDrafter(engine, config=draft_config,
+                             params=draft_params, seed=seed)
+    if kind == "none":
+        return _NullDrafter()
+    raise ValueError(f"unknown drafter kind {kind!r} "
+                     "(expected 'ngram', 'model', or 'none')")
+
+
 class _DecodeRequest:
     """One caller's generation: prompt in, streamed tokens out."""
 
@@ -394,11 +820,12 @@ class _DecodeRequest:
                  "t_submit_wall", "t_admit", "t_last", "ttft_s",
                  "max_itl_s", "error", "rt", "slot", "pages", "done",
                  "tenant", "prefill_pos", "start_pos", "shared_pages",
-                 "cow_index", "table")
+                 "cow_index", "table", "sampling", "history")
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
                  rt: "_trace.RequestTrace | None",
-                 tenant: str = "default"):
+                 tenant: str = "default",
+                 sampling: SamplingParams | None = None):
         self.tenant = tenant
         self.prompt = prompt
         self.prompt_len = int(prompt.shape[0])
@@ -426,6 +853,10 @@ class _DecodeRequest:
         self.shared_pages = 0         # prefix pages mapped for free
         self.cow_index: int | None = None  # table index pending COW
         self.table: np.ndarray | None = None  # this slot's page table
+        self.sampling = sampling  # None = greedy
+        # full token history (prompt + emitted) — the prompt-lookup
+        # drafter's search corpus; python ints, appended per emit
+        self.history: list[int] = [int(t) for t in prompt]
 
 
 class DecodeStream:
@@ -563,7 +994,12 @@ class DecodeEngine:
                  prefill_chunk: int | None = None,
                  share_prefixes: bool | None = None,
                  prefix_registry_max: int | None = None,
+                 spec_tokens: int | None = None,
+                 spec_drafter: str | None = None,
+                 draft_config=None, draft_params=None,
                  seed: int = 0):
+        import os
+
         import jax
 
         from tensorflowonspark_tpu import obs, shapes, util
@@ -625,13 +1061,36 @@ class DecodeEngine:
                                            DEFAULT_PREFIX_REGISTRY_MAX)
         self.prefix_registry_max = int(prefix_registry_max)
 
+        # speculative decoding geometry: the configured draft length
+        # (TFOS_SPEC_TOKENS; 0 = legacy single-token step) and the
+        # drafter kind (TFOS_SPEC_DRAFTER: ngram | model | none).
+        # Speculation rides the chunk scheduler's phase discipline
+        # (prefill-phase slots carry zero table rows so the verify
+        # step's writes for them land in trash), so it requires
+        # chunked prefill — the default mode
+        if spec_tokens is None:
+            spec_tokens = _env_int("TFOS_SPEC_TOKENS", 0)
+        self.spec_tokens = max(0, int(spec_tokens))
+        if self.spec_tokens and not self.chunked_prefill:
+            raise ValueError(
+                "speculative decoding requires chunked prefill "
+                "(spec_tokens >= 1 with prefill_chunk == 0)")
+        self.spec_ladder = (shapes.spec_ladder(self.spec_tokens)
+                            if self.spec_tokens else ())
+        if spec_drafter is None:
+            spec_drafter = os.environ.get(
+                "TFOS_SPEC_DRAFTER", "ngram").strip().lower() or "ngram"
+        self.spec_drafter = (str(spec_drafter)
+                             if self.spec_tokens else "off")
+
         # the note_compile identity: one per engine INSTANCE — the jitted
         # closures below are per-engine, so two engines with one shared
         # key would claim compiles==jit-keys while each pays its own
         self.cache_key = ("decode", model_name, self.max_seqs,
                           self.page_size, self.pages_per_seq,
                           self.prefill_buckets, self.prefill_chunks,
-                          self.share_prefixes, next(_ENGINE_SEQ))
+                          self.share_prefixes, self.spec_ladder,
+                          self.spec_drafter, next(_ENGINE_SEQ))
 
         pool_shape = tinylm.kv_pool_shape(self.config, self.num_pages,
                                           self.page_size)
@@ -658,6 +1117,19 @@ class DecodeEngine:
         self._decode_jit = jax.jit(functools.partial(
             tinylm.decode_fn, config=self.config,
             page_size=self.page_size))
+        self._verify_jit = jax.jit(functools.partial(
+            tinylm.verify_fn, config=self.config,
+            page_size=self.page_size))
+
+        # the drafter and the adaptive-k controller (speculation only);
+        # the model drafter allocates its shadow pools here, once
+        self._drafter = (make_drafter(self, self.spec_drafter,
+                                      draft_config=draft_config,
+                                      draft_params=draft_params,
+                                      seed=seed)
+                         if self.spec_tokens else None)
+        self._spec_ctl = (_SpecController(self.spec_ladder)
+                          if self.spec_tokens else None)
 
         # host-side slot state, mutated between jit calls (fixed shapes:
         # the arrays are reused, never reallocated)
@@ -750,29 +1222,56 @@ class DecodeEngine:
         self._shared_pages_g = obs.gauge(
             "decode_kv_pages_shared",
             "physical pages currently mapped by more than one holder")
+        self._spec_proposed_total = obs.counter(
+            "decode_spec_proposed_total",
+            "draft tokens proposed to the speculative verify step")
+        self._spec_accepted_total = obs.counter(
+            "decode_spec_accepted_total",
+            "draft tokens accepted by the verify step (the longest "
+            "agreeing prefix; acceptance/proposed is the drafter's "
+            "hit rate)")
+        self._spec_steps_total = obs.counter(
+            "decode_spec_steps_total",
+            "speculative verify steps run (each emits >= 1 token per "
+            "live sequence)")
+        self._spec_emitted_total = obs.counter(
+            "decode_spec_emitted_total",
+            "tokens emitted by speculative verify steps (accepted "
+            "drafts plus the bonus token each sequence mints per step)")
+        self._spec_k_g = obs.gauge(
+            "decode_spec_k",
+            "current adaptive draft length k (0 = speculation off)")
+        self._spec_k_g.set(self._spec_ctl.k if self._spec_ctl else 0)
 
     # -- shape policy --------------------------------------------------------
 
     def enumerate_signatures(self) -> list[tuple]:
         """The complete signature set this engine's runtime requests:
         one per chunk-ladder rung (or prefill bucket in legacy mode),
-        exactly ONE for the decode step, and one for the COW page copy
-        when prefix sharing is on — what :meth:`warmup` warms, and what
-        steady-state serving must not grow (asserted in tests via the
-        ``note_compile`` seen-set)."""
+        exactly ONE for the decode step — or, with speculation on, one
+        VERIFY signature per ``spec_ladder`` rung instead (the verify
+        path replaces the single-token step entirely) plus the
+        draft-model drafter's own chunk/decode/COW set — and one for
+        the COW page copy when prefix sharing is on.  What
+        :meth:`warmup` warms, and what steady-state serving must not
+        grow (asserted in tests via the ``note_compile`` seen-set)."""
         return enumerate_signatures(
             max_seqs=self.max_seqs, pages_per_seq=self.pages_per_seq,
             prefill_buckets=(None if self.chunked_prefill
                              else self.prefill_buckets),
             prefill_chunks=(self.prefill_chunks
                             if self.chunked_prefill else None),
-            share_prefixes=self.share_prefixes)
+            share_prefixes=self.share_prefixes,
+            spec_ladder=self.spec_ladder or None,
+            spec_drafter=(self.spec_drafter
+                          if self.spec_tokens else None))
 
     def warmup(self) -> None:
         """Compile every ladder shape now: each chunk rung (or prefill
         bucket in legacy mode; zero tokens through the trash page — no
-        allocation), the decode step, and the COW page copy when
-        sharing is on.  Counted through ``serving.note_compile`` so
+        allocation), the decode step — or with speculation on, every
+        verify rung plus the drafter's own set — and the COW page copy
+        when sharing is on.  Counted through ``serving.note_compile`` so
         compiles == jit keys holds, and run through the persistent
         compile cache's designated seeding path semantics (first call
         pays, fleet loads)."""
@@ -824,16 +1323,39 @@ class DecodeEngine:
                 int(nt)
                 if fresh:
                     serving.observe_compile_seconds(perf() - t0)
-        batch = {"tokens": self._tokens, "seq_lens": self._seq_lens,
-                 "page_tables": self._ptables}
-        fresh = serving.note_compile(self.cache_key, batch)
-        t0 = perf()
-        nts, self._kp, self._vp = self._decode_jit(
-            self._params, self._tokens, self._seq_lens, self._kp,
-            self._vp, self._ptables)
-        np.asarray(nts)
-        if fresh:
-            serving.observe_compile_seconds(perf() - t0)
+        if self.spec_tokens:
+            # a speculative engine never issues the single-token decode
+            # step — every rung of the verify ladder compiles instead
+            # (the adaptive controller only moves along these), then the
+            # drafter's own fixed set (none for host-side drafters)
+            for k in self.spec_ladder:
+                tokens = np.zeros((S, k + 1), np.int32)
+                seqs = np.zeros((S,), np.int32)
+                steps = np.zeros((S,), np.int32)
+                tables = np.zeros((S, P), np.int32)
+                fresh = serving.note_compile(
+                    self.cache_key,
+                    {"tokens": tokens, "seq_lens": seqs,
+                     "step_lens": steps, "page_tables": tables})
+                t0 = perf()
+                lg, self._kp, self._vp = self._verify_jit(
+                    self._params, tokens, seqs, steps, self._kp,
+                    self._vp, tables)
+                np.asarray(lg)
+                if fresh:
+                    serving.observe_compile_seconds(perf() - t0)
+            self._drafter.warmup(self)
+        else:
+            batch = {"tokens": self._tokens, "seq_lens": self._seq_lens,
+                     "page_tables": self._ptables}
+            fresh = serving.note_compile(self.cache_key, batch)
+            t0 = perf()
+            nts, self._kp, self._vp = self._decode_jit(
+                self._params, self._tokens, self._seq_lens, self._kp,
+                self._vp, self._ptables)
+            np.asarray(nts)
+            if fresh:
+                serving.observe_compile_seconds(perf() - t0)
         self._warmed = True
 
     # -- lifecycle -----------------------------------------------------------
@@ -890,9 +1412,17 @@ class DecodeEngine:
     def submit(self, prompt: Sequence[int] | np.ndarray,
                max_new_tokens: int = 16,
                trace_ctx: "_trace.TraceContext | None" = None,
-               tenant: str = "default") -> DecodeStream:
+               tenant: str = "default",
+               sampling: SamplingParams | None = None) -> DecodeStream:
         """Queue one generation; returns a :class:`DecodeStream` whose
         tokens arrive as the engine produces them.
+
+        ``sampling`` selects seeded real sampling for this request
+        (:class:`SamplingParams`); ``None`` — and temperature 0 — mean
+        greedy.  Non-greedy sampling needs the verify path's
+        full-position logits, so it requires a speculative engine
+        (``spec_tokens >= 1``; the ``"none"`` drafter gives sampling
+        without speculation).
 
         Raises ``ValueError`` for malformed prompts (empty, over the
         ladder, out-of-vocab ids, no room to generate) and
@@ -936,6 +1466,12 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt token ids must be in [0, "
                 f"{self.config.vocab_size})")
+        if (sampling is not None and not sampling.greedy
+                and not self.spec_tokens):
+            raise ValueError(
+                "sampling needs the verify path's per-position logits: "
+                "construct the engine with spec_tokens >= 1 (the "
+                "'none' drafter gives sampling without speculation)")
 
         rt = None
         if _trace.requests_enabled():
@@ -945,7 +1481,7 @@ class DecodeEngine:
                     "decode.request", ctx=trace_ctx,
                     prompt_len=plen, max_new_tokens=max_new_tokens)
         req = _DecodeRequest(prompt, max_new_tokens, rt,
-                             tenant=str(tenant))
+                             tenant=str(tenant), sampling=sampling)
         with self._cond:
             if not self._started or self._stopped:
                 raise RuntimeError("DecodeEngine is not serving "
@@ -1028,16 +1564,23 @@ class DecodeEngine:
                         self._prefill_one(req)
                 t1 = perf()
                 prefill_s = t1 - t0
+                spec_s = verify_s = decode_s = 0.0
                 if self._active - self._prefilling > 0:
-                    self._decode_step()
-                decode_s = perf() - t1
+                    if self.spec_tokens:
+                        spec_s, verify_s = self._spec_step()
+                    else:
+                        self._decode_step()
+                        decode_s = perf() - t1
             except Exception as e:  # a broken step must not wedge callers
                 self._errors_total.inc()
                 logger.warning("decode engine step failed: %r", e)
                 self._fail_all(e)
                 continue
-            if prefill_s or decode_s:
-                if chunked:
+            if prefill_s or decode_s or spec_s or verify_s:
+                if self.spec_tokens:
+                    rec.add(prefill_chunk=prefill_s, speculate=spec_s,
+                            verify=verify_s)
+                elif chunked:
                     rec.add(prefill_chunk=prefill_s, decode=decode_s)
                 else:
                     rec.add(prefill=prefill_s, decode=decode_s)
@@ -1158,6 +1701,10 @@ class DecodeEngine:
         if fresh:
             serving.observe_compile_seconds(time.perf_counter() - t0)
         self.pool.free([old])
+        if self._drafter is not None:
+            # the drafter's shadow cache shares this page table, so its
+            # copy of the page must move too (no-op for host drafters)
+            self._drafter.on_cow(self, old, new)
         req.pages[idx] = new
         req.table[idx] = new
         self._cow_copies_total.inc()
@@ -1212,6 +1759,11 @@ class DecodeEngine:
         dt = perf() - t0
         if fresh:
             serving.observe_compile_seconds(dt)
+        if self._drafter is not None:
+            # mirror the chunk into the drafter's shadow cache (no-op
+            # for host-side drafters) so its proposals see the prompt
+            self._drafter.on_prefill_chunk(self, tokens, starts, lens,
+                                           tables)
         from tensorflowonspark_tpu.obs import ledger as _ledger_mod
 
         _ledger_mod.get_ledger().charge_decode(
@@ -1226,12 +1778,17 @@ class DecodeEngine:
                 req.rt.add("prefill_chunk", dt / len(packed),
                            pos=pos, tokens=n, chunk_len=L)
             if req.prefill_pos >= req.prompt_len:
-                self._finish_prefill(req, int(nts_np[i]))
+                self._finish_prefill(req, nts_np[i])
 
-    def _finish_prefill(self, req: _DecodeRequest, tok: int) -> None:
+    def _finish_prefill(self, req: _DecodeRequest,
+                        logits_row: np.ndarray) -> None:
         """Prompt fully in cache: flip the slot into the decode phase
         (its real page table becomes decode-visible only now — see
-        ``_prefilling``) and emit the first generated token."""
+        ``_prefilling``) and emit the first generated token, chosen
+        from the prompt's last-position logits so sampling reaches it
+        too (host argmax of the row is bit-identical to the former
+        on-device argmax)."""
+        tok = self._choose_token(req, logits_row, req.prompt_len)
         slot = req.slot
         self._prefilling -= 1
         self._seq_lens[slot] = req.prompt_len
@@ -1353,6 +1910,154 @@ class DecodeEngine:
                     self.eos_id is not None and tok == self.eos_id):
                 self._retire(s, "ok", None)
 
+    def _spec_step(self) -> tuple[float, float]:
+        """One speculative engine step: the drafter proposes up to ``k``
+        tokens per decode-phase slot (host-side work — the *speculate*
+        flight stage), then ONE fixed-shape verify call scores all
+        ``k+1`` positions of every slot against the paged cache and
+        each slot keeps its longest agreeing prefix plus the one
+        correction token (the *verify* stage).
+
+        Rollback is pure host bookkeeping: the write cursor
+        (``_seq_lens``) advances only over accepted positions, so a
+        rejected draft's stale KV sits beyond every future read mask
+        until the next step overwrites it in place.  Draft writes land
+        exclusively in this slot's private pages — shared prefix pages
+        were COW-resolved before the call — so the pool invariant holds
+        across rejection.  Under greedy selection the emitted stream is
+        token-for-token the single-token engine's; with sampling on,
+        rejected drafts resample from the leftover distribution so the
+        target distribution is preserved exactly.
+
+        Returns ``(speculate_s, verify_s)`` for the flight recorder."""
+        from tensorflowonspark_tpu import serving
+
+        perf = time.perf_counter
+        t0 = perf()
+        rows = [r for r in self._slots
+                if r is not None and r.prefill_pos >= r.prompt_len]
+        if not rows:
+            return 0.0, 0.0
+        k = self._spec_ctl.k
+        # shared boundary pages must go private BEFORE draft positions
+        # write: post-prefill this is a no-op (prefill already resolved
+        # it), kept as defense-in-depth for the COW invariant
+        for req in rows:
+            self._cow_resolve(req)
+        proposals = self._drafter.propose_all(self, rows, k)
+        S, P = self.max_seqs, self.pages_per_seq
+        tokens = np.zeros((S, k + 1), np.int32)
+        step_lens = np.zeros((S,), np.int32)
+        drafts: dict[int, list[int]] = {}
+        proposed = 0
+        for req in rows:
+            s = req.slot
+            # clamp so full acceptance (d+1 emitted) never exceeds the
+            # request's max_new budget — the max write position n+d
+            # stays inside the admitted page reservation
+            room = max(0, req.max_new_tokens - req.generated - 1)
+            d = [int(t) for t in proposals.get(s, [])][:min(k, room)]
+            drafts[s] = d
+            tokens[s, 0] = self._tokens[s]
+            if d:
+                tokens[s, 1:1 + len(d)] = d
+            step_lens[s] = 1 + len(d)
+            proposed += len(d)
+        t1 = perf()
+        fresh = serving.note_compile(
+            self.cache_key,
+            {"tokens": tokens, "seq_lens": self._seq_lens,
+             "step_lens": step_lens, "page_tables": self._ptables})
+        lg, self._kp, self._vp = self._verify_jit(
+            self._params, tokens, self._seq_lens, step_lens, self._kp,
+            self._vp, self._ptables)
+        lg_np = np.asarray(lg)
+        jit_dt = perf() - t1
+        if fresh:
+            serving.observe_compile_seconds(jit_dt)
+        self._spec_steps_total.inc()
+        # prefill-phase slots rode the call with zero step_lens and a
+        # zero table row (trash writes); only decode-phase rows emit
+        accepted_total = 0
+        emissions: list[tuple[_DecodeRequest, list[int]]] = []
+        for req in rows:
+            s = req.slot
+            d = drafts[s]
+            n0 = int(self._seq_lens[s])
+            emitted: list[int] = []
+            for j in range(len(d) + 1):
+                tok = self._choose_token(
+                    req, lg_np[s, j], n0 + j + 1,
+                    d[j] if j < len(d) else None)
+                emitted.append(tok)
+                if j < len(d) and tok == d[j]:
+                    continue
+                break
+            if self.eos_id is not None and self.eos_id in emitted:
+                # the baseline stops at EOS; tokens past it were never
+                # generated there, so they don't count or get charged
+                emitted = emitted[:emitted.index(self.eos_id) + 1]
+            accepted_total += len(emitted) - 1
+            self._seq_lens[s] = n0 + len(emitted)
+            self._tokens[s] = emitted[-1]
+            emissions.append((req, emitted))
+        from tensorflowonspark_tpu.obs import ledger as _ledger_mod
+
+        _ledger_mod.get_ledger().charge_decode(
+            [(req.tenant, len(em)) for req, em in emissions], jit_dt,
+            compile_s=jit_dt if fresh else 0.0)
+        n_emitted = 0
+        for req, emitted in emissions:
+            for tok in emitted:
+                self._emit(req, tok)
+                n_emitted += 1
+                if req.generated >= req.max_new_tokens or (
+                        self.eos_id is not None and tok == self.eos_id):
+                    self._retire(req.slot, "ok", None)
+                    break
+        self._spec_proposed_total.inc(proposed)
+        self._spec_accepted_total.inc(accepted_total)
+        self._spec_emitted_total.inc(n_emitted)
+        if proposed:
+            # controller note under the stats lock: acceptance() readers
+            # come from stats/healthz threads
+            with self._lock:
+                self._spec_ctl.note(proposed, accepted_total)
+            self._spec_k_g.set(self._spec_ctl.k)
+        return t1 - t0, perf() - t1
+
+    def _choose_token(self, req: _DecodeRequest,
+                      logits_row: np.ndarray, position: int,
+                      draft_tok: int | None = None) -> int:
+        """Pick the next token from one position's logits.
+
+        Greedy (no sampling params, or temperature 0) is a plain
+        argmax — bit-identical to the single-token engine.  Sampling
+        derives its RNG from ``fold_in(seed, position)`` (the token's
+        ABSOLUTE position), so the stream replays identically across
+        engine restarts and is independent of how generation was split
+        into speculative steps.  A draft token goes through speculative
+        rejection sampling: accept it with probability ``p(draft)``,
+        otherwise resample from ``p`` with the draft excluded and
+        renormalized — which composes to exactly ``p`` for any
+        deterministic proposal, so sampling quality never depends on
+        the drafter."""
+        sp = req.sampling
+        if sp is None or sp.greedy:
+            return int(np.argmax(logits_row))
+        p = _sampling_dist(logits_row, sp)
+        rng = np.random.default_rng([sp.seed, int(position)])
+        if draft_tok is not None:
+            if rng.random() < p[draft_tok]:
+                return int(draft_tok)
+            q = p.copy()
+            q[draft_tok] = 0.0
+            tot = q.sum()
+            if tot <= 0.0:
+                return int(draft_tok)  # p was a point mass on the draft
+            return int(rng.choice(len(q), p=q / tot))
+        return int(rng.choice(len(p), p=p))
+
     def _emit(self, req: _DecodeRequest, tok: int) -> None:
         now = time.perf_counter()
         req.generated += 1
@@ -1385,6 +2090,7 @@ class DecodeEngine:
                            itl_ms=round(itl * 1000, 3))
         req.t_last = now
         self._tokens_total.inc()
+        req.history.append(int(tok))
         if not req.cancelled:
             req.queue.put(tok)
 
@@ -1470,6 +2176,12 @@ class DecodeEngine:
                 "window_s": SLO_WINDOW_S,
                 "samples": self._ttft_window.count(now),
                 "itl_samples": self._itl_window.count(now),
+                # windowed draft acceptance (None when speculation is
+                # off or nothing proposed lately): the fleet signal for
+                # a drafter gone cold on the live workload
+                "spec_acceptance_rate": (
+                    self._spec_ctl.acceptance(now)
+                    if self._spec_ctl is not None else None),
             }
 
     def stats(self) -> dict[str, Any]:
@@ -1531,6 +2243,15 @@ class DecodeEngine:
                 "max_len": self.max_len,
                 "max_prompt_len": self.max_prompt_len,
                 "warmed": self._warmed,
+                "spec": {
+                    "spec_tokens": self.spec_tokens,
+                    "drafter": self.spec_drafter,
+                    "ladder": list(self.spec_ladder),
+                    "k": (self._spec_ctl.k
+                          if self._spec_ctl is not None else 0),
+                    "shifts": (self._spec_ctl.shifts
+                               if self._spec_ctl is not None else 0),
+                },
             },
             "slo": slo,
             "admission": {
@@ -1568,6 +2289,16 @@ class DecodeEngine:
                         self._cow_copies_total.value),
                     "pages_allocated_total": self.pool.alloc_total,
                     "invariant": invariant,
+                    # speculative decode health rides the kv block the
+                    # mesh router already scrapes (fleet_summary lifts
+                    # spec_acceptance_rate / spec_k per replica)
+                    "spec_proposed_total": int(
+                        self._spec_proposed_total.value),
+                    "spec_accepted_total": int(
+                        self._spec_accepted_total.value),
+                    "spec_acceptance_rate": slo["spec_acceptance_rate"],
+                    "spec_k": (self._spec_ctl.k
+                               if self._spec_ctl is not None else 0),
                 },
             },
             "requests_total": int(self._requests_total.value),
@@ -1581,12 +2312,20 @@ class DecodeEngine:
 def enumerate_signatures(*, max_seqs: int, pages_per_seq: int,
                          prefill_buckets: Sequence[int] | None = None,
                          prefill_chunks: Sequence[int] | None = None,
-                         share_prefixes: bool = False) -> list[tuple]:
+                         share_prefixes: bool = False,
+                         spec_ladder: Sequence[int] | None = None,
+                         spec_drafter: str | None = None) -> list[tuple]:
     """The decode tier's complete compile-shape set, from geometry alone
     (no engine, no params): one prefill signature per chunk-ladder rung
     (``prefill_chunks``; or per prompt bucket via ``prefill_buckets``
-    in legacy mode), exactly one decode-step signature, and one COW
-    page-copy signature when ``share_prefixes``.  Signed through
+    in legacy mode), exactly one decode-step signature — or, when
+    ``spec_ladder`` is given, one VERIFY signature per ladder rung in
+    its place (a speculative engine never issues the single-token step;
+    the controller only moves along pre-declared rungs) — and one COW
+    page-copy signature when ``share_prefixes``.  A ``spec_drafter`` of
+    ``"model"`` adds the draft model's own fixed set: its chunk rungs,
+    its decode step, and its COW copy, all under ``draft_``-prefixed
+    keys so they sign distinctly from the target's.  Signed through
     ``shapes.signature`` on ``ShapeDtypeStruct`` specs — identical to
     what the runtime hands ``serving.note_compile``, which is the
     zero-new-signatures test's whole claim."""
@@ -1609,14 +2348,37 @@ def enumerate_signatures(*, max_seqs: int, pages_per_seq: int,
             sigs.append(shapes.signature({
                 "tokens": jax.ShapeDtypeStruct((int(b),), i32),
                 "prompt_len": jax.ShapeDtypeStruct((), i32)}))
-    sigs.append(shapes.signature({
-        "tokens": jax.ShapeDtypeStruct((S,), i32),
-        "seq_lens": jax.ShapeDtypeStruct((S,), i32),
-        "page_tables": jax.ShapeDtypeStruct((S, P), i32)}))
+    if spec_ladder:
+        for k in spec_ladder:
+            sigs.append(shapes.signature({
+                "tokens": jax.ShapeDtypeStruct((S, int(k) + 1), i32),
+                "seq_lens": jax.ShapeDtypeStruct((S,), i32),
+                "step_lens": jax.ShapeDtypeStruct((S,), i32),
+                "page_tables": jax.ShapeDtypeStruct((S, P), i32)}))
+    else:
+        sigs.append(shapes.signature({
+            "tokens": jax.ShapeDtypeStruct((S,), i32),
+            "seq_lens": jax.ShapeDtypeStruct((S,), i32),
+            "page_tables": jax.ShapeDtypeStruct((S, P), i32)}))
     if share_prefixes:
         sigs.append(shapes.signature({
             "src": jax.ShapeDtypeStruct((), i32),
             "dst": jax.ShapeDtypeStruct((), i32)}))
+    if spec_ladder and spec_drafter == "model":
+        for rung in prefill_chunks or ():
+            sigs.append(shapes.signature({
+                "draft_tokens": jax.ShapeDtypeStruct((S, int(rung)), i32),
+                "draft_start_lens": jax.ShapeDtypeStruct((S,), i32),
+                "draft_chunk_lens": jax.ShapeDtypeStruct((S,), i32),
+                "draft_page_tables": jax.ShapeDtypeStruct((S, P), i32)}))
+        sigs.append(shapes.signature({
+            "draft_tokens": jax.ShapeDtypeStruct((S,), i32),
+            "draft_seq_lens": jax.ShapeDtypeStruct((S,), i32),
+            "draft_page_tables": jax.ShapeDtypeStruct((S, P), i32)}))
+        if share_prefixes:
+            sigs.append(shapes.signature({
+                "draft_src": jax.ShapeDtypeStruct((), i32),
+                "draft_dst": jax.ShapeDtypeStruct((), i32)}))
     return sigs
 
 
@@ -1629,7 +2391,10 @@ class DecodeHTTPServer:
     """Stdlib HTTP front end over a :class:`DecodeEngine`.
 
     - ``POST /v1/generate`` — body ``{"prompt": [ids],
-      "max_new_tokens": n, "stream": bool?, "timeout_s": float?}``.
+      "max_new_tokens": n, "stream": bool?, "timeout_s": float?,
+      "temperature": float?, "top_k": int?, "top_p": float?,
+      "seed": int?}`` (the sampling quartet maps to
+      :class:`SamplingParams`; omitted → greedy).
       With ``stream`` (the default) the reply is newline-delimited JSON
       over ``Transfer-Encoding: chunked`` — one ``{"token": id,
       "index": i}`` line per generated token as it is produced, then a
@@ -1692,9 +2457,17 @@ class DecodeHTTPServer:
             max_new = int(doc.get("max_new_tokens", 16))
             stream = bool(doc.get("stream", True))
             timeout = min(float(doc.get("timeout_s", 60.0)), 300.0)
+            sp = None
+            if any(key in doc for key in
+                   ("temperature", "top_k", "top_p", "seed")):
+                sp = SamplingParams(
+                    temperature=float(doc.get("temperature", 0.0)),
+                    top_k=int(doc.get("top_k", 0)),
+                    top_p=float(doc.get("top_p", 1.0)),
+                    seed=int(doc.get("seed", 0)))
             ctx = _trace.parse_traceparent(headers.get("traceparent"))
             handle = engine.submit(prompt, max_new_tokens=max_new,
-                                   trace_ctx=ctx)
+                                   trace_ctx=ctx, sampling=sp)
         except Rejected as e:
             return (429, "application/json",
                     _json.dumps({"error": str(e),

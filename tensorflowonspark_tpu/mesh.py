@@ -834,6 +834,13 @@ class MeshRouter:
                     "occupancy": kv.get("occupancy"),
                     "bytes_resident": kv.get("bytes_resident"),
                     "invariant_ok": (kv.get("invariant") or {}).get("ok"),
+                    # speculative-decode health: the windowed acceptance
+                    # rate and the controller's current draft length —
+                    # a drafter gone cold (rate near 0, k pinned at the
+                    # ladder floor) is visible fleet-wide here, not
+                    # buried in one replica's /healthz
+                    "spec_acceptance_rate": kv.get("spec_acceptance_rate"),
+                    "spec_k": kv.get("spec_k"),
                 } if kv else None),
                 "compile_cache": (health or {}).get("compile_cache"),
             }

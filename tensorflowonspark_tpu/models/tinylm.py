@@ -64,6 +64,19 @@ class Config:
         return cls(vocab_size=64, dim=32, n_layers=2, n_heads=2,
                    head_dim=16, mlp_dim=64, max_len=64)
 
+    @classmethod
+    def draft_for(cls, target: "Config") -> "Config":
+        """A smaller config suitable as a speculative DRAFT model for
+        ``target``: same vocab (proposals must be target tokens) and the
+        same ``max_len`` (the draft cache mirrors the target's page
+        geometry), everything else halved — the cheap-proposer shape."""
+        return cls(vocab_size=target.vocab_size,
+                   dim=max(8, target.dim // 2), n_layers=1,
+                   n_heads=max(1, target.n_heads // 2),
+                   head_dim=max(8, target.head_dim // 2),
+                   mlp_dim=max(16, target.mlp_dim // 2),
+                   max_len=target.max_len, dtype=target.dtype)
+
 
 def _rms(x, scale, eps=1e-6):
     import jax.numpy as jnp
@@ -189,10 +202,13 @@ def prefill_chunk_fn(params, tokens, start_lens, chunk_lens, k_pool,
       (prefix sharing); shared pages are only ever read here, writes
       land in each row's private pages by the engine's COW discipline.
 
-    Returns ``(next_tokens (C,), k_pool, v_pool)`` where
-    ``next_tokens[c]`` is the argmax at the row's LAST valid position —
+    Returns ``(logits (C, V), k_pool, v_pool)`` where ``logits[c]`` is
+    the full next-token distribution at the row's LAST valid position —
     meaningful only when this chunk completes the prompt (the engine
-    uses it as the first generated token then, discards it otherwise).
+    argmaxes it host-side for greedy, samples from it for seeded
+    sampling requests, discards it otherwise; host ``np.argmax`` over
+    the same float32 row is bit-identical to the device argmax this
+    function used to return).
 
     KV at position t depends only on tokens ``0..t``, so chunked
     computation is exact: the gather reads prior positions from the
@@ -246,7 +262,7 @@ def prefill_chunk_fn(params, tokens, start_lens, chunk_lens, k_pool,
     xl = jnp.take_along_axis(x, last[:, None, None].repeat(
         x.shape[-1], axis=-1), axis=1)[:, 0]
     logits = _rms(xl, params["lnf"]) @ params["embed"].T
-    return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pool, v_pool
+    return logits.astype(jnp.float32), k_pool, v_pool
 
 
 def copy_page_fn(k_pool, v_pool, src, dst):
@@ -309,6 +325,79 @@ def decode_fn(params, tokens, seq_lens, k_pool, v_pool, page_tables,
         x = x + jnp.maximum(h @ w1, 0.0) @ w2
     logits = _rms(x, params["lnf"]) @ params["embed"].T
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pool, v_pool
+
+
+def verify_fn(params, tokens, seq_lens, step_lens, k_pool, v_pool,
+              page_tables, *, config: Config, page_size: int):
+    """Score ``k+1`` positions per slot in ONE fixed-shape call — the
+    speculative-decoding VERIFY step (`S` slots × `L = k+1` positions;
+    `Ctx = P * page_size` gathered context positions).
+
+    - ``tokens``: ``(S, L)`` int32 — column 0 is each slot's last
+      emitted token (entering the cache at position ``seq_lens[s]``,
+      exactly as :func:`decode_fn` would write it), columns ``1..d`` the
+      drafter's proposed tokens, zero-padded to L;
+    - ``seq_lens``: ``(S,)`` int32, cache length BEFORE this step;
+    - ``step_lens``: ``(S,)`` int32, valid positions per slot (``d+1``
+      for a slot carrying ``d`` draft tokens, 0 for idle/prefilling
+      slots — their writes route to the trash page);
+    - ``page_tables``: ``(S, P)`` int32 — position ``seq_lens[s]+t``
+      writes through slot s's own table; positions beyond the allocated
+      pages read entry 0 = trash, so a near-finished slot's speculative
+      tail never lands in a page it does not own.
+
+    Returns ``(logits (S, L, V) float32, k_pool, v_pool)`` — the FULL
+    next-token distribution at every position, so the host can accept
+    the longest agreeing draft prefix (greedy: argmax equality, exactly
+    the token :func:`decode_fn` would have produced position for
+    position) or run speculative rejection sampling.  KV at position t
+    depends only on tokens ``0..t``, so when the first ``a`` drafts are
+    accepted the pool already holds their CORRECT K/V; rejected
+    positions hold stale K/V that the causal mask keeps unread until the
+    next step overwrites them — rollback is pure host bookkeeping.
+    """
+    import jax.numpy as jnp
+
+    S, L = tokens.shape
+    P = page_tables.shape[1]
+    Ctx = P * page_size
+    scale = 1.0 / np.sqrt(config.head_dim)
+    t_idx = jnp.arange(L)[None, :]                      # (1, L)
+    pos = seq_lens[:, None] + t_idx                     # (S, L) global pos
+    valid = t_idx < step_lens[:, None]                  # (S, L)
+    pos_c = jnp.minimum(pos, config.max_len - 1)
+    pages = jnp.where(
+        valid,
+        jnp.take_along_axis(page_tables, pos_c // page_size, axis=1), 0)
+    offs = pos_c % page_size
+    # valid context for slot s, position t = 0..seq_len+t inclusive
+    # (this step's own K/V is written below, before the gather)
+    mask = jnp.arange(Ctx)[None, None, :] <= pos_c[:, :, None]  # (S, L, Ctx)
+    x = params["embed"][tokens] + params["pos"][pos_c]
+    for i in range(config.n_layers):
+        ln1, wq, wk, wv, wo, ln2, w1, w2 = (params[n]
+                                            for n in _layer_names(i))
+        h = _rms(x, ln1)
+        q = jnp.einsum("ctd,dhk->cthk", h, wq)
+        k = jnp.einsum("ctd,dhk->cthk", h, wk)
+        v = jnp.einsum("ctd,dhk->cthk", h, wv)
+        k_pool = k_pool.at[i, pages, offs].set(k)
+        v_pool = v_pool.at[i, pages, offs].set(v)
+        kg = k_pool[i][page_tables].reshape(S, Ctx, *k_pool.shape[3:])
+        vg = v_pool[i][page_tables].reshape(S, Ctx, *v_pool.shape[3:])
+        s = jnp.einsum("cthk,cshk->chts", q, kg) * scale
+        s = jnp.where(mask[:, None], s, -1e30)
+        w = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+        w = w / jnp.sum(w, axis=-1, keepdims=True)
+        o = jnp.einsum("chts,cshk->cthk", w, vg)
+        x = x + jnp.einsum("cthk,hkd->ctd", o, wo)
+        h = _rms(x, ln2)
+        x = x + jnp.maximum(h @ w1, 0.0) @ w2
+    # EVERY position's logits matter here: position j's distribution
+    # decides accept/reject for draft j+1 and mints the bonus token at
+    # the first mismatch — so no last-position slice, unlike prefill
+    logits = _rms(x, params["lnf"]) @ params["embed"].T
+    return logits.astype(jnp.float32), k_pool, v_pool
 
 
 def kv_pool_shape(config: Config, num_pages: int,

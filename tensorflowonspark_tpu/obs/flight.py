@@ -107,12 +107,20 @@ STAGE_VERDICT = {
     "prefill": "prefill_bound",
     "prefill_chunk": "prefill_bound",
     "decode": "decode_bound",
+    # speculative decode splits the token step further: "speculate"
+    # (drafting — host n-gram lookup or the draft-model forward) and
+    # "verify" (the one fixed-shape k+1-position target forward).  A
+    # speculate_bound tier is paying more for proposals than they save
+    # — shrink k or switch drafter; a verify-dominated tier is just the
+    # decode step under another name, so it classifies decode_bound
+    "speculate": "speculate_bound",
+    "verify": "decode_bound",
 }
 
 #: every verdict :func:`classify` can return
 VERDICTS = ("feed_starved", "device_bound", "comm_bound", "emit_bound",
             "queue_backpressured", "ingest_bound", "prefill_bound",
-            "decode_bound", "balanced")
+            "decode_bound", "speculate_bound", "balanced")
 
 #: a verdict needs this share of the additive batch time to be named
 DOMINANCE = 0.5

@@ -286,6 +286,34 @@ def prefill_chunks(max_prompt_len: int, page_size: int, *,
     return tuple(out)
 
 
+def spec_ladder(spec_tokens: int) -> tuple[int, ...]:
+    """The SPECULATION ladder: the draft lengths ``k`` the decode tier
+    compiles its verify step at, ascending, ending at the configured
+    ``spec_tokens``.
+
+    The verify step scores ``k+1`` positions per slot in one fixed-shape
+    call, so each rung is one jit signature of ``(max_seqs, k+1)``
+    geometry.  The adaptive controller moves BETWEEN rungs (halving on a
+    cold drafter, restoring on a hot one) and every rung is compiled at
+    warmup — which is what lets the controller change ``k`` mid-flight
+    without minting a signature (the zero-new-signatures invariant,
+    same discipline as :func:`prefill_chunks`).  Rungs halve from the
+    top: ``spec_tokens, spec_tokens // 2, ..., 1``.  Pure arithmetic —
+    no env, no device state — so every process derives the identical
+    ladder from the same config.
+    """
+    spec_tokens = int(spec_tokens)
+    if spec_tokens < 1:
+        raise ValueError(f"spec_tokens must be >= 1, got {spec_tokens}")
+    out: list[int] = []
+    rung = spec_tokens
+    while rung > 1:
+        out.append(rung)
+        rung //= 2
+    out.append(1)
+    return tuple(reversed(out))
+
+
 def batch_rows(batch: Mapping[str, Any]) -> int:
     """The batch's paddable row count: the leading dimension EVERY
     ``ndim >= 1`` input shares — that shared dimension is what makes it a

@@ -1934,3 +1934,224 @@ def test_costs_goodput_breakdown_must_reconcile(tmp_path):
     verdict = bench_gate.gate([_write(tmp_path, "BENCH_r20.json", half)])
     assert verdict["verdict"] == "fail"
     assert any("costs_goodput_breakdown" in r for r in verdict["reasons"])
+
+
+# -- chunked prefill + prefix sharing (ISSUE 19) -----------------------------
+
+
+def _prefill_fields(ttft=0.8, **extra):
+    fields = {"decode_prefill_short_ttft_ms_p99": ttft,
+              "decode_prefill_output_equality": "pass",
+              "decode_prefill_alloc_pages": 34,
+              "decode_prefill_alloc_pages_baseline": 60,
+              "decode_prefill_page_savings_frac": 0.4333,
+              "decode_prefill_short_ttft_speedup": None,
+              "decode_prefill_short_ttft_speedup_reason":
+                  "compute-bound single-device host: packed prefill "
+                  "costs more FLOPs than per-prompt calls",
+              "decode_prefill_clients": 6,
+              "decode_prefill_requests": 24,
+              "decode_prefill_shared_requests": 6,
+              "decode_prefill_max_new_tokens": 8,
+              "decode_prefill_prompt_lens": [4, 20],
+              "decode_prefill_prefix_len": 16,
+              "decode_prefill_chunk": 8,
+              "decode_prefill_chunks": [8, 16, 24],
+              "decode_prefill_model": "tiny_lm_d32L2H2v64",
+              "decode_prefill_page_size": 8,
+              "decode_prefill_max_seqs": 8,
+              "decode_prefill_devices": 1,
+              "decode_prefill_host_cpus": 1}
+    fields.update(extra)
+    return fields
+
+
+def _r21(**extra):
+    """A round-21-complete primary half: r20 + the chunked-prefill +
+    prefix-sharing microbench."""
+    half = _r20(**_prefill_fields())
+    half.update(extra)
+    return half
+
+
+def test_decode_prefill_field_required_on_primary_from_round_21(tmp_path):
+    # round 20: grandfathered — no chunked-prefill microbench owed
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r20.json", _r20())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # round 21+: the primary must carry it (or explicit null + reason)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r21.json", _r20())])
+    assert verdict["verdict"] == "fail"
+    assert any("decode_prefill_short_ttft_ms_p99" in r
+               for r in verdict["reasons"])
+    # complete round 21 passes
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r21.json", _r21())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # explicit null + reason satisfies (e.g. wall budget exhausted)
+    half = _r20(decode_prefill_short_ttft_ms_p99=None,
+                decode_prefill_reason="wall budget exhausted before the "
+                                      "chunked-prefill microbench")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r21.json", half)])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # bare null does not
+    half = _r20(decode_prefill_short_ttft_ms_p99=None)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r21.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("decode_prefill_reason" in r for r in verdict["reasons"])
+
+
+def test_decode_prefill_equality_fail_fails_artifact(tmp_path):
+    """A diverged chunked+shared prefill is broken, not fast — it fails
+    the artifact even though it also stamps a legitimate-looking null
+    headline + reason."""
+    half = _r20(**_prefill_fields(
+        ttft=None, decode_prefill_output_equality="fail",
+        decode_prefill_reason="3 request(s) decoded different tokens "
+                              "chunked vs per-prompt: broken, not fast"))
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r21.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("broken, not fast" in r for r in verdict["reasons"])
+
+
+# -- speculative multi-token decoding + seeded sampling (ISSUE 20) -----------
+
+
+def _spec_fields(ratio=1.32, **extra):
+    # mirrors the shape the bench stamps on a compute-bound 1-core host:
+    # ITL ratio numeric, speedup null + reason, mechanism evidence
+    # (tokens/step, acceptance) numeric, equality verified
+    fields = {"spec_itl_p99_ratio": ratio,
+              "decode_spec_output_equality": "pass",
+              "spec_tokens_per_step": 7.24,
+              "spec_acceptance_rate": 0.9366,
+              "spec_itl_speedup": None,
+              "spec_itl_speedup_reason":
+                  "compute-bound single-device host: the (k+1)-position "
+                  "verify call costs more FLOPs than the steps it "
+                  "collapses",
+              "spec_clients": 6, "spec_requests": 24,
+              "spec_shared_requests": 6, "spec_max_new_tokens": 24,
+              "spec_prompt_lens": [4, 20], "spec_prefix_len": 16,
+              "spec_k": 4, "spec_drafter": "ngram",
+              "spec_ladder": [1, 2, 4],
+              "spec_model": "tiny_lm_d32L2H2v64",
+              "spec_page_size": 8, "spec_max_seqs": 8,
+              "spec_prefill_chunk": 8, "spec_devices": 1,
+              "spec_host_cpus": 1}
+    fields.update(extra)
+    return fields
+
+
+def _r22(**extra):
+    """A round-22-complete primary half: r21 + the speculative-decoding
+    microbench."""
+    half = _r21(**_spec_fields())
+    half.update(extra)
+    return half
+
+
+def test_decode_spec_field_required_on_primary_from_round_22(tmp_path):
+    # round 21: grandfathered — no speculative microbench owed
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r21.json", _r21())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # round 22+: the primary must carry it (or explicit null + reason)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r22.json", _r21())])
+    assert verdict["verdict"] == "fail"
+    assert any("spec_itl_p99_ratio" in r for r in verdict["reasons"])
+    # complete round 22 passes (speedup null + reason: the compute-bound
+    # host shape — the equality and tokens-per-step claims still gate)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r22.json", _r22())])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # explicit null + reason satisfies (e.g. wall budget exhausted)
+    half = _r21(spec_itl_p99_ratio=None,
+                spec_reason="wall budget exhausted before the "
+                            "speculative-decode microbench")
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r22.json", half)])
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # bare null does not
+    half = _r21(spec_itl_p99_ratio=None)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r22.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("spec_reason" in r for r in verdict["reasons"])
+
+
+def test_decode_spec_equality_fail_fails_artifact(tmp_path):
+    """A speculative stream that diverged from the single-token engine is
+    broken, not fast — it fails the artifact even though it also stamps a
+    legitimate-looking null headline + reason."""
+    half = _r21(**_spec_fields(
+        ratio=None, decode_spec_output_equality="fail",
+        spec_reason="2 request(s) decoded different tokens speculative "
+                    "vs single-token: broken, not fast"))
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r22.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("broken, not fast" in r for r in verdict["reasons"])
+
+
+def test_decode_spec_numeric_requires_mechanism_evidence(tmp_path):
+    # tokens/step at 1.0 means no draft was ever accepted: the ratio
+    # measured a plain decode loop wearing a speculation costume
+    half = _r22(spec_tokens_per_step=1.0)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r22.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("spec_tokens_per_step" in r for r in verdict["reasons"])
+    # an acceptance rate outside [0, 1] (or missing) is not a rate
+    half = _r22(spec_acceptance_rate=1.4)
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r22.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("spec_acceptance_rate" in r for r in verdict["reasons"])
+    half = _r22()
+    del half["spec_acceptance_rate"]
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r22.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("spec_acceptance_rate" in r for r in verdict["reasons"])
+    # a null speedup must say why (compute-bound host, SLO, ...)
+    half = _r22()
+    del half["spec_itl_speedup_reason"]
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r22.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("spec_itl_speedup_reason" in r for r in verdict["reasons"])
+    # equality must be the verified 'pass', not absent
+    half = _r22()
+    del half["decode_spec_output_equality"]
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r22.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("decode_spec_output_equality" in r
+               for r in verdict["reasons"])
+
+
+def test_decode_spec_value_without_config_identity_fails(tmp_path):
+    half = _r22()
+    del half["spec_drafter"]
+    del half["spec_k"]
+    verdict = bench_gate.gate([_write(tmp_path, "BENCH_r22.json", half)])
+    assert verdict["verdict"] == "fail"
+    assert any("config identity" in r and "spec_drafter" in r
+               and "spec_k" in r for r in verdict["reasons"])
+
+
+def test_decode_spec_itl_ratio_ratchets_lower_is_better(tmp_path):
+    # same config, higher (worse) ratio beyond 1/threshold: fail
+    paths = [
+        _write(tmp_path, "BENCH_r22.json", _r22()),
+        _write(tmp_path, "BENCH_r23.json",
+               _r22(**_spec_fields(ratio=1.9)))]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "fail"
+    assert any("slowed" in r and "spec_itl_p99_ratio" in r
+               for r in verdict["reasons"])
+    # a lower (better) ratio passes
+    paths = [
+        _write(tmp_path, "BENCH_r22.json", _r22()),
+        _write(tmp_path, "BENCH_r23.json",
+               _r22(**_spec_fields(ratio=1.1)))]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass", verdict["reasons"]
+    # a different drafter or draft depth is a different experiment:
+    # no comparison
+    paths = [
+        _write(tmp_path, "BENCH_r22.json", _r22()),
+        _write(tmp_path, "BENCH_r23.json",
+               _r22(**_spec_fields(ratio=1.9, spec_k=6,
+                                   spec_ladder=[1, 3, 6])))]
+    verdict = bench_gate.gate(paths)
+    assert verdict["verdict"] == "pass", verdict["reasons"]

@@ -48,6 +48,7 @@ def make_engine():
         eng.stop()
         assert eng.pool.used_pages == 0, "leaked KV pages"
         assert eng.pool.free_pages == eng.num_pages - 1
+        assert eng.pool.shared_pages == 0  # no orphaned references
         eng.pool.check_invariant()  # conservation law holds at teardown
         assert eng.kv_pool_bytes == pool_bytes, "device pool grew"
         assert tuple(eng._kp.shape) == tinylm.kv_pool_shape(
@@ -229,6 +230,39 @@ def test_cancel_mid_stream_frees_pages(make_engine):
         time.sleep(0.01)
     assert eng.pool.used_pages == 0
     assert int(eng._cancelled_total.value) >= 1
+
+
+def test_cancel_mid_speculation_rewinds_draft_tokens(make_engine):
+    """ISSUE 20 satellite: a cancel/disconnect landing while draft
+    tokens are in flight (between propose and verify) must rewind them —
+    the slot retires at the step boundary, every page frees, the pool
+    conservation law holds (teardown's check_invariant sweep re-asserts
+    on this engine too) — and the surviving generation is untouched."""
+    eng = make_engine(max_seqs=2, spec_tokens=4, spec_drafter="ngram")
+    state = {"victim": None}
+    real_verify = eng._verify_jit
+
+    def chaotic_verify(*a, **kw):
+        if state["victim"] is not None:
+            state["victim"].cancel()  # drafts proposed, not yet verified
+            state["victim"] = None
+        return real_verify(*a, **kw)
+
+    eng._verify_jit = chaotic_verify
+    eng.start()
+    victim = eng.submit(_prompts(1)[0], max_new_tokens=40)
+    it = victim.tokens(timeout=30)
+    next(it)  # prefill done: speculation owns the slot now
+    state["victim"] = victim
+    other = eng.submit([5, 6, 7], max_new_tokens=6)
+    assert other.result() == eng.submit([5, 6, 7], max_new_tokens=6).result()
+    deadline = time.time() + 10
+    while eng.pool.used_pages and time.time() < deadline:
+        time.sleep(0.01)
+    assert eng.pool.used_pages == 0
+    assert int(eng._cancelled_total.value) >= 1
+    assert state["victim"] is None, "chaos hook never fired"
+    eng.pool.check_invariant()
 
 
 def test_stop_fails_inflight_loudly(make_engine):

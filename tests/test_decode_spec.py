@@ -1,0 +1,613 @@
+"""Speculative multi-token decoding + seeded real sampling (ISSUE 20).
+
+The tentpole contracts: a speculative engine (n-gram or draft-model
+drafter) emits token streams IDENTICAL to the single-token engine under
+greedy selection — including shared-prefix admissions, mid-page COW
+divergence, and total draft rejection — while emitting more than one
+token per verify step; steady-state speculation mints ZERO jit
+signatures beyond the enumerated set (chunk rungs + one verify shape per
+``spec_ladder`` rung + COW, plus the draft model's own ``draft_``-keyed
+set); the adaptive controller halves ``k`` when the drafter goes cold
+and only along pre-compiled rungs; seeded sampling replays bit-identical
+across engine restarts and speculative rejection sampling preserves the
+target distribution exactly; and the cancel/stop chaos paths rewind
+in-flight drafts with the pool conservation law intact.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import decode, serving, shapes
+from tensorflowonspark_tpu.models import tinylm
+from tensorflowonspark_tpu.util import ensure_jax_platform
+
+ensure_jax_platform()
+
+CFG = tinylm.Config.tiny()
+
+
+@pytest.fixture
+def make_engine():
+    """Engine factory with the pool hygiene contract enforced at
+    teardown for EVERY engine (the test_decode pattern, plus the
+    refcount conservation law and zero leftover shared pages)."""
+    engines = []
+
+    def _make(**kw):
+        defaults = dict(max_seqs=4, page_size=8, max_len=64,
+                        max_prompt_len=24)
+        defaults.update(kw)
+        eng = decode.DecodeEngine(CFG, **defaults)
+        engines.append(eng)
+        return eng
+
+    yield _make
+    for eng in engines:
+        eng.stop()
+        assert eng.pool.used_pages == 0, "leaked KV pages"
+        assert eng.pool.shared_pages == 0
+        eng.pool.check_invariant()
+
+
+def _prompts(n, lo=3, hi=24, seed=3):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, CFG.vocab_size,
+                        size=(lo + (i * (hi - lo)) // max(1, n - 1),)
+                        ).astype(np.int32) for i in range(n)]
+
+
+def _family(prefix_len, tail_len, n, seed=11):
+    rng = np.random.RandomState(seed)
+    prefix = rng.randint(0, CFG.vocab_size, size=(prefix_len,))
+    return [np.concatenate([
+        prefix, rng.randint(0, CFG.vocab_size, size=(tail_len,))]
+    ).astype(np.int32) for _ in range(n)]
+
+
+# -- geometry + controller units ----------------------------------------------
+
+
+def test_spec_ladder_shapes():
+    assert shapes.spec_ladder(1) == (1,)
+    assert shapes.spec_ladder(4) == (1, 2, 4)
+    assert shapes.spec_ladder(6) == (1, 3, 6)
+    assert shapes.spec_ladder(8) == (1, 2, 4, 8)
+    with pytest.raises(ValueError):
+        shapes.spec_ladder(0)
+
+
+def test_spec_controller_halves_restores_and_gates_on_evidence():
+    """The adaptive-k law: cold drafter → rung down, hot drafter → rung
+    up, never off the pre-compiled ladder, never on thin evidence, and
+    every shift clears the window (no carried momentum)."""
+    ctl = decode._SpecController((1, 2, 4), window_s=30.0)
+    assert ctl.k == 4 and ctl.shifts == 0
+    # one cold window at the evidence floor → halve
+    ctl.note(decode.SPEC_WINDOW_MIN_PROPOSED, 0, now=100.0)
+    assert ctl.k == 2 and ctl.shifts == 1
+    # below the floor nothing moves, however cold
+    ctl.note(decode.SPEC_WINDOW_MIN_PROPOSED - 1, 0, now=101.0)
+    assert ctl.k == 2
+    # topping up the window past the floor acts on the combined rate
+    ctl.note(1, 0, now=102.0)
+    assert ctl.k == 1 and ctl.shifts == 2
+    # the floor rung never drops further (and, unshifted, keeps its
+    # cold samples — recovery needs the WINDOW to warm, not one burst)
+    ctl.note(decode.SPEC_WINDOW_MIN_PROPOSED, 0, now=103.0)
+    assert ctl.k == 1
+    ctl.note(decode.SPEC_WINDOW_MIN_PROPOSED,
+             decode.SPEC_WINDOW_MIN_PROPOSED, now=104.0)
+    assert ctl.k == 1  # blended rate is mid-band
+    # once the cold evidence expires, a hot window restores ONE rung
+    ctl.note(decode.SPEC_WINDOW_MIN_PROPOSED,
+             decode.SPEC_WINDOW_MIN_PROPOSED, now=140.0)
+    assert ctl.k == 2 and ctl.shifts == 3
+    # mid-band acceptance holds the rung (hysteresis)
+    ctl.note(100, 50, now=141.0)
+    assert ctl.k == 2
+    # expired samples leave the window: old evidence is not evidence
+    ctl.note(decode.SPEC_WINDOW_MIN_PROPOSED - 1, 0, now=200.0)
+    assert ctl.k == 2
+    assert ctl.acceptance(now=200.0) == 0.0
+    assert ctl.acceptance(now=300.0) is None  # window drained
+
+
+def test_spec_requires_chunked_prefill():
+    with pytest.raises(ValueError, match="chunked prefill"):
+        decode.DecodeEngine(CFG, max_seqs=2, page_size=8, max_len=64,
+                            max_prompt_len=24, prefill_chunk=0,
+                            spec_tokens=4)
+
+
+def test_sampling_params_validate():
+    sp = decode.SamplingParams(temperature=0.8, top_k=5, top_p=0.9,
+                               seed=7)
+    assert not sp.greedy
+    assert decode.SamplingParams(temperature=0.0).greedy
+    with pytest.raises(ValueError):
+        decode.SamplingParams(temperature=-0.1)
+    with pytest.raises(ValueError):
+        decode.SamplingParams(top_k=-1)
+    with pytest.raises(ValueError):
+        decode.SamplingParams(top_p=0.0)
+    with pytest.raises(ValueError):
+        decode.SamplingParams(top_p=1.5)
+
+
+# -- greedy token-exactness ---------------------------------------------------
+
+
+def test_greedy_ngram_spec_token_exact_vs_baseline(make_engine):
+    """The tentpole equivalence: the n-gram speculative engine's greedy
+    streams are token-for-token the single-token engine's, while the
+    verify step emits MORE than one token per step on average."""
+    base = make_engine()
+    spec = make_engine(spec_tokens=4, spec_drafter="ngram")
+    assert spec.spec_ladder == (1, 2, 4)
+    base.start()
+    spec.start()
+    # spec counters are process-global metrics: measure THIS engine's
+    # traffic as deltas (other tests' engines share the series)
+    s0 = int(spec._spec_steps_total.value)
+    e0 = int(spec._spec_emitted_total.value)
+    p0 = int(spec._spec_proposed_total.value)
+    a0 = int(spec._spec_accepted_total.value)
+    prompts = _prompts(8, lo=3, hi=24)
+    want = [base.submit(p, max_new_tokens=20).result() for p in prompts]
+    got = [spec.submit(p, max_new_tokens=20).result() for p in prompts]
+    assert got == want
+    kv = spec.stats()["admission"]["kv"]
+    assert int(spec._spec_proposed_total.value) > p0
+    assert int(spec._spec_accepted_total.value) > a0
+    assert 0.0 <= kv["spec_acceptance_rate"] <= 1.0
+    # the headline mechanism: accepted drafts mean fewer verify steps
+    # than tokens (tiny greedy models settle into cycles the prompt-
+    # lookup drafter reads straight from the history)
+    steps = int(spec._spec_steps_total.value) - s0
+    emitted = int(spec._spec_emitted_total.value) - e0
+    assert emitted / steps > 1.0
+
+
+def test_greedy_spec_token_exact_with_shared_prefix_and_cow(make_engine):
+    """Speculation composes with prefix sharing: shared-prefix families
+    (including a mid-page divergence forcing COW) stay token-exact, and
+    draft rollback never mutates a registered page — a later request
+    reusing the full base prompt still matches the baseline."""
+    base = make_engine()
+    spec = make_engine(spec_tokens=4, spec_drafter="ngram")
+    base.start()
+    spec.start()
+    fam = _family(prefix_len=16, tail_len=4, n=5, seed=29)
+    rng = np.random.RandomState(31)
+    root = rng.randint(0, CFG.vocab_size, size=(16,)).astype(np.int32)
+    fork = np.concatenate([
+        root[:12], rng.randint(0, CFG.vocab_size, size=(8,))]
+    ).astype(np.int32)
+    prompts = fam + [root, fork, root]
+    want = [base.submit(p, max_new_tokens=16).result() for p in prompts]
+    got = [spec.submit(p, max_new_tokens=16).result() for p in prompts]
+    assert got == want
+    st = spec.stats()
+    assert st["engine"]["prefix_registry"]["hits"] >= len(fam) - 1
+    assert st["admission"]["kv"]["cow_copies_total"] >= 1
+    assert st["admission"]["kv"]["invariant"]["ok"]
+
+
+def test_model_drafter_token_exact_perfect_and_cold(make_engine):
+    """The draft-model drafter: with the TARGET's own params it predicts
+    every verify outcome (acceptance 1.0); with mismatched params it
+    stays token-exact anyway — mid-page rollback of rejected drafts is
+    correctness-neutral by construction."""
+    base = make_engine()
+    base.start()
+    prompts = _prompts(6, lo=3, hi=20, seed=17)
+    want = [base.submit(p, max_new_tokens=16).result() for p in prompts]
+
+    perfect = make_engine(spec_tokens=4, spec_drafter="model",
+                          draft_config=CFG,
+                          draft_params=tinylm.init_params(CFG, seed=0))
+    perfect.start()
+    got = [perfect.submit(p, max_new_tokens=16).result()
+           for p in prompts]
+    assert got == want
+    assert perfect.stats()["admission"]["kv"]["spec_acceptance_rate"] \
+        >= 0.95
+
+    cold = make_engine(spec_tokens=4, spec_drafter="model",
+                       draft_config=CFG,
+                       draft_params=tinylm.init_params(CFG, seed=99))
+    cold.start()
+    got2 = [cold.submit(p, max_new_tokens=16).result() for p in prompts]
+    assert got2 == want
+
+
+def test_none_drafter_is_single_token_with_sampling_reach(make_engine):
+    """The ``none`` drafter: proposes nothing, greedy output matches the
+    baseline exactly, zero drafts ever counted — the sampling-capable
+    single-token engine the distribution test compares against."""
+    base = make_engine()
+    spec = make_engine(spec_tokens=1, spec_drafter="none")
+    base.start()
+    spec.start()
+    p0 = int(spec._spec_proposed_total.value)  # global series: delta
+    for p in _prompts(4, seed=37):
+        assert (spec.submit(p, max_new_tokens=10).result()
+                == base.submit(p, max_new_tokens=10).result())
+    kv = spec.stats()["admission"]["kv"]
+    assert int(spec._spec_proposed_total.value) == p0
+    assert kv["spec_acceptance_rate"] is None
+
+
+def test_adaptive_controller_drops_k_on_cold_drafter(make_engine):
+    """A drafter whose proposals are ALWAYS rejected (forced garbage:
+    argmax+1 everywhere) drives windowed acceptance to zero — the
+    controller walks k down the ladder to the floor WITHOUT minting
+    signatures, and the stream stays token-exact throughout."""
+    base = make_engine()
+    spec = make_engine(spec_tokens=4, spec_drafter="ngram")
+    base.start()
+    spec.warmup()
+    enumerated = set(spec.enumerate_signatures())
+
+    def garbage(engine, rows, k):
+        return {r.slot: [(int(engine._tokens[r.slot]) + 1)
+                         % CFG.vocab_size] * k for r in rows}
+
+    spec._drafter.propose_all = garbage
+    spec.start()
+    prompts = _prompts(6, lo=5, hi=20, seed=41)
+    for p in prompts:
+        assert (spec.submit(p, max_new_tokens=16).result()
+                == base.submit(p, max_new_tokens=16).result())
+    sp = spec.stats()["engine"]["spec"]
+    assert sp["k"] == 1 and sp["shifts"] >= 2
+    assert spec.stats()["admission"]["kv"]["spec_acceptance_rate"] == 0.0
+    assert serving._SEEN_SHAPES[spec.cache_key] == enumerated
+
+
+# -- compile discipline -------------------------------------------------------
+
+
+def test_zero_new_signatures_with_spec_on(make_engine):
+    """Speculation's whole geometry claim: warmup compiles one verify
+    shape per ladder rung (the single-token decode signature is GONE —
+    a speculative engine never issues it) and steady-state serving over
+    mixed traffic, shared prefixes, COW, and adaptive-k shifts mints
+    nothing new."""
+    eng = make_engine(spec_tokens=4, spec_drafter="ngram")
+    eng.warmup()
+    enumerated = set(eng.enumerate_signatures())
+    expected = (len(eng.prefill_chunks) + len(eng.spec_ladder)
+                + (1 if eng.share_prefixes else 0))
+    assert len(enumerated) == expected
+    assert serving._SEEN_SHAPES[eng.cache_key] == enumerated
+    eng.start()
+    for p in _prompts(6, lo=1, hi=24, seed=43):
+        eng.submit(p, max_new_tokens=12).result()
+    for p in _family(prefix_len=16, tail_len=4, n=4, seed=47):
+        eng.submit(p, max_new_tokens=8).result()
+    assert serving._SEEN_SHAPES[eng.cache_key] == enumerated
+
+
+def test_zero_new_signatures_model_drafter(make_engine):
+    """The draft model's shadow set rides the same invariant: its chunk
+    rungs, decode step, and COW copy sign distinctly (``draft_`` keys)
+    and are all warmed — serving mints nothing."""
+    eng = make_engine(spec_tokens=2, spec_drafter="model")
+    eng.warmup()
+    enumerated = set(eng.enumerate_signatures())
+    expected = (len(eng.prefill_chunks) + len(eng.spec_ladder)
+                + (1 if eng.share_prefixes else 0)
+                + len(eng.prefill_chunks) + 1
+                + (1 if eng.share_prefixes else 0))
+    assert len(enumerated) == expected
+    assert serving._SEEN_SHAPES[eng.cache_key] == enumerated
+    eng.start()
+    for p in _prompts(5, lo=1, hi=24, seed=53):
+        eng.submit(p, max_new_tokens=8).result()
+    base = np.asarray(_family(16, 4, 2, seed=59)[0])
+    eng.submit(base, max_new_tokens=4).result()
+    eng.submit(np.concatenate([base[:12], [1, 2, 3]]).astype(np.int32),
+               max_new_tokens=4).result()  # mid-page COW, mirrored
+    assert serving._SEEN_SHAPES[eng.cache_key] == enumerated
+
+
+# -- seeded sampling ----------------------------------------------------------
+
+
+def test_seeded_sampling_deterministic_across_restarts(make_engine):
+    """Position-keyed RNG: the same request with the same seed replays
+    bit-identically on a FRESH engine; distinct seeds decorrelate."""
+    prompt = _prompts(1, seed=61)[0]
+    streams = {}
+    for seed in (5, 5, 6, 7):
+        eng = make_engine(spec_tokens=2, spec_drafter="ngram")
+        eng.start()
+        sp = decode.SamplingParams(temperature=0.9, top_p=0.95,
+                                   seed=seed)
+        out = eng.submit(prompt, max_new_tokens=16,
+                         sampling=sp).result(timeout=60)
+        streams.setdefault(seed, []).append(out)
+        eng.stop()
+    assert streams[5][0] == streams[5][1]
+    assert len({tuple(v[0]) for v in streams.values()}) > 1
+
+
+def test_greedy_temperature_zero_is_argmax(make_engine):
+    """temperature=0 through the sampling path IS greedy: identical to
+    a no-sampling submit on the same engine."""
+    eng = make_engine(spec_tokens=4, spec_drafter="ngram")
+    eng.start()
+    p = _prompts(1, seed=67)[0]
+    want = eng.submit(p, max_new_tokens=12).result()
+    got = eng.submit(p, max_new_tokens=12,
+                     sampling=decode.SamplingParams(
+                         temperature=0.0, seed=9)).result()
+    assert got == want
+
+
+def test_sampling_requires_spec_engine(make_engine):
+    eng = make_engine()  # spec_tokens defaults to 0
+    eng.start()
+    with pytest.raises(ValueError, match="spec_tokens"):
+        eng.submit([1, 2, 3], sampling=decode.SamplingParams(
+            temperature=0.7))
+    # greedy sampling params are fine on a legacy engine
+    assert len(eng.submit([1, 2, 3], max_new_tokens=3,
+                          sampling=decode.SamplingParams(
+                              temperature=0.0)).result()) == 3
+
+
+def test_rejection_sampling_preserves_target_distribution(make_engine):
+    """The speculative-sampling law, tested at the choose-token level
+    where it is sharp: for ANY deterministic draft token, accept-with-
+    probability-p(draft) + resample-from-the-remainder composes to
+    exactly the target distribution.  Empirical marginals over 20k
+    position-keyed draws must match ``_sampling_dist`` to TV < 0.03 —
+    for a high-mass draft, a low-mass draft, and no draft at all."""
+    eng = make_engine(spec_tokens=2, spec_drafter="ngram")
+    sp = decode.SamplingParams(temperature=0.8, top_p=0.9, seed=71)
+    rng = np.random.RandomState(73)
+    logits = (rng.randn(CFG.vocab_size) * 2.0).astype(np.float32)
+    p = decode._sampling_dist(logits, sp)
+    req = decode._DecodeRequest(np.asarray([1], np.int32), 4, None,
+                                sampling=sp)
+    kept = np.flatnonzero(p)
+    for draft in (int(p.argmax()), int(kept[p[kept].argmin()]), None):
+        counts = np.zeros(CFG.vocab_size)
+        n = 20000
+        for pos in range(n):
+            counts[eng._choose_token(req, logits, pos, draft)] += 1
+        tv = 0.5 * np.abs(counts / n - p).sum()
+        assert tv < 0.03, (draft, tv)
+        # rejected drafts actually resample (the correction term fires)
+        if draft is not None:
+            assert counts[draft] / n == pytest.approx(p[draft], abs=0.02)
+
+
+def test_spec_sampling_distribution_matches_none_drafter(make_engine):
+    """End-to-end distribution check: the SAME sampled workload through
+    a speculating engine (drafts in play, rejection sampling live) and
+    through the ``none`` drafter (plain sampling, no drafts) produces
+    matching per-position marginals across seeds — speculation changes
+    throughput, not the distribution."""
+    spec = make_engine(spec_tokens=2, spec_drafter="ngram")
+    plain = make_engine(spec_tokens=1, spec_drafter="none")
+    spec.start()
+    plain.start()
+    p0 = int(spec._spec_proposed_total.value)  # global series: delta
+    prompt = _prompts(1, seed=79)[0]
+    n, new = 200, 5
+    a = np.zeros((new, CFG.vocab_size))
+    b = np.zeros((new, CFG.vocab_size))
+    for seed in range(n):
+        sp = decode.SamplingParams(temperature=0.7, top_p=0.9, seed=seed)
+        for eng, acc in ((spec, a), (plain, b)):
+            toks = eng.submit(prompt, max_new_tokens=new,
+                              sampling=sp).result(timeout=60)
+            for j, t in enumerate(toks):
+                acc[j, t] += 1
+    assert int(spec._spec_proposed_total.value) > p0  # non-vacuous
+    for j in range(new):
+        tv = 0.5 * np.abs(a[j] / n - b[j] / n).sum()
+        assert tv < 0.35, (j, tv)
+
+
+# -- observability ------------------------------------------------------------
+
+
+def test_spec_stats_slo_and_fleet_summary_surface(make_engine):
+    """The acceptance signal's full path: engine slo block → /healthz
+    admission.kv → mesh fleet_summary's per-replica kv view."""
+    from tensorflowonspark_tpu import mesh
+
+    eng = make_engine(spec_tokens=4, spec_drafter="ngram")
+    eng.start()
+    for p in _prompts(3, seed=83):
+        eng.submit(p, max_new_tokens=16).result()
+    slo = eng.slo_snapshot()
+    assert 0.0 <= slo["spec_acceptance_rate"] <= 1.0
+    st = eng.stats()
+    assert st["engine"]["spec"]["spec_tokens"] == 4
+    assert st["engine"]["spec"]["drafter"] == "ngram"
+    assert st["engine"]["spec"]["ladder"] == [1, 2, 4]
+    kv = st["admission"]["kv"]
+    assert kv["spec_proposed_total"] >= kv["spec_accepted_total"] > 0
+    assert kv["spec_k"] in (1, 2, 4)
+    router = mesh.MeshRouter(expected_replicas=1)
+    replica = mesh._Replica("r1", {"host": "127.0.0.1", "port": 1})
+    replica.health = st
+    replica.health_ts = time.time()
+    router._replicas["r1"] = replica
+    doc = router.fleet_summary()["replicas"]["r1"]["kv"]
+    assert doc["spec_acceptance_rate"] == kv["spec_acceptance_rate"]
+    assert doc["spec_k"] == kv["spec_k"]
+
+
+def test_spec_flight_stages_speculate_and_verify(make_engine):
+    from tensorflowonspark_tpu.obs import flight
+
+    eng = make_engine(spec_tokens=4, spec_drafter="ngram")
+    eng.start()
+    rec = flight.recorder("decode")
+    rec.reset()
+    for p in _prompts(3, seed=89):
+        eng.submit(p, max_new_tokens=12).result()
+    snap = rec.snapshot()
+    assert snap["stages_s"].get("speculate", 0) > 0
+    assert snap["stages_s"].get("verify", 0) > 0
+    assert "decode" not in snap["stages_s"]
+    assert snap["verdict"] in flight.VERDICTS
+
+
+def test_http_sampling_quartet_reaches_engine(make_engine):
+    import http.client
+    import json
+
+    eng = make_engine(spec_tokens=2, spec_drafter="ngram")
+    eng.start()
+    srv = decode.DecodeHTTPServer(eng)
+    try:
+        host, port = srv.start()
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        body = json.dumps({"prompt": [1, 2, 3, 4], "max_new_tokens": 8,
+                           "stream": False, "temperature": 0.9,
+                           "top_p": 0.95, "seed": 11}).encode()
+        outs = []
+        for _ in range(2):
+            conn.request("POST", "/v1/generate", body=body)
+            r = conn.getresponse()
+            assert r.status == 200
+            outs.append(json.loads(r.read())["tokens"])
+        assert outs[0] == outs[1]  # same seed, same stream
+        # a sampling request on a non-spec engine maps to 400, not 500
+        eng2 = decode.DecodeEngine(CFG, max_seqs=2, page_size=8,
+                                   max_len=64, max_prompt_len=24)
+        eng2.start()
+        srv2 = decode.DecodeHTTPServer(eng2)
+        try:
+            h2, p2 = srv2.start()
+            c2 = http.client.HTTPConnection(h2, p2, timeout=30)
+            c2.request("POST", "/v1/generate", body=body)
+            assert c2.getresponse().status == 400
+        finally:
+            srv2.stop()
+            eng2.stop()
+    finally:
+        srv.stop()
+
+
+# -- chaos / invariant --------------------------------------------------------
+
+
+def test_cancel_mid_speculation_rewinds_and_frees(make_engine):
+    """The satellite-1 chaos path: a cancel landing BETWEEN propose and
+    verify (drafts in flight) must rewind the victim — slot retired at
+    the step boundary, every page freed, conservation law intact — while
+    the surviving request's stream stays token-exact."""
+    base = make_engine(max_seqs=2, share_prefixes=False)
+    base.start()
+    spec = make_engine(max_seqs=2, spec_tokens=4, spec_drafter="ngram",
+                       share_prefixes=False)  # no registry pins: the
+    # pool must drain to literal zero once the victim rewinds
+    prompts = _prompts(2, lo=8, hi=12, seed=97)
+    want = base.submit(prompts[1], max_new_tokens=24).result()
+
+    state = {"victim": None, "armed": False}
+    real_verify = spec._verify_jit
+
+    def chaotic_verify(*a, **kw):
+        if state["armed"] and state["victim"] is not None:
+            state["victim"].cancel()  # between propose and verify
+            state["armed"] = False
+        return real_verify(*a, **kw)
+
+    spec._verify_jit = chaotic_verify
+    spec.start()
+    victim = spec.submit(prompts[0], max_new_tokens=40)
+    it = victim.tokens(timeout=30)
+    next(it)  # prefill done, speculation underway
+    state["victim"] = victim
+    state["armed"] = True
+    survivor = spec.submit(prompts[1], max_new_tokens=24)
+    assert survivor.result(timeout=60) == want
+    deadline = time.time() + 10
+    while spec.pool.used_pages and time.time() < deadline:
+        time.sleep(0.01)
+    assert spec.pool.used_pages == 0
+    assert int(spec._cancelled_total.value) >= 1
+    spec.pool.check_invariant()
+    assert not state["armed"], "chaos hook never fired mid-speculation"
+
+
+def test_stop_mid_speculation_keeps_invariant(make_engine):
+    """stop() with drafts in flight: every caller fails loudly, every
+    page returns, the conservation law holds (teardown re-asserts)."""
+    eng = make_engine(max_seqs=2, spec_tokens=4, spec_drafter="ngram")
+    real_verify = eng._verify_jit
+
+    def slow_verify(*a, **kw):
+        time.sleep(0.02)
+        return real_verify(*a, **kw)
+
+    eng._verify_jit = slow_verify
+    eng.start()
+    streams = [eng.submit(p, max_new_tokens=38)
+               for p in _prompts(4, lo=3, hi=20, seed=101)]
+    results = []
+
+    def consume(s):
+        try:
+            results.append(("ok", s.result(timeout=30)))
+        except Exception as e:
+            results.append(("err", type(e).__name__))
+
+    threads = [threading.Thread(target=consume, args=(s,))
+               for s in streams]
+    for t in threads:
+        t.start()
+    eng.stop()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(results) == 4
+    assert any(kind == "err" for kind, _ in results)
+    assert eng.pool.used_pages == 0
+    eng.pool.check_invariant()
+
+
+# -- heavy sweep --------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_spec_mixed_workload_sweep_token_exact(make_engine):
+    """Heavy mixed workload through the speculative engine: prefix
+    families + singletons, concurrent submission, all three drafters'
+    greedy outputs vs the single-token baseline, invariant at the end."""
+    base = make_engine(max_seqs=4)
+    base.start()
+    prompts = []
+    for fam in range(3):
+        prompts += _family(prefix_len=16, tail_len=3 + fam, n=5,
+                           seed=300 + fam)
+    prompts += _prompts(12, lo=1, hi=24, seed=400)
+    want = [base.submit(p, max_new_tokens=12).result() for p in prompts]
+    for kind in ("ngram", "model", "none"):
+        eng = make_engine(max_seqs=4, spec_tokens=4, spec_drafter=kind)
+        eng.start()
+        got = [None] * len(prompts)
+
+        def run(i, e=eng, out=got):
+            out[i] = e.submit(prompts[i],
+                              max_new_tokens=12).result(timeout=120)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert got == want, kind
+        assert eng.stats()["admission"]["kv"]["invariant"]["ok"]

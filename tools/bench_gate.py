@@ -268,6 +268,11 @@ DEFAULT_REQUIRE_COSTS_FROM = 20
 #: introduced with chunked batched prefill + COW prefix sharing on the
 #: paged decode tier)
 DEFAULT_REQUIRE_DECODE_PREFILL_FROM = 21
+#: first round whose primary half must carry the speculative-decoding
+#: microbench (``spec_itl_p99_ratio``, introduced with drafted
+#: multi-token verification + seeded real sampling on the paged decode
+#: tier)
+DEFAULT_REQUIRE_DECODE_SPEC_FROM = 22
 #: |stage_sum / wall - 1| beyond this fails the artifact: a breakdown that
 #: does not add up is decoration, not attribution
 DEFAULT_FLIGHT_TOLERANCE = 0.15
@@ -378,6 +383,19 @@ _DECODE_PREFILL_IDENT_KEYS = (
     "decode_prefill_model", "decode_prefill_page_size",
     "decode_prefill_max_seqs", "decode_prefill_devices",
     "decode_prefill_host_cpus")
+_DECODE_SPEC_KEY = "spec_itl_p99_ratio"
+#: the speculative-decoding A/B's config identity: the ITL ratio,
+#: tokens-per-verify-step and acceptance rate are only comparable at
+#: the same drafter kind and draft depth k (the mechanism itself),
+#: prompt mix, generation length, chunk/page/slot geometry, model
+#: geometry AND device/CPU counts — drafts verified over a different
+#: ladder or by a different drafter are a different experiment
+_DECODE_SPEC_IDENT_KEYS = (
+    "spec_clients", "spec_requests", "spec_shared_requests",
+    "spec_max_new_tokens", "spec_prompt_lens", "spec_prefix_len",
+    "spec_k", "spec_drafter", "spec_ladder", "spec_model",
+    "spec_page_size", "spec_max_seqs", "spec_prefill_chunk",
+    "spec_devices", "spec_host_cpus")
 _COSTS_KEY = "costs_conservation_ratio"
 #: the cost-accounting microbench's config identity: the ledger's
 #: overhead and the skew detection latency are only comparable at the
@@ -511,7 +529,8 @@ def validate_half(half: dict[str, Any], *,
                   require_incident: bool = False,
                   require_collectives: bool = False,
                   require_costs: bool = False,
-                  require_decode_prefill: bool = False) -> list[str]:
+                  require_decode_prefill: bool = False,
+                  require_decode_spec: bool = False) -> list[str]:
     """Schema problems of one measured result (a wrapper's half)."""
     problems = []
     for key in _REQUIRED_HALF_KEYS:
@@ -882,6 +901,77 @@ def validate_half(half: dict[str, Any], *,
                         "'decode_prefill_short_ttft_speedup' is null "
                         "without a "
                         "'decode_prefill_short_ttft_speedup_reason'")
+    # speculative-decoding microbench: host-side like the chunked-prefill
+    # one, so required even on degraded-accelerator rounds; null +
+    # 'spec_reason' always satisfies.  A numeric ITL ratio must carry
+    # its config identity, a verified token-equality pass, a sane
+    # acceptance rate, and tokens-per-step > 1 — speculation that never
+    # collapsed a step measured nothing, and speculation that changed
+    # the tokens is broken, not fast.  The ITL SPEEDUP may be null only
+    # WITH a 'spec_itl_speedup_reason': a compute-bound single-device
+    # host pays the (k+1)-position verify FLOPs in full where a
+    # dispatch-bound accelerator gets the extra positions for ~one
+    # step's dispatch cost
+    if require_decode_spec or _DECODE_SPEC_KEY in half:
+        if half.get("decode_spec_output_equality") == "fail":
+            # judged FIRST: a diverged speculative stream also stamps a
+            # null headline + reason, and that legitimate-looking null
+            # must not launder broken speculation into a passing
+            # artifact
+            problems.append(
+                "decode_spec_output_equality is 'fail': the "
+                "speculative engine decoded different tokens than the "
+                "single-token engine — broken, not fast; the artifact "
+                "fails")
+        if _DECODE_SPEC_KEY not in half:
+            problems.append(
+                f"missing {_DECODE_SPEC_KEY!r} (speculative-decoding "
+                "microbench is part of the schema from r22: measure it "
+                "or stamp an explicit null + 'spec_reason')")
+        elif half[_DECODE_SPEC_KEY] is None \
+                and "spec_reason" not in half:
+            problems.append(
+                f"{_DECODE_SPEC_KEY!r} is null without a 'spec_reason'")
+        elif isinstance(half.get(_DECODE_SPEC_KEY), (int, float)):
+            sval = half[_DECODE_SPEC_KEY]
+            if sval <= 0:
+                problems.append(
+                    f"{_DECODE_SPEC_KEY!r} is {sval!r} — a latency "
+                    "ratio must be a positive number")
+            missing = [k for k in _DECODE_SPEC_IDENT_KEYS
+                       if k not in half]
+            if missing:
+                problems.append(
+                    f"{_DECODE_SPEC_KEY!r} without its config identity "
+                    f"({', '.join(missing)}) — the speculative A/B is "
+                    "only comparable within one drafter/k/mix/page/"
+                    "device config")
+            if half.get("decode_spec_output_equality") != "pass":
+                problems.append(
+                    "decode_spec_output_equality is "
+                    f"{half.get('decode_spec_output_equality')!r}: a "
+                    "speculative stream whose tokens were not verified "
+                    "equal to the single-token engine's is broken, not "
+                    "fast")
+            rate = half.get("spec_acceptance_rate")
+            if not isinstance(rate, (int, float)) \
+                    or not 0.0 <= rate <= 1.0:
+                problems.append(
+                    f"{_DECODE_SPEC_KEY!r} without a numeric "
+                    "'spec_acceptance_rate' in [0, 1] — an ITL ratio "
+                    "with no drafter hit rate cannot be attributed to "
+                    "speculation")
+            tps = half.get("spec_tokens_per_step")
+            if not isinstance(tps, (int, float)) or tps <= 1.0:
+                problems.append(
+                    f"'spec_tokens_per_step' is {tps!r} — speculation "
+                    "must emit MORE than one token per verify step, or "
+                    "the mechanism under test never engaged")
+            if half.get("spec_itl_speedup") is None \
+                    and "spec_itl_speedup_reason" not in half:
+                problems.append(
+                    "'spec_itl_speedup' is null without a "
+                    "'spec_itl_speedup_reason'")
     # fleet-observability microbench: host-side multi-process like the
     # mesh one, so a degraded-accelerator round still owes it; null +
     # 'fleet_reason' always satisfies.  A numeric overhead must be a
@@ -1345,7 +1435,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
          require_incident_from: int = DEFAULT_REQUIRE_INCIDENT_FROM,
          require_collectives_from: int = DEFAULT_REQUIRE_COLLECTIVES_FROM,
          require_costs_from: int = DEFAULT_REQUIRE_COSTS_FROM,
-         require_decode_prefill_from: int = DEFAULT_REQUIRE_DECODE_PREFILL_FROM
+         require_decode_prefill_from: int = DEFAULT_REQUIRE_DECODE_PREFILL_FROM,
+         require_decode_spec_from: int = DEFAULT_REQUIRE_DECODE_SPEC_FROM
          ) -> dict[str, Any]:
     """Run the gate over a trajectory; returns the verdict document."""
     checks: list[dict[str, Any]] = []
@@ -1409,6 +1500,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                           and art["n"] >= require_costs_from)
             require_dp = (label == "primary"
                           and art["n"] >= require_decode_prefill_from)
+            require_ds = (label == "primary"
+                          and art["n"] >= require_decode_spec_from)
             for problem in validate_half(half, require_roofline=require_rf,
                                          require_feed=require_fd,
                                          require_serving=require_sv,
@@ -1423,7 +1516,8 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                                          require_incident=require_in,
                                          require_collectives=require_co,
                                          require_costs=require_ct,
-                                         require_decode_prefill=require_dp):
+                                         require_decode_prefill=require_dp,
+                                         require_decode_spec=require_ds):
                 check(f"schema:{name}:{label}",
                       "fail" if is_newest else "warn", problem)
             # flight breakdowns ride the primary half with the microbench
@@ -1649,6 +1743,34 @@ def gate(paths: list[str], *, threshold: float = DEFAULT_THRESHOLD,
                           f"prior {pprior[0]}ms ({pprior[1]}) — the "
                           "short-prompt first token slowed beyond "
                           f"1/{threshold}")
+            # speculative-decoding ITL ratio: host-side, a latency
+            # ratio, LOWER is better within its own drafter/k/mix/
+            # page/device identity — a drafter change that buys
+            # acceptance with a slower per-token tail is a regression,
+            # not a win
+            if isinstance(half.get(_DECODE_SPEC_KEY), (int, float)):
+                sprior = _comparable_prior_hostside(
+                    artifacts, newest, half, _DECODE_SPEC_KEY,
+                    _DECODE_SPEC_IDENT_KEYS, better=min)
+                sname = f"regression:{_DECODE_SPEC_KEY}"
+                sval = float(half[_DECODE_SPEC_KEY])
+                if sprior is None:
+                    check(sname, "pass",
+                          "no comparable prior speculative-decode "
+                          "measurement (same drafter/k/mix/page/device "
+                          "config) — nothing to regress against")
+                elif sval * threshold <= sprior[0]:
+                    check(sname, "pass",
+                          f"{sval} vs best prior {sprior[0]} "
+                          f"({sprior[1]}): ratio "
+                          f"{round(sval / sprior[0], 4)} ≤ "
+                          f"{round(1 / threshold, 4)}")
+                else:
+                    check(sname, "fail",
+                          f"{sval} is {round(sval / sprior[0], 4)}× "
+                          f"the best prior {sprior[0]} ({sprior[1]}) — "
+                          "the speculative per-token tail slowed "
+                          f"beyond 1/{threshold}")
             # compile-cache cold start: host-side, judged before the
             # degraded skip; LOWER is better (it is a latency), same
             # contract as recovery_seconds
@@ -1799,6 +1921,8 @@ def main(argv: list[str] | None = None) -> int:
                    default=DEFAULT_REQUIRE_COSTS_FROM)
     p.add_argument("--require-decode-prefill-from", type=int,
                    default=DEFAULT_REQUIRE_DECODE_PREFILL_FROM)
+    p.add_argument("--require-decode-spec-from", type=int,
+                   default=DEFAULT_REQUIRE_DECODE_SPEC_FROM)
     args = p.parse_args(argv)
     paths = args.paths or discover(args.repo)
     if not paths:
@@ -1823,7 +1947,8 @@ def main(argv: list[str] | None = None) -> int:
                require_incident_from=args.require_incident_from,
                require_collectives_from=args.require_collectives_from,
                require_costs_from=args.require_costs_from,
-               require_decode_prefill_from=args.require_decode_prefill_from)
+               require_decode_prefill_from=args.require_decode_prefill_from,
+               require_decode_spec_from=args.require_decode_spec_from)
     print(json.dumps(doc))
     return 1 if doc["verdict"] == "fail" else 0
 
